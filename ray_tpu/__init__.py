@@ -1,0 +1,35 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+A ground-up redesign of the capabilities of the reference Ray fork
+(/root/reference, Ray ~2.54): tasks, actors, objects, placement groups,
+cluster scheduling, autoscaling, and the AI libraries — built TPU-first.
+The cluster scheduler itself is a set of batched JAX programs
+(ray_tpu.scheduler); model compute is jax/pjit/pallas over device meshes.
+"""
+from ray_tpu._version import __version__  # noqa: F401
+
+from ray_tpu.core.api import (  # noqa: F401
+    ObjectRef,
+    actor_exited,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.placement_group import (  # noqa: F401
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+# method decorator for actor method options
+from ray_tpu.core.actor import method  # noqa: F401

@@ -1,0 +1,1 @@
+"""Core runtime: tasks, actors, objects, placement groups, lease scheduling."""

@@ -1,0 +1,291 @@
+"""Actors: stateful workers with ordered method execution and restarts.
+
+Analog of the reference's actor stack (GcsActorManager state machine +
+ActorTaskSubmitter ordered queues + TaskReceiver concurrency groups,
+/root/reference/src/ray/gcs/actor/, src/ray/core_worker/task_submission/
+actor_task_submitter.cc). Creation is centrally scheduled through the same
+batched kernels as tasks; each live actor owns a dedicated executor thread
+(or pool, for max_concurrency>1) so method ordering matches the reference's
+per-caller sequencing. ``max_restarts`` drives the restart state machine on
+node death.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .object_store import ObjectRef, TaskError
+
+
+class ActorUnavailableError(Exception):
+    pass
+
+
+def method(**options):
+    """Decorator carrying per-method options (num_returns, ...) — parity
+    with ray.method (python/ray/actor.py)."""
+
+    def wrap(fn):
+        fn._ray_tpu_method_options = options
+        return fn
+
+    return wrap
+
+
+class ActorState:
+    """Server side of one actor instance."""
+
+    def __init__(
+        self,
+        runtime,
+        actor_id: str,
+        cls: type,
+        ctor_args: tuple,
+        ctor_kwargs: dict,
+        resources: Dict[str, float],
+        *,
+        name: Optional[str] = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+    ):
+        self.runtime = runtime
+        self.actor_id = actor_id
+        self.cls = cls
+        self.ctor_args = ctor_args
+        self.ctor_kwargs = ctor_kwargs
+        self.resources = resources
+        self.name = name
+        self.max_restarts = max_restarts
+        self.max_task_retries = max_task_retries
+        self.max_concurrency = max_concurrency
+        self.restarts_used = 0
+        self.node_id: Optional[str] = None
+        self.instance: Any = None
+        self.alive = False
+        self.dead_forever = False
+        self.death_cause: Optional[str] = None
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._threads: List[threading.Thread] = []
+        self._held_req = None  # (node, ResourceRequest) while alive
+
+    # -- lifecycle ------------------------------------------------------
+    def on_created(self, node_id: str, instance: Any, held_req) -> None:
+        with self._cond:
+            self.node_id = node_id
+            self.instance = instance
+            self.alive = True
+            self._held_req = held_req
+            self._threads = [
+                threading.Thread(
+                    target=self._run_loop,
+                    name=f"actor-{self.actor_id[:6]}-{i}",
+                    daemon=True,
+                )
+                for i in range(self.max_concurrency)
+            ]
+            for t in self._threads:
+                t.start()
+            self._cond.notify_all()
+
+    def mark_died(self, restart: bool) -> None:
+        with self._cond:
+            was_alive = self.alive
+            self.alive = False
+            self.instance = None
+            if restart and self.restarts_used < self.max_restarts:
+                self.restarts_used += 1
+                self._cond.notify_all()
+                if was_alive:
+                    self.runtime._resubmit_actor_creation(self)
+                return
+            self.dead_forever = True
+            self.death_cause = "killed" if not restart else "node died"
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        from .runtime import ActorDiedError
+
+        for call in pending:
+            for ref in call["returns"]:
+                self.runtime.store.seal(
+                    ref,
+                    ActorDiedError(
+                        f"actor {self.name or self.actor_id} is dead"
+                    ),
+                    is_error=True,
+                )
+
+    def stop(self) -> None:
+        self.mark_died(restart=False)
+
+    # -- method invocation ---------------------------------------------
+    def submit_method(
+        self, method_name: str, args: tuple, kwargs: dict, returns: List[ObjectRef]
+    ) -> None:
+        from .runtime import ActorDiedError
+
+        with self._cond:
+            if self.dead_forever:
+                for ref in returns:
+                    self.runtime.store.seal(
+                        ref,
+                        ActorDiedError(
+                            f"actor {self.name or self.actor_id} is dead"
+                        ),
+                        is_error=True,
+                    )
+                return
+            self._queue.append(
+                {
+                    "method": method_name,
+                    "args": args,
+                    "kwargs": kwargs,
+                    "returns": returns,
+                    "attempt": 0,
+                }
+            )
+            self._cond.notify()
+
+    def _run_loop(self) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._cond:
+                while self.alive and not self._queue:
+                    self._cond.wait(timeout=0.5)
+                if not self.alive:
+                    return
+                if me not in self._threads:
+                    return  # superseded by a restart generation
+                call = self._queue.popleft()
+                instance = self.instance
+            self._execute_call(instance, call)
+
+    def _execute_call(self, instance: Any, call: dict) -> None:
+        from .runtime import get_context
+
+        ctx = get_context()
+        ctx.node_id = self.node_id
+        ctx.actor_id = self.actor_id
+        try:
+            args, kwargs = self.runtime._resolve_args(call["args"], call["kwargs"])
+            fn = getattr(instance, call["method"])
+            result = fn(*args, **kwargs)
+            refs = call["returns"]
+            values = [result] if len(refs) == 1 else tuple(result)
+            node = self.runtime.nodes.get(self.node_id)
+            for ref, value in zip(refs, values):
+                if node is not None:
+                    node.objects.add(ref.hex)
+                self.runtime.store.seal(ref, value)
+            self.runtime.metrics["tasks_finished"] += 1
+        except BaseException as exc:  # noqa: BLE001
+            if call["attempt"] < self.max_task_retries:
+                call["attempt"] += 1
+                with self._cond:
+                    self._queue.appendleft(call)
+                    self._cond.notify()
+                return
+            err = TaskError(exc, f"{self.cls.__name__}.{call['method']}")
+            err.__cause__ = exc
+            for ref in call["returns"]:
+                self.runtime.store.seal(ref, err, is_error=True)
+            self.runtime.metrics["tasks_failed"] += 1
+        finally:
+            ctx.node_id = None
+            ctx.actor_id = None
+
+    def requeue_front(self, call: dict) -> None:
+        with self._cond:
+            self._queue.appendleft(call)
+            self._cond.notify()
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs, self._num_returns)
+
+    def options(self, num_returns: Optional[int] = None, **_ignored):
+        return ActorMethod(
+            self._handle, self._name, num_returns or self._num_returns
+        )
+
+
+class ActorHandle:
+    """Client-side handle (reference: python/ray/actor.py ActorHandle)."""
+
+    def __init__(self, runtime, actor_id: str, cls: type):
+        self._runtime = runtime
+        self._actor_id = actor_id
+        self._cls = cls
+
+    @property
+    def _actor_state(self) -> ActorState:
+        return self._runtime._actors[self._actor_id]
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        fn = getattr(self._cls, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError(
+                f"actor class {self._cls.__name__} has no method {name!r}"
+            )
+        opts = getattr(fn, "_ray_tpu_method_options", {})
+        return ActorMethod(self, name, opts.get("num_returns", 1))
+
+    def _invoke(self, method_name, args, kwargs, num_returns):
+        refs = [ObjectRef.new(owner=self._actor_id) for _ in range(num_returns)]
+        for r in refs:
+            self._runtime.store.create(r)
+        self._runtime.metrics["tasks_submitted"] += 1
+        self._actor_state.submit_method(method_name, args, kwargs, refs)
+        return refs[0] if num_returns == 1 else refs
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._cls.__name__}, {self._actor_id[:8]})"
+
+
+def create_actor(
+    runtime,
+    cls: type,
+    args: tuple,
+    kwargs: dict,
+    *,
+    resources: Dict[str, float],
+    name: Optional[str] = None,
+    lifetime: Optional[str] = None,
+    max_restarts: int = 0,
+    max_task_retries: int = 0,
+    max_concurrency: int = 1,
+    scheduling_strategy=None,
+) -> ActorHandle:
+    """Create + centrally schedule an actor (GcsActorScheduler analog)."""
+    if name is not None and name in runtime._named_actors:
+        raise ValueError(f"actor name {name!r} already taken")
+    actor_id = uuid.uuid4().hex[:16]
+    state = ActorState(
+        runtime,
+        actor_id,
+        cls,
+        args,
+        kwargs,
+        resources,
+        name=name,
+        max_restarts=max_restarts,
+        max_task_retries=max_task_retries,
+        max_concurrency=max_concurrency,
+    )
+    runtime._actors[actor_id] = state
+    if name is not None:
+        runtime._named_actors[name] = actor_id
+    runtime._submit_actor_creation(state, scheduling_strategy)
+    return ActorHandle(runtime, actor_id, cls)

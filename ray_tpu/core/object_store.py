@@ -1,0 +1,177 @@
+"""In-memory object plane: ObjectRef + owner-tracked store.
+
+Single-process analog of the reference's object plane (plasma +
+CoreWorkerMemoryStore, /root/reference/src/ray/core_worker/store_provider/):
+objects are immutable once sealed; readers block until sealed; task errors
+are stored as first-class values and re-raised on get (RayTaskError
+semantics, python/ray/exceptions.py). Ownership/refcounting is tracked per
+object so lineage-based recovery can be layered on (reference_counter.h:44).
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class TaskError(Exception):
+    """Wraps an exception raised in a remote task (RayTaskError analog)."""
+
+    def __init__(self, cause: BaseException, task_desc: str = ""):
+        super().__init__(f"task {task_desc} failed: {cause!r}")
+        self.cause = cause
+        self.task_desc = task_desc
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """A future-like handle to a task output or put object.
+
+    28-hex ids like the reference's ObjectID (src/ray/common/id.h).
+    """
+
+    hex: str
+    owner: str = ""  # owning "worker"/task id — lineage anchor
+
+    @staticmethod
+    def new(owner: str = "") -> "ObjectRef":
+        return ObjectRef(uuid.uuid4().hex[:28], owner)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.hex})"
+
+    def __hash__(self) -> int:
+        return hash(self.hex)
+
+
+@dataclass
+class _Entry:
+    event: threading.Event = field(default_factory=threading.Event)
+    value: Any = None
+    is_error: bool = False
+    local_refs: int = 1
+    creating_task: Optional[str] = None  # lineage: task id that creates this
+
+
+class ObjectStore:
+    """Process-wide store; thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._objects: Dict[str, _Entry] = {}
+
+    def create(self, ref: ObjectRef, creating_task: Optional[str] = None) -> None:
+        with self._lock:
+            if ref.hex not in self._objects:
+                self._objects[ref.hex] = _Entry(creating_task=creating_task)
+
+    def seal(self, ref: ObjectRef, value: Any, is_error: bool = False) -> None:
+        with self._lock:
+            entry = self._objects.setdefault(ref.hex, _Entry())
+            entry.value = value
+            entry.is_error = is_error
+            entry.event.set()
+
+    def contains(self, ref: ObjectRef) -> bool:
+        with self._lock:
+            e = self._objects.get(ref.hex)
+            return e is not None and e.event.is_set()
+
+    def get(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            entry = self._objects.setdefault(ref.hex, _Entry())
+        if not entry.event.wait(timeout):
+            raise GetTimeoutError(f"get() timed out waiting for {ref}")
+        if entry.is_error:
+            if isinstance(entry.value, BaseException):
+                raise entry.value
+            raise TaskError(RuntimeError(str(entry.value)))
+        return entry.value
+
+    def wait_many(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> tuple[List[ObjectRef], List[ObjectRef]]:
+        """ray.wait semantics: (ready, not_ready), preserving input order."""
+        deadline = None if timeout is None else (timeout + _now())
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            progressed = False
+            still: List[ObjectRef] = []
+            for r in pending:
+                if self.contains(r):
+                    ready.append(r)
+                    progressed = True
+                    if len(ready) >= num_returns:
+                        still.extend(pending[pending.index(r) + 1 :])
+                        break
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and _now() >= deadline:
+                break
+            if not progressed:
+                remaining = None if deadline is None else max(0.0, deadline - _now())
+                self._wait_any(pending, remaining)
+        return ready, pending
+
+    def _wait_any(self, refs: List[ObjectRef], timeout: Optional[float]) -> None:
+        if not refs:
+            return
+        with self._lock:
+            events = [self._objects.setdefault(r.hex, _Entry()).event for r in refs]
+        step = 0.005
+        waited = 0.0
+        while True:
+            for e in events:
+                if e.is_set():
+                    return
+            if timeout is not None and waited >= timeout:
+                return
+            events[0].wait(step)
+            waited += step
+            step = min(step * 2, 0.1)
+
+    def add_ref(self, ref: ObjectRef) -> None:
+        with self._lock:
+            e = self._objects.get(ref.hex)
+            if e:
+                e.local_refs += 1
+
+    def remove_ref(self, ref: ObjectRef) -> None:
+        with self._lock:
+            e = self._objects.get(ref.hex)
+            if e:
+                e.local_refs -= 1
+                if e.local_refs <= 0 and e.event.is_set():
+                    del self._objects[ref.hex]
+
+    def free(self, refs: List[ObjectRef]) -> None:
+        with self._lock:
+            for r in refs:
+                self._objects.pop(r.hex, None)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            sealed = sum(1 for e in self._objects.values() if e.event.is_set())
+            return {"num_objects": len(self._objects), "num_sealed": sealed}
+
+
+def _now() -> float:
+    import time
+
+    return time.monotonic()

@@ -1,0 +1,696 @@
+"""The ray_tpu runtime: nodes, leases, batched scheduling, lineage.
+
+Single-process, multi-node-simulated runtime — the analog of the reference's
+raylet + GCS + core-worker stack (/root/reference/src/ray/raylet/,
+src/ray/gcs/, src/ray/core_worker/), with the crucial difference that *all*
+placement decisions flow through the batched JAX kernels in
+``ray_tpu.scheduler`` instead of per-request C++ scans:
+
+- Every task/actor-creation submission becomes a *lease request* queued with
+  the scheduler thread (ClusterLeaseManager::QueueAndScheduleLease analog,
+  cluster_lease_manager.cc:47).
+- The scheduler thread drains the queue and places the whole batch with one
+  ``hybrid_schedule_batch`` call (ScheduleAndGrantLeases hot loop,
+  cluster_lease_manager.cc:196 — but batched).
+- Grants are admitted against each node's exact fixed-point ledger
+  (grant-or-reject under a possibly-stale dense view, the reference's
+  LocalResourceManager contract); rejected grants are requeued (spillback).
+- Node death drops that node's objects; lost objects are rebuilt by lineage
+  re-execution (ObjectRecoveryManager / TaskManager::ResubmitTask analog,
+  core_worker/task_manager.h:229).
+
+This process-level harness is also the test vehicle for multi-node scheduling
+logic, mirroring how the reference tests multi-node behavior in a single
+process (python/ray/cluster_utils.py:137).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import traceback
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.scheduler import (
+    ClusterView,
+    HybridConfig,
+    NodeResourceLedger,
+    ResourceRequest,
+    ResourceVocab,
+    hybrid_schedule_reference,
+)
+from ray_tpu.scheduler import hybrid as hybrid_mod
+from .object_store import ObjectRef, ObjectStore, TaskError
+
+logger = logging.getLogger("ray_tpu")
+
+# Leases per scheduling round (the batching that makes the TPU kernel pay).
+MAX_SCHEDULE_BATCH = 1024
+# Below this batch size the host (numpy) path beats a device dispatch.
+DEVICE_KERNEL_MIN_BATCH = 64
+
+
+class ActorDiedError(Exception):
+    pass
+
+
+class NodeDiedError(Exception):
+    pass
+
+
+@dataclass
+class TaskSpec:
+    """A task/actor-creation/actor-method invocation (LeaseSpecification +
+    TaskSpecification analog, src/ray/common/lease/)."""
+
+    task_id: str
+    func: Callable
+    args: tuple
+    kwargs: dict
+    returns: List[ObjectRef]
+    resources: Dict[str, float]
+    name: str = ""
+    kind: str = "task"  # task | actor_creation | actor_method
+    actor_id: Optional[str] = None
+    strategy: Any = None  # scheduling strategy object or None
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    attempt: int = 0
+
+
+@dataclass
+class Node:
+    """A simulated cluster node: ledger + worker pool (raylet + workers)."""
+
+    node_id: str
+    ledger: NodeResourceLedger
+    pool: ThreadPoolExecutor
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    running_tasks: Dict[str, TaskSpec] = field(default_factory=dict)
+    objects: set = field(default_factory=set)  # hex ids sealed on this node
+
+
+class WorkerContext(threading.local):
+    node_id: Optional[str] = None
+    task_id: Optional[str] = None
+    actor_id: Optional[str] = None
+
+
+_context = WorkerContext()
+
+
+def get_context() -> WorkerContext:
+    return _context
+
+
+class Runtime:
+    """Cluster-in-a-process. One instance per init()."""
+
+    def __init__(
+        self,
+        num_nodes: int = 1,
+        resources_per_node: Optional[Dict[str, float]] = None,
+        use_device_scheduler: bool = False,
+        hybrid_config: HybridConfig = HybridConfig(),
+    ):
+        self.vocab = ResourceVocab()
+        self.view = ClusterView(self.vocab)
+        self.store = ObjectStore()
+        self.nodes: Dict[str, Node] = {}
+        self.hybrid_config = hybrid_config
+        self.use_device_scheduler = use_device_scheduler
+        self._rng = np.random.default_rng(0)
+        self._seed_counter = itertools.count(1)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[TaskSpec] = []
+        self._infeasible: List[TaskSpec] = []
+        self._lineage: Dict[str, TaskSpec] = {}  # object hex -> creating spec
+        self._actors: Dict[str, "ActorState"] = {}
+        self._named_actors: Dict[str, str] = {}
+        self._pgs: Dict[str, Any] = {}  # pg_id -> PlacementGroupState
+        self._pending_pgs: List[Any] = []  # PG states awaiting placement
+        self._dirty = False
+        self._shutdown = False
+        self._sched_thread = threading.Thread(
+            target=self._scheduler_loop, name="ray_tpu-scheduler", daemon=True
+        )
+        self.metrics: Dict[str, int] = {
+            "tasks_submitted": 0,
+            "tasks_finished": 0,
+            "tasks_failed": 0,
+            "leases_spilled_back": 0,
+            "sched_rounds": 0,
+        }
+        if resources_per_node is None:
+            resources_per_node = {"CPU": 8, "memory": float(4 << 30)}
+        for i in range(num_nodes):
+            self.add_node(resources_per_node)
+        self._sched_thread.start()
+
+    # ------------------------------------------------------------------
+    # membership (GcsNodeManager analog)
+    # ------------------------------------------------------------------
+    def add_node(
+        self,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+    ) -> str:
+        node_id = uuid.uuid4().hex[:16]
+        num_workers = max(1, int(resources.get("CPU", 1)))
+        node = Node(
+            node_id=node_id,
+            ledger=NodeResourceLedger(self.vocab, resources),
+            pool=ThreadPoolExecutor(
+                max_workers=num_workers, thread_name_prefix=f"worker-{node_id[:6]}"
+            ),
+            labels=dict(labels or {}),
+        )
+        with self._cond:
+            self.nodes[node_id] = node
+            self.view.add_node(node_id, resources, labels)
+            # new capacity may unblock infeasible leases and pending PGs
+            self._dirty = True
+            self._pending.extend(self._infeasible)
+            self._infeasible.clear()
+            self._cond.notify_all()
+        return node_id
+
+    def kill_node(self, node_id: str) -> None:
+        """Simulated node failure (test chaos hook, like RayletKiller,
+        /root/reference/python/ray/_private/test_utils.py:1408)."""
+        with self._cond:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            self.view.remove_node(node_id)
+            lost_objects = list(node.objects)
+            node.objects.clear()
+            running = list(node.running_tasks.values())
+            node.running_tasks.clear()
+            # Actors on this node die.
+            for actor in list(self._actors.values()):
+                if actor.node_id == node_id and actor.alive:
+                    actor.mark_died(restart=True)
+            self._cond.notify_all()
+        node.pool.shutdown(wait=False, cancel_futures=True)
+        # Drop the node's objects; lineage rebuilds them on demand.
+        for hex_id in lost_objects:
+            self._invalidate_object(hex_id)
+        # Resubmit tasks that were running there.
+        for spec in running:
+            if spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                self.metrics["leases_spilled_back"] += 1
+                self._enqueue(spec)
+            else:
+                for ref in spec.returns:
+                    self.store.seal(
+                        ref,
+                        NodeDiedError(f"node {node_id} died running {spec.name}"),
+                        is_error=True,
+                    )
+
+    def _invalidate_object(self, hex_id: str) -> None:
+        spec = self._lineage.get(hex_id)
+        if spec is not None and (
+            spec.kind != "task" or spec.attempt >= spec.max_retries
+        ):
+            # Lineage exhausted (or not a re-executable plain task): the
+            # object is permanently lost — fail pending gets.
+            ref = next((r for r in spec.returns if r.hex == hex_id), None)
+            if ref is not None and self.store.contains(ref):
+                return  # already sealed elsewhere (e.g. resubmitted copy won)
+            from .object_store import ObjectLostError
+
+            self.store.seal(
+                ObjectRef(hex_id),
+                ObjectLostError(
+                    f"object {hex_id} lost with its node; lineage retries "
+                    f"exhausted ({spec.attempt}/{spec.max_retries})"
+                ),
+                is_error=True,
+            )
+            return
+        with self.store._lock:
+            entry = self.store._objects.get(hex_id)
+            if entry is not None and entry.event.is_set():
+                entry.event.clear()
+                entry.value = None
+        if spec is not None:
+            clone = TaskSpec(
+                task_id=uuid.uuid4().hex[:16],
+                func=spec.func,
+                args=spec.args,
+                kwargs=spec.kwargs,
+                returns=spec.returns,
+                resources=spec.resources,
+                name=spec.name,
+                kind=spec.kind,
+                actor_id=spec.actor_id,
+                strategy=spec.strategy,
+                max_retries=spec.max_retries,
+                retry_exceptions=spec.retry_exceptions,
+                attempt=spec.attempt + 1,
+            )
+            for r in clone.returns:
+                self._lineage[r.hex] = clone  # retry budget advances
+            self._enqueue(clone)
+
+    # ------------------------------------------------------------------
+    # submission (NormalTaskSubmitter analog)
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        for ref in spec.returns:
+            self.store.create(ref, creating_task=spec.task_id)
+            self._lineage[ref.hex] = spec
+        self.metrics["tasks_submitted"] += 1
+        self._enqueue(spec)
+        return spec.returns
+
+    def _enqueue(self, spec: TaskSpec) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            self._pending.append(spec)
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # the batched scheduler (ScheduleAndGrantLeases analog)
+    # ------------------------------------------------------------------
+    def _scheduler_loop(self) -> None:
+        while True:
+            with self._cond:
+                while (
+                    not self._pending and not self._dirty and not self._shutdown
+                ):
+                    self._cond.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                self._dirty = False
+                batch = self._pending[:MAX_SCHEDULE_BATCH]
+                del self._pending[: len(batch)]
+            try:
+                self._try_schedule_pgs()
+                if batch:
+                    self._schedule_batch(batch)
+            except Exception:  # pragma: no cover - scheduler must survive
+                logger.exception("scheduler round failed; requeueing batch")
+                with self._cond:
+                    self._pending.extend(batch)
+
+    def register_pg(self, state) -> None:
+        """Queue a placement group for scheduling (SchedulePendingPlacementGroups
+        analog, gcs_placement_group_manager.cc:300)."""
+        with self._cond:
+            self._pgs[state.id] = state
+            self._pending_pgs.append(state)
+            self._dirty = True
+            self._cond.notify_all()
+
+    def notify_resources_changed(self) -> None:
+        with self._cond:
+            self._dirty = True
+            self._pending.extend(self._infeasible)
+            self._infeasible.clear()
+            self._cond.notify_all()
+
+    def _try_schedule_pgs(self) -> None:
+        with self._cond:
+            pending = list(self._pending_pgs)
+        for state in pending:
+            if state.removed:
+                with self._cond:
+                    if state in self._pending_pgs:
+                        self._pending_pgs.remove(state)
+                continue
+            if state.try_schedule():
+                with self._cond:
+                    if state in self._pending_pgs:
+                        self._pending_pgs.remove(state)
+                    # PG-waiting leases were parked as infeasible; retry them.
+                    self._pending.extend(self._infeasible)
+                    self._infeasible.clear()
+                    self._cond.notify_all()
+
+    def _schedule_batch(self, batch: List[TaskSpec]) -> None:
+        self.metrics["sched_rounds"] += 1
+        # Split out strategy-constrained leases; they bypass the hybrid kernel
+        # (the reference dispatches them to other policies —
+        # composite_scheduling_policy.cc).
+        hybrid_batch: List[TaskSpec] = []
+        for spec in batch:
+            target = self._strategy_target(spec)
+            if target is _HYBRID:
+                hybrid_batch.append(spec)
+            elif target is _FAIL:
+                self.metrics["tasks_failed"] += 1
+                err = TaskError(
+                    NodeDiedError(
+                        f"task {spec.name}: hard scheduling constraint can "
+                        "never be satisfied (target node is dead/unknown)"
+                    ),
+                    spec.name,
+                )
+                for ref in spec.returns:
+                    self.store.seal(ref, err, is_error=True)
+            elif target is None:
+                self._park_infeasible(spec)
+            else:
+                node_id, via_pg = target
+                self._grant_or_requeue(spec, node_id, via_pg=via_pg)
+        if not hybrid_batch:
+            return
+
+        totals, avail, alive = self.view.active_arrays()
+        n = self.view.num_nodes
+        if n == 0:
+            for spec in hybrid_batch:
+                self._park_infeasible(spec)
+            return
+        demands = np.stack(
+            [
+                ResourceRequest.from_map(self.vocab, s.resources).dense(
+                    totals.shape[1]
+                )
+                for s in hybrid_batch
+            ]
+        )
+        prefer = np.zeros(len(hybrid_batch), dtype=np.int32)
+        force_spill = np.zeros(len(hybrid_batch), dtype=bool)
+        if self.use_device_scheduler and len(hybrid_batch) >= DEVICE_KERNEL_MIN_BATCH:
+            import jax.numpy as jnp
+
+            res = hybrid_mod.hybrid_schedule_batch(
+                jnp.asarray(totals),
+                jnp.asarray(avail),
+                jnp.asarray(alive),
+                jnp.asarray(demands),
+                jnp.asarray(prefer),
+                jnp.asarray(force_spill),
+                np.uint32(next(self._seed_counter)),
+                config=self.hybrid_config,
+            )
+            nodes_idx = np.asarray(res.node)
+            granted = np.asarray(res.available)
+        else:
+            nodes_idx, granted, _ = hybrid_schedule_reference(
+                totals,
+                avail,
+                alive,
+                demands,
+                prefer,
+                force_spill,
+                config=self.hybrid_config,
+                rng=self._rng,
+            )
+        for spec, row, ok in zip(hybrid_batch, nodes_idx, granted):
+            if row < 0:
+                self._park_infeasible(spec)
+            elif not ok:
+                # Feasible but no node has the resources free right now:
+                # park until a release/new node notifies (the reference
+                # queues at the target raylet, local_lease_manager.h:39).
+                self._park_infeasible(spec)
+            else:
+                self._grant_or_requeue(spec, self.view.node_id(int(row)))
+
+    _SENTINEL = object()
+
+    def _strategy_target(self, spec: TaskSpec):
+        """Resolve scheduling strategies. Returns _HYBRID, None (infeasible
+        now), or (node_id, via_pg) to dispatch directly."""
+        from .scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+            PlacementGroupSchedulingStrategy,
+        )
+
+        strat = spec.strategy
+        if strat is None or strat == "DEFAULT" or strat == "SPREAD":
+            return _HYBRID
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            node = self.nodes.get(strat.node_id)
+            if node is not None and node.alive:
+                return (strat.node_id, None)
+            # Hard affinity to a dead/unknown node can never succeed — fail
+            # fast (the reference raises an unschedulable error).
+            return _HYBRID if strat.soft else _FAIL
+        if isinstance(strat, PlacementGroupSchedulingStrategy):
+            pg = self._pgs.get(strat.placement_group.id)
+            if pg is None or not pg.ready_event.is_set():
+                return None  # wait for PG (requeued when PG commits)
+            picked = pg.pick_bundle(
+                strat.placement_group_bundle_index,
+                ResourceRequest.from_map(self.vocab, spec.resources),
+            )
+            if picked is None:
+                return None
+            node_id, bundle_idx = picked
+            return (node_id, (pg.id, bundle_idx))
+        return _HYBRID
+
+    def _park_infeasible(self, spec: TaskSpec) -> None:
+        with self._cond:
+            self._infeasible.append(spec)
+
+    def requeue_parked(self) -> None:
+        """Re-test infeasible/PG-waiting leases (cluster state changed)."""
+        with self._cond:
+            self._pending.extend(self._infeasible)
+            self._infeasible.clear()
+            self._cond.notify_all()
+
+    def _grant_or_requeue(
+        self, spec: TaskSpec, node_id: str, via_pg: Optional[tuple] = None
+    ) -> None:
+        node = self.nodes.get(node_id)
+        req = ResourceRequest.from_map(self.vocab, spec.resources)
+        if node is None or not node.alive:
+            self._enqueue(spec)
+            return
+        if via_pg is not None:
+            pg_id, bundle_idx = via_pg
+            pg = self._pgs.get(pg_id)
+            if pg is None or not pg.try_allocate(bundle_idx, req):
+                self._park_infeasible(spec)
+                return
+        elif not node.ledger.try_allocate(req):
+            # Stale dense view → grant rejected → spill back to the queue
+            # (grant-or-reject, local_lease_manager.h:39-61).
+            self.metrics["leases_spilled_back"] += 1
+            self.view.update_available(node_id, node.ledger.avail_map())
+            self._enqueue(spec)
+            return
+        if via_pg is None:
+            self.view.update_available(node_id, node.ledger.avail_map())
+        node.running_tasks[spec.task_id] = spec
+        node.pool.submit(self._execute, spec, node, req, via_pg)
+
+    # ------------------------------------------------------------------
+    # execution (TaskReceiver analog)
+    # ------------------------------------------------------------------
+    def _execute(
+        self, spec: TaskSpec, node: Node, req: ResourceRequest, via_pg: Optional[tuple]
+    ) -> None:
+        _context.node_id = node.node_id
+        _context.task_id = spec.task_id
+        _context.actor_id = spec.actor_id
+        actor_holds_resources = False
+        try:
+            args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+            result = spec.func(*args, **kwargs)
+            if spec.kind == "actor_creation":
+                state = self._actors[spec.actor_id]
+                state.on_created(node.node_id, result, (node.node_id, req))
+                actor_holds_resources = via_pg is None
+                self._seal_results(spec, node, spec.actor_id)
+            else:
+                self._seal_results(spec, node, result)
+            self.metrics["tasks_finished"] += 1
+        except BaseException as exc:  # noqa: BLE001 - task errors are values
+            if spec.retry_exceptions and spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                self._enqueue(spec)
+            else:
+                self.metrics["tasks_failed"] += 1
+                err = TaskError(exc, spec.name or spec.task_id)
+                err.__cause__ = exc
+                for ref in spec.returns:
+                    self.store.seal(ref, err, is_error=True)
+                if spec.kind == "actor_creation":
+                    state = self._actors.get(spec.actor_id)
+                    if state is not None:
+                        state.mark_died(restart=False)
+                logger.debug(
+                    "task %s failed:\n%s", spec.name, traceback.format_exc()
+                )
+        finally:
+            node.running_tasks.pop(spec.task_id, None)
+            if not node.alive or actor_holds_resources:
+                pass  # dropped with the node / held for the actor lifetime
+            elif via_pg is not None:
+                pg_id, bundle_idx = via_pg
+                pg = self._pgs.get(pg_id)
+                if pg is not None:
+                    pg.release(bundle_idx, req)
+                self.notify_resources_changed()
+            else:
+                node.ledger.release(req)
+                with self._cond:
+                    self.view.update_available(node.node_id, node.ledger.avail_map())
+                    # freed capacity may unblock queued/infeasible leases
+                    self._dirty = True
+                    self._pending.extend(self._infeasible)
+                    self._infeasible.clear()
+                    self._cond.notify_all()
+            _context.node_id = None
+            _context.task_id = None
+            _context.actor_id = None
+
+    # ------------------------------------------------------------------
+    # actor creation (GcsActorScheduler analog)
+    # ------------------------------------------------------------------
+    def _submit_actor_creation(self, state, strategy=None) -> None:
+        ready = ObjectRef.new(owner="actor")
+        self.store.create(ready)
+        spec = TaskSpec(
+            task_id=uuid.uuid4().hex[:16],
+            func=state.cls,
+            args=state.ctor_args,
+            kwargs=state.ctor_kwargs,
+            returns=[ready],
+            resources=state.resources,
+            name=f"{state.cls.__name__}.__init__",
+            kind="actor_creation",
+            actor_id=state.actor_id,
+            strategy=strategy,
+            max_retries=0,
+        )
+        state.creation_ref = ready
+        state.creation_strategy = strategy
+        self.submit(spec)
+
+    def _resubmit_actor_creation(self, state) -> None:
+        self._submit_actor_creation(state, getattr(state, "creation_strategy", None))
+
+    def _resolve_args(self, args: tuple, kwargs: dict) -> Tuple[tuple, dict]:
+        """Inline ObjectRef arguments (DependencyResolver analog)."""
+        res_args = tuple(
+            self.get_object(a) if isinstance(a, ObjectRef) else a for a in args
+        )
+        res_kwargs = {
+            k: self.get_object(v) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return res_args, res_kwargs
+
+    def _seal_results(self, spec: TaskSpec, node: Node, result: Any) -> None:
+        refs = spec.returns
+        if len(refs) == 1:
+            values: Sequence[Any] = [result]
+        else:
+            values = tuple(result)
+            if len(values) != len(refs):
+                raise ValueError(
+                    f"task {spec.name} returned {len(values)} values, "
+                    f"expected {len(refs)}"
+                )
+        for ref, value in zip(refs, values):
+            node.objects.add(ref.hex)
+            self.store.seal(ref, value)
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+    def put_object(self, value: Any) -> ObjectRef:
+        ref = ObjectRef.new(owner=_context.task_id or "driver")
+        self.store.create(ref)
+        self.store.seal(ref, value)
+        node_id = _context.node_id
+        if node_id and node_id in self.nodes:
+            self.nodes[node_id].objects.add(ref.hex)
+        return ref
+
+    def get_object(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        # Lost objects were either resubmitted by _invalidate_object (lineage
+        # reconstruction, object_recovery_manager.h:41) — in which case this
+        # blocks until the re-execution seals — or sealed with ObjectLostError.
+        return self.store.get(ref, timeout)
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        for actor in list(self._actors.values()):
+            actor.stop()
+        for node in self.nodes.values():
+            node.pool.shutdown(wait=False, cancel_futures=True)
+        self._sched_thread.join(timeout=2)
+
+    # introspection (ray.nodes / state API analog)
+    def nodes_info(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "NodeID": n.node_id,
+                "Alive": n.alive,
+                "Resources": n.ledger.total_map(),
+                "Available": n.ledger.avail_map(),
+                "Labels": dict(n.labels),
+            }
+            for n in self.nodes.values()
+        ]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.ledger.total_map().items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.ledger.avail_map().items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+
+_HYBRID = object()
+_FAIL = object()
+
+_runtime: Optional[Runtime] = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime() -> Runtime:
+    if _runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _runtime
+
+
+def set_runtime(rt: Optional[Runtime]) -> None:
+    global _runtime
+    with _runtime_lock:
+        _runtime = rt
+
+
+def runtime_initialized() -> bool:
+    return _runtime is not None
+
+
+# ActorState lives in actor.py; imported late to avoid a cycle.
+from .actor import ActorState  # noqa: E402,F401  (re-export for runtime users)

@@ -1,0 +1,36 @@
+"""TPU-batched cluster scheduler: the north-star subsystem.
+
+The reference schedules one lease at a time with hash-map scans
+(/root/reference/src/ray/raylet/scheduling/). Here, cluster state is dense
+device arrays and every policy is a compiled, batched XLA program:
+
+- resources.py — vocabulary interning, exact fixed-point ledger (authoritative
+  grants), dense ClusterView (approximate scoring view).
+- hybrid.py    — batched HybridSchedulingPolicy (fidelity + throughput modes).
+- bundles.py   — placement-group PACK/SPREAD/STRICT_* bin-packing kernels.
+- binpack.py   — autoscaler first-fit residual + node-type utilization scorer.
+"""
+from .resources import (  # noqa: F401
+    CPU,
+    GPU,
+    MEMORY,
+    OBJECT_STORE_MEMORY,
+    TPU,
+    ClusterView,
+    NodeResourceLedger,
+    ResourceRequest,
+    ResourceVocab,
+)
+from .hybrid import (  # noqa: F401
+    HybridConfig,
+    hybrid_schedule_batch,
+    hybrid_schedule_reference,
+    hybrid_schedule_rounds,
+)
+from .bundles import schedule_bundles, sort_bundles  # noqa: F401
+from .binpack import (  # noqa: F401
+    bin_pack_residual,
+    pick_best_node_type,
+    sort_demands,
+    utilization_scores,
+)

@@ -1,0 +1,137 @@
+"""Autoscaler bin-packing kernels.
+
+TPU-batched re-design of the reference autoscaler's demand math
+(/root/reference/python/ray/autoscaler/_private/resource_demand_scheduler.py):
+
+- ``bin_pack_residual`` — first-fit packing of pending demands onto node
+  resource rows (get_bin_pack_residual, :879-938). The reference walks python
+  dicts per demand; here it is one ``lax.scan`` over a dense demand matrix.
+- ``utilization_scores`` — the node-type scorer used by get_nodes_for
+  (:809-864): simulates filling one node of each type with the demand list
+  and returns the 4-component lexicographic key (gpu_ok,
+  num_matching_resource_types, min(v·u³), mean(v·u³)) — vmapped over *all*
+  node types at once.
+
+Demands must be pre-sorted complex→heavy (``sort_demands``), matching the
+reference's `sorted(..., key=(len, sum, items), reverse=True)`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .resources import GPU, TPU
+
+_EPS = 1e-5
+
+
+def sort_demands(demands: np.ndarray) -> np.ndarray:
+    """Indices ordering demands complex-first, then heavy-first (host-side)."""
+    complexity = (demands > 0).sum(axis=1)
+    weight = demands.sum(axis=1)
+    # reverse=True on (len, sum); stable original order as final tie-break.
+    return np.lexsort((np.arange(len(demands)), -weight, -complexity))
+
+
+class BinPackResult(NamedTuple):
+    node: jax.Array       # int32[B] node row per demand, -1 = unfulfilled
+    avail_out: jax.Array  # f32[N,R] residual node resources
+
+
+@functools.partial(jax.jit, static_argnames=("strict_spread",))
+def bin_pack_residual(
+    nodes_avail: jax.Array,  # f32[N,R]
+    demands: jax.Array,      # f32[B,R], pre-sorted complex→heavy
+    *,
+    strict_spread: bool = False,
+) -> BinPackResult:
+    """First-fit packing; the kernel behind autoscaler demand satisfaction."""
+    n = nodes_avail.shape[0]
+
+    def step(state, d):
+        avail, used = state
+        fits = jnp.all(avail >= d[None, :] - _EPS, axis=1) & ~used
+        any_fit = jnp.any(fits)
+        chosen = jnp.argmax(fits)  # first fitting node (reference iterates in order)
+        avail = jnp.where(any_fit, avail.at[chosen].add(-d), avail)
+        if strict_spread:
+            used = used.at[chosen].set(jnp.where(any_fit, True, used[chosen]))
+        node = jnp.where(any_fit, chosen.astype(jnp.int32), -1)
+        return (avail, used), node
+
+    (avail_out, _), nodes = jax.lax.scan(
+        step, (nodes_avail, jnp.zeros((n,), dtype=bool)), demands
+    )
+    return BinPackResult(nodes, avail_out)
+
+
+class TypeScore(NamedTuple):
+    feasible: jax.Array   # bool[T] — at least one demand fits this type
+    gpu_ok: jax.Array     # bool[T]
+    num_matching: jax.Array  # int32[T]
+    min_util: jax.Array   # f32[T]
+    mean_util: jax.Array  # f32[T]
+
+
+@functools.partial(jax.jit, static_argnames=("conserve_accel_nodes",))
+def utilization_scores(
+    node_types: jax.Array,  # f32[T,R] resources of one node of each type
+    demands: jax.Array,     # f32[B,R] pre-sorted
+    *,
+    conserve_accel_nodes: bool = True,
+) -> TypeScore:
+    """_resource_based_utilization_scorer semantics, vmapped over types."""
+    resource_types_mask = jnp.any(demands > 0, axis=0)  # bool[R]
+    any_accel_task = jnp.any(demands[:, (GPU, TPU),] > 0)
+
+    def score_one(node: jax.Array):
+        def fill(remaining, d):
+            fits = jnp.all(remaining >= d - _EPS)
+            remaining = jnp.where(fits, remaining - d, remaining)
+            return remaining, fits
+
+        remaining, fit_flags = jax.lax.scan(fill, node, demands)
+        feasible = jnp.any(fit_flags)
+        valid = node >= 1.0  # reference skips v < 1 (resources are ~integers)
+        util = jnp.where(valid, (node - remaining) / jnp.where(valid, node, 1.0), 0.0)
+        ubr = node * util**3  # v · u³ per resource
+        big = jnp.float32(jnp.inf)
+        min_util = jnp.min(jnp.where(valid, ubr, big))
+        cnt = jnp.sum(valid.astype(jnp.float32))
+        mean_util = jnp.sum(jnp.where(valid, ubr, 0.0)) / jnp.maximum(cnt, 1.0)
+        num_matching = jnp.sum((valid & resource_types_mask).astype(jnp.int32))
+        is_accel_node = jnp.any(node[(GPU, TPU),] > 0)
+        if conserve_accel_nodes:
+            gpu_ok = ~(is_accel_node & ~any_accel_task)
+        else:
+            gpu_ok = jnp.bool_(True)
+        feasible = feasible & (cnt > 0)
+        return feasible, gpu_ok, num_matching, min_util, mean_util
+
+    f, g, m, mn, me = jax.vmap(score_one)(node_types)
+    return TypeScore(f, g, m, mn, me)
+
+
+def pick_best_node_type(scores: TypeScore) -> int:
+    """Lexicographic argmax over (gpu_ok, num_matching, min_util, mean_util);
+    -1 if no type is feasible. Host-side: T is small."""
+    f = np.asarray(scores.feasible)
+    if not f.any():
+        return -1
+    key = np.stack(
+        [
+            np.asarray(scores.gpu_ok, dtype=np.float64),
+            np.asarray(scores.num_matching, dtype=np.float64),
+            np.asarray(scores.min_util, dtype=np.float64),
+            np.asarray(scores.mean_util, dtype=np.float64),
+        ],
+        axis=1,
+    )
+    key[~f] = -np.inf
+    # np.lexsort sorts ascending by last key primary; we want max.
+    order = np.lexsort((key[:, 3], key[:, 2], key[:, 1], key[:, 0]))
+    return int(order[-1])

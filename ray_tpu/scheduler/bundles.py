@@ -1,0 +1,211 @@
+"""Placement-group bundle bin-packing as JAX kernels.
+
+Reimplements the semantics of the reference's bundle scheduling policies
+(/root/reference/src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc:156-383
+and scorer.cc:20-46) as compiled XLA programs over dense
+``[nodes, resources]`` / ``[bundles, resources]`` arrays:
+
+- PACK     — best node for the highest-priority unplaced bundle, then fill
+             that node with every remaining bundle that fits, retire the node,
+             repeat (bundle_scheduling_policy.cc:156-235).
+- SPREAD   — each bundle prefers a not-yet-used candidate node, falling back
+             to already-selected nodes (:238-301).
+- STRICT_PACK — aggregate all bundles into one request, one best node (:304).
+- STRICT_SPREAD — every bundle on a distinct node.
+
+Scoring is LeastResourceScorer (scorer.cc:20-46): over the *requested*
+resources, sum of (available - requested) / available (0 when available is
+0), -1 when the node can't host the bundle; higher is better; ties go to the
+lowest node row (the reference iterates an unordered hash map — we pin the
+deterministic choice, which is what its unit tests do too).
+
+Bundle priority order (SortRequiredResources, :61-129): GPU desc, then each
+custom resource column desc, then object-store-memory, memory, CPU desc.
+Sorting happens host-side (`sort_bundles`) — bundle lists are small; the
+packing itself is the device program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .resources import CPU, GPU, MEMORY, NUM_PREDEFINED, OBJECT_STORE_MEMORY
+
+_EPS = 1e-5
+
+
+def sort_bundles(bundles: np.ndarray) -> np.ndarray:
+    """Return bundle indices in scheduling priority order (host-side)."""
+    b, r = bundles.shape
+    # np.lexsort: last key is primary. Priority: GPU, customs (in column
+    # order), OBJ, MEM, CPU — all descending; final tie-break: original index.
+    keys = [np.arange(b)]  # least significant: stable original order
+    for col in (CPU, MEMORY, OBJECT_STORE_MEMORY):
+        keys.append(-bundles[:, col])
+    for col in range(r - 1, NUM_PREDEFINED - 1, -1):
+        keys.append(-bundles[:, col])
+    keys.append(-bundles[:, GPU])
+    return np.lexsort(tuple(keys))
+
+
+def _least_resource_score(avail_rows: jax.Array, demand: jax.Array) -> jax.Array:
+    """LeastResourceScorer over all node rows: f32[N], -1 = can't host."""
+    requested = demand > 0
+    ok = jnp.all(avail_rows >= demand[None, :] - _EPS, axis=1)
+    safe = jnp.where(avail_rows > 0, avail_rows, 1.0)
+    terms = jnp.where(
+        requested[None, :] & (avail_rows > 0),
+        (avail_rows - demand[None, :]) / safe,
+        0.0,
+    )
+    score = jnp.sum(terms, axis=1)
+    return jnp.where(ok, score, -1.0)
+
+
+class PackResult(NamedTuple):
+    node: jax.Array      # int32[B] node row per bundle (sorted order), -1 on fail
+    success: jax.Array   # bool scalar — all bundles placed
+    avail_out: jax.Array  # f32[N,R] availability after placement (valid iff success)
+
+
+@jax.jit
+def pack_bundles(
+    totals: jax.Array,
+    avail: jax.Array,
+    alive: jax.Array,
+    bundles: jax.Array,  # f32[B,R] already in priority order
+) -> PackResult:
+    """PACK strategy. ``bundles`` must already be priority-sorted."""
+    n = totals.shape[0]
+    b = bundles.shape[0]
+
+    def outer(i, state):
+        placed, cand, avail_run, failed = state
+        unplaced = placed < 0
+        any_un = jnp.any(unplaced)
+        j = jnp.argmax(unplaced)  # first unplaced (priority order)
+        d = bundles[j]
+        score = _least_resource_score(avail_run, d)
+        score = jnp.where(cand & alive, score, -jnp.inf)
+        best = jnp.argmax(score)  # first max → lowest row on ties
+        ok = (score[best] >= 0) & any_un & ~failed
+
+        # Fill `best` with every unplaced bundle that fits, in priority order.
+        def fill(carry, idx):
+            node_avail, placed = carry
+            d2 = bundles[idx]
+            can = (
+                ok
+                & (placed[idx] < 0)
+                & jnp.all(node_avail >= d2 - _EPS)
+            )
+            node_avail = jnp.where(can, node_avail - d2, node_avail)
+            placed = placed.at[idx].set(
+                jnp.where(can, best.astype(jnp.int32), placed[idx])
+            )
+            return (node_avail, placed), None
+
+        (node_avail, placed), _ = jax.lax.scan(
+            fill, (avail_run[best], placed), jnp.arange(b)
+        )
+        avail_run = jnp.where(ok, avail_run.at[best].set(node_avail), avail_run)
+        cand = cand.at[best].set(jnp.where(ok, False, cand[best]))
+        failed = failed | (any_un & (score[best] < 0))
+        return placed, cand, avail_run, failed
+
+    placed0 = jnp.full((b,), -1, dtype=jnp.int32)
+    placed, _, avail_out, failed = jax.lax.fori_loop(
+        0, min(b, n), outer, (placed0, alive, avail, jnp.bool_(False))
+    )
+    success = jnp.all(placed >= 0) & ~failed
+    return PackResult(placed, success, avail_out)
+
+
+@functools.partial(jax.jit, static_argnames=("strict",))
+def spread_bundles(
+    totals: jax.Array,
+    avail: jax.Array,
+    alive: jax.Array,
+    bundles: jax.Array,  # f32[B,R] priority-sorted
+    *,
+    strict: bool = False,
+) -> PackResult:
+    """SPREAD / STRICT_SPREAD strategies."""
+
+    def step(state, d):
+        fresh, avail_run = state  # fresh: bool[N] not-yet-selected candidates
+        score = _least_resource_score(avail_run, d)
+        s1 = jnp.where(fresh & alive, score, -jnp.inf)
+        best1 = jnp.argmax(s1)
+        ok1 = s1[best1] >= 0
+        if strict:
+            best, ok = best1, ok1
+        else:
+            s2 = jnp.where(~fresh & alive, score, -jnp.inf)
+            best2 = jnp.argmax(s2)
+            ok2 = s2[best2] >= 0
+            best = jnp.where(ok1, best1, best2)
+            ok = ok1 | ok2
+        avail_run = jnp.where(ok, avail_run.at[best].add(-d), avail_run)
+        fresh = fresh.at[best].set(jnp.where(ok, False, fresh[best]))
+        node = jnp.where(ok, best.astype(jnp.int32), -1)
+        return (fresh, avail_run), node
+
+    (_, avail_out), nodes = jax.lax.scan(step, (alive, avail), bundles)
+    success = jnp.all(nodes >= 0)
+    return PackResult(nodes, success, avail_out)
+
+
+@jax.jit
+def strict_pack_bundles(
+    totals: jax.Array,
+    avail: jax.Array,
+    alive: jax.Array,
+    bundles: jax.Array,
+) -> PackResult:
+    """STRICT_PACK: all bundles on one node (aggregate demand)."""
+    agg = jnp.sum(bundles, axis=0)
+    score = _least_resource_score(avail, agg)
+    score = jnp.where(alive, score, -jnp.inf)
+    best = jnp.argmax(score)
+    ok = score[best] >= 0
+    b = bundles.shape[0]
+    nodes = jnp.where(ok, jnp.full((b,), best, dtype=jnp.int32), -1)
+    avail_out = jnp.where(ok, avail.at[best].add(-agg), avail)
+    return PackResult(nodes, ok, avail_out)
+
+
+def schedule_bundles(
+    totals,
+    avail,
+    alive,
+    bundles: np.ndarray,
+    strategy: str = "PACK",
+):
+    """Host entry point: sort, dispatch to the strategy kernel, unsort.
+
+    Returns (node_per_bundle int32[B] in *original* bundle order, success,
+    avail_out). Mirrors ClusterResourceScheduler::Schedule
+    (cluster_resource_scheduler.cc:397) + SortSchedulingResult.
+    """
+    bundles = np.asarray(bundles, dtype=np.float32)
+    order = sort_bundles(bundles)
+    sorted_bundles = jnp.asarray(bundles[order])
+    if strategy == "PACK":
+        res = pack_bundles(totals, avail, alive, sorted_bundles)
+    elif strategy == "SPREAD":
+        res = spread_bundles(totals, avail, alive, sorted_bundles, strict=False)
+    elif strategy == "STRICT_SPREAD":
+        res = spread_bundles(totals, avail, alive, sorted_bundles, strict=True)
+    elif strategy == "STRICT_PACK":
+        res = strict_pack_bundles(totals, avail, alive, sorted_bundles)
+    else:
+        raise ValueError(f"unknown placement strategy: {strategy}")
+    nodes_sorted = np.asarray(res.node)
+    nodes = np.full_like(nodes_sorted, -1)
+    nodes[order] = nodes_sorted
+    return nodes, bool(res.success), res.avail_out

@@ -1,0 +1,293 @@
+"""Resource model: string-interned vocabulary + dense tensor packing.
+
+TPU-first redesign of the reference's resource model
+(/root/reference/src/ray/common/scheduling/cluster_resource_data.h:39,308 and
+scheduling_ids.h:45). Instead of per-node hash maps of FixedPoint scalars, the
+cluster view is a pair of dense ``float32 [num_nodes, num_resources]`` arrays
+(totals / available) so that every scheduling decision can be a batched XLA
+program. String resource names are interned to dense column ids at the edge
+only (like StringIdMap), and the *authoritative* bookkeeping on grant/return
+is exact int64 fixed-point (1e-4 quantum, mirroring fixed_point.h:26) host-side;
+the device arrays are the approximate scoring view (eventually-consistent, the
+same trust model the reference assigns to ClusterResourceManager).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Fixed-point quantum: 1/10000, like the reference FixedPoint
+# (/root/reference/src/ray/common/scheduling/fixed_point.h:26).
+FP_SCALE = 10_000
+
+# Predefined resource columns. The reference's predefined set is
+# CPU/MEM/GPU/OBJECT_STORE_MEM (cluster_resource_data.h); we add TPU as a
+# first-class accelerator column.
+CPU = 0
+MEMORY = 1
+OBJECT_STORE_MEMORY = 2
+GPU = 3
+TPU = 4
+NUM_PREDEFINED = 5
+
+PREDEFINED_NAMES = ("CPU", "memory", "object_store_memory", "GPU", "TPU")
+
+# Columns used by CalculateCriticalResourceUtilization
+# (cluster_resource_data.cc:62-77): CPU, MEM, OBJECT_STORE_MEM.
+CRITICAL_COLUMNS = (CPU, MEMORY, OBJECT_STORE_MEMORY)
+
+
+def to_fp(value: float) -> int:
+    """Quantize a python float to exact int64 fixed point (round-to-nearest)."""
+    return int(round(float(value) * FP_SCALE))
+
+
+def from_fp(value: int) -> float:
+    return value / FP_SCALE
+
+
+class ResourceVocab:
+    """Interns resource names to dense column indices.
+
+    Thread-safe, append-only. Column layout: predefined columns first, then
+    custom resources in interning order. ``capacity`` fixes the dense width so
+    jitted kernels see a static resource axis; growing past capacity doubles
+    it (a recompile boundary, expected to be rare — the reference similarly
+    treats the resource universe as small and slowly-growing).
+    """
+
+    def __init__(self, capacity: int = 16):
+        assert capacity >= NUM_PREDEFINED
+        self._lock = threading.Lock()
+        self._name_to_col: Dict[str, int] = {
+            name: i for i, name in enumerate(PREDEFINED_NAMES)
+        }
+        self._names: List[str] = list(PREDEFINED_NAMES)
+        self.capacity = capacity
+
+    def intern(self, name: str) -> int:
+        with self._lock:
+            col = self._name_to_col.get(name)
+            if col is None:
+                col = len(self._names)
+                self._names.append(name)
+                self._name_to_col[name] = col
+                while col >= self.capacity:
+                    self.capacity *= 2
+            return col
+
+    def get(self, name: str) -> Optional[int]:
+        return self._name_to_col.get(name)
+
+    def name(self, col: int) -> str:
+        return self._names[col]
+
+    @property
+    def num_resources(self) -> int:
+        return len(self._names)
+
+    def pack(self, resource_map: Mapping[str, float]) -> np.ndarray:
+        """Pack a {name: quantity} map into a dense float32 row [capacity]."""
+        row = np.zeros(self.capacity, dtype=np.float32)
+        for name, qty in resource_map.items():
+            row[self.intern(name)] = float(qty)
+        return row
+
+    def pack_fp(self, resource_map: Mapping[str, float]) -> Dict[int, int]:
+        """Exact fixed-point form: {column: int64 quantity}, zeros dropped."""
+        out: Dict[int, int] = {}
+        for name, qty in resource_map.items():
+            v = to_fp(qty)
+            if v != 0:
+                out[self.intern(name)] = v
+        return out
+
+    def unpack(self, row: np.ndarray) -> Dict[str, float]:
+        return {
+            self._names[i]: float(row[i])
+            for i in range(min(len(self._names), len(row)))
+            if row[i] != 0
+        }
+
+
+@dataclass
+class ResourceRequest:
+    """A task/bundle resource demand (reference: ResourceRequest,
+    cluster_resource_data.h:39). Exact fixed-point host form."""
+
+    demands: Dict[int, int] = field(default_factory=dict)  # col -> fp qty
+
+    @classmethod
+    def from_map(cls, vocab: ResourceVocab, m: Mapping[str, float]) -> "ResourceRequest":
+        return cls(vocab.pack_fp(m))
+
+    def is_empty(self) -> bool:
+        return not self.demands
+
+    def dense(self, width: int) -> np.ndarray:
+        row = np.zeros(width, dtype=np.float32)
+        for col, fp in self.demands.items():
+            row[col] = from_fp(fp)
+        return row
+
+    def has(self, col: int) -> bool:
+        return self.demands.get(col, 0) > 0
+
+
+class NodeResourceLedger:
+    """Authoritative per-node resource accounting in exact fixed point.
+
+    This is the grant-time admission check — the analog of the reference's
+    LocalResourceManager (local_resource_manager.h:58): the dense device view
+    may be stale, but a grant only succeeds if this ledger says so
+    (grant-or-reject under eventually-consistent views,
+    local_lease_manager.h:39-61).
+    """
+
+    def __init__(self, vocab: ResourceVocab, total: Mapping[str, float]):
+        self.vocab = vocab
+        self._lock = threading.Lock()
+        self.total_fp: Dict[int, int] = vocab.pack_fp(total)
+        self.avail_fp: Dict[int, int] = dict(self.total_fp)
+
+    def is_feasible(self, req: ResourceRequest) -> bool:
+        with self._lock:
+            return all(self.total_fp.get(c, 0) >= q for c, q in req.demands.items())
+
+    def is_available(self, req: ResourceRequest) -> bool:
+        with self._lock:
+            return all(self.avail_fp.get(c, 0) >= q for c, q in req.demands.items())
+
+    def try_allocate(self, req: ResourceRequest) -> bool:
+        with self._lock:
+            if any(
+                self.avail_fp.get(c, 0) < q for c, q in req.demands.items()
+            ):
+                return False
+            for c, q in req.demands.items():
+                self.avail_fp[c] = self.avail_fp.get(c, 0) - q
+            return True
+
+    def release(self, req: ResourceRequest) -> None:
+        with self._lock:
+            for c, q in req.demands.items():
+                self.avail_fp[c] = self.avail_fp.get(c, 0) + q
+                # Floating credit is a bug; exact arithmetic makes this checkable.
+                assert self.avail_fp[c] <= self.total_fp.get(c, 0) + 0, (
+                    f"over-release of resource {self.vocab.name(c)}"
+                )
+
+    def add_capacity(self, extra: Mapping[str, float]) -> None:
+        with self._lock:
+            for c, q in self.vocab.pack_fp(extra).items():
+                self.total_fp[c] = self.total_fp.get(c, 0) + q
+                self.avail_fp[c] = self.avail_fp.get(c, 0) + q
+
+    def total_map(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                self.vocab.name(c): from_fp(q) for c, q in self.total_fp.items() if q
+            }
+
+    def avail_map(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                self.vocab.name(c): from_fp(q) for c, q in self.avail_fp.items() if q
+            }
+
+
+class ClusterView:
+    """Dense cluster resource view: the scheduler dataplane.
+
+    The analog of ClusterResourceManager (cluster_resource_manager.h) — every
+    node's totals/availables as rows of dense arrays, fed by the resource
+    gossip (§ray_syncer). Kernels consume ``totals``/``avail`` as float32
+    device arrays; this class owns the host mirrors and the node-id interning.
+    """
+
+    def __init__(self, vocab: ResourceVocab, capacity_nodes: int = 8):
+        self.vocab = vocab
+        self.capacity_nodes = capacity_nodes
+        self._node_ids: List[str] = []
+        self._id_to_row: Dict[str, int] = {}
+        self.totals = np.zeros((capacity_nodes, vocab.capacity), dtype=np.float32)
+        self.avail = np.zeros((capacity_nodes, vocab.capacity), dtype=np.float32)
+        self.alive = np.zeros(capacity_nodes, dtype=bool)
+        self.labels: List[Dict[str, str]] = [dict() for _ in range(capacity_nodes)]
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._node_ids)
+
+    def _grow(self, min_nodes: int, min_res: int) -> None:
+        n_cap, r_cap = self.totals.shape
+        new_n = n_cap
+        while new_n < min_nodes:
+            new_n *= 2
+        new_r = r_cap
+        while new_r < min_res:
+            new_r *= 2
+        if (new_n, new_r) != (n_cap, r_cap):
+            for attr in ("totals", "avail"):
+                old = getattr(self, attr)
+                new = np.zeros((new_n, new_r), dtype=np.float32)
+                new[:n_cap, :r_cap] = old
+                setattr(self, attr, new)
+            self.alive = np.resize(self.alive, new_n)
+            self.alive[n_cap:] = False
+            self.labels.extend(dict() for _ in range(new_n - n_cap))
+            self.capacity_nodes = new_n
+
+    def add_node(
+        self,
+        node_id: str,
+        total: Mapping[str, float],
+        labels: Optional[Mapping[str, str]] = None,
+    ) -> int:
+        row_total = self.vocab.pack(total)
+        self._grow(len(self._node_ids) + 1, self.vocab.capacity)
+        if row_total.shape[0] < self.totals.shape[1]:
+            row_total = np.resize(row_total, self.totals.shape[1])
+        row = self._id_to_row.get(node_id)
+        if row is None:
+            row = len(self._node_ids)
+            self._node_ids.append(node_id)
+            self._id_to_row[node_id] = row
+        self.totals[row, : len(row_total)] = row_total
+        self.avail[row, : len(row_total)] = row_total
+        self.alive[row] = True
+        self.labels[row] = dict(labels or {})
+        return row
+
+    def remove_node(self, node_id: str) -> None:
+        row = self._id_to_row.get(node_id)
+        if row is not None:
+            self.alive[row] = False
+            self.totals[row] = 0
+            self.avail[row] = 0
+
+    def row_of(self, node_id: str) -> int:
+        return self._id_to_row[node_id]
+
+    def node_id(self, row: int) -> str:
+        return self._node_ids[row]
+
+    def update_available(self, node_id: str, avail: Mapping[str, float]) -> None:
+        """Apply a gossip snapshot (RaySyncer RESOURCE_VIEW analog)."""
+        row = self._id_to_row[node_id]
+        packed = self.vocab.pack(avail)
+        self.avail[row, : len(packed)] = packed
+
+    def subtract(self, row: int, demand: np.ndarray) -> None:
+        self.avail[row, : len(demand)] -= demand
+
+    def add(self, row: int, demand: np.ndarray) -> None:
+        self.avail[row, : len(demand)] += demand
+
+    def active_arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(totals, avail, alive) trimmed to the populated node rows."""
+        n = self.num_nodes
+        return self.totals[:n], self.avail[:n], self.alive[:n]

@@ -1,0 +1,365 @@
+"""Core runtime API tests: tasks, actors, objects, placement groups —
+the shape of the reference's python/ray/tests/test_basic.py suite."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture()
+def rt():
+    rt = ray_tpu.init(
+        num_nodes=3,
+        resources_per_node={"CPU": 4, "memory": float(1 << 30)},
+        ignore_reinit_error=False,
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_put_get(rt):
+    ref = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(ref) == {"a": 1}
+
+
+def test_task_roundtrip(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(2, 3)) == 5
+
+
+def test_task_with_object_ref_args(rt):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_many_tasks_parallel(rt):
+    @ray_tpu.remote
+    def f(i):
+        return i * i
+
+    refs = [f.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_multiple_returns(rt):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get(a) == 1 and ray_tpu.get(b) == 2
+
+
+def test_task_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(ray_tpu.core.object_store.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.01), slow.remote(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=2)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert ray_tpu.get(ready[0]) == 0.01
+
+
+def test_resources_respected(rt):
+    # 3 nodes x 4 CPUs; 4-CPU tasks must land on distinct nodes.
+    @ray_tpu.remote(num_cpus=4)
+    def whereami():
+        from ray_tpu.core.runtime import get_context
+
+        time.sleep(0.2)
+        return get_context().node_id
+
+    nodes = ray_tpu.get([whereami.remote() for _ in range(3)])
+    assert len(set(nodes)) == 3
+
+
+def test_infeasible_task_waits_then_runs_after_node_add(rt):
+    @ray_tpu.remote(num_cpus=64)
+    def big():
+        return "ok"
+
+    ref = big.remote()
+    ready, _ = ray_tpu.wait([ref], timeout=0.3)
+    assert not ready  # infeasible: parked
+    rt.add_node({"CPU": 64, "memory": float(1 << 30)})
+    assert ray_tpu.get(ref, timeout=10) == "ok"
+
+
+def test_actor_basic(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, by=1):
+            self.v += by
+            return self.v
+
+        def value(self):
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_methods_ordered(rt):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_named_actor(rt):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc").remote()
+    h = ray_tpu.core.api.get_actor("svc")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+
+
+def test_kill_actor(rt):
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.f.remote()) == 1
+    ray_tpu.kill(a)
+    with pytest.raises(Exception):
+        ray_tpu.get(a.f.remote(), timeout=5)
+
+
+def test_actor_restart_on_node_death(rt):
+    @ray_tpu.remote(max_restarts=1, num_cpus=1)
+    class Stateful:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            from ray_tpu.core.runtime import get_context
+
+            return get_context().node_id
+
+    s = Stateful.remote()
+    assert ray_tpu.get(s.bump.remote()) == 1
+    node = ray_tpu.get(s.where.remote())
+    rt.kill_node(node)
+    # restarted elsewhere, state reset (reference restart semantics)
+    assert ray_tpu.get(s.bump.remote(), timeout=10) == 1
+    assert ray_tpu.get(s.where.remote()) != node
+
+
+def test_node_affinity_strategy(rt):
+    target = ray_tpu.nodes()[1]["NodeID"]
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(target))
+    def whereami():
+        from ray_tpu.core.runtime import get_context
+
+        return get_context().node_id
+
+    assert ray_tpu.get(whereami.remote()) == target
+
+
+def test_placement_group_pack_and_task(rt):
+    pg = ray_tpu.placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+    table = ray_tpu.placement_group_table()[pg.id]
+    assert table["state"] == "CREATED"
+    b0 = table["bundles"][0]["node_id"]
+    b1 = table["bundles"][1]["node_id"]
+    assert b0 == b1  # PACK on a fresh cluster → same node
+
+    @ray_tpu.remote(
+        num_cpus=2,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    )
+    def inside():
+        from ray_tpu.core.runtime import get_context
+
+        return get_context().node_id
+
+    assert ray_tpu.get(inside.remote(), timeout=10) == b0
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_strict_spread(rt):
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}] * 3, strategy="STRICT_SPREAD"
+    )
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+    t = ray_tpu.placement_group_table()[pg.id]
+    hosts = {b["node_id"] for b in t["bundles"].values()}
+    assert len(hosts) == 3
+
+
+def test_pg_infeasible_until_node_added(rt):
+    pg = ray_tpu.placement_group([{"CPU": 32}], strategy="PACK")
+    assert not pg.wait(timeout_seconds=0.3)
+    rt.add_node({"CPU": 32, "memory": float(1 << 30)})
+    assert pg.wait(timeout_seconds=10)
+
+
+def test_cluster_and_available_resources(rt):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 12.0
+
+    @ray_tpu.remote(num_cpus=4)
+    def hold():
+        time.sleep(0.5)
+        return 1
+
+    ref = hold.remote()
+    time.sleep(0.2)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= 8.0
+    ray_tpu.get(ref)
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(1)) == 20
+
+
+def test_lineage_reconstruction_on_node_death(rt):
+    calls = []
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        from ray_tpu.core.runtime import get_context
+
+        calls.append(1)
+        return ("data", get_context().node_id)
+
+    ref = produce.remote()
+    _, node = ray_tpu.get(ref)
+    rt.kill_node(node)
+    data, node2 = ray_tpu.get(ref, timeout=10)  # rebuilt via lineage
+    assert data == "data"
+    assert len(calls) == 2
+
+
+def test_wait_num_returns_validation(rt):
+    ref = ray_tpu.put(1)
+    with pytest.raises(ValueError):
+        ray_tpu.wait([ref], num_returns=2)
+
+
+def test_cancel_seals_all_sibling_returns(rt):
+    @ray_tpu.remote(num_cpus=999, num_returns=2)  # infeasible → stays queued
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(r1)
+    for r in (r1, r2):
+        with pytest.raises(Exception):
+            ray_tpu.get(r, timeout=5)
+
+
+def test_get_actor_exported(rt):
+    @ray_tpu.remote
+    class S:
+        def ping(self):
+            return "pong"
+
+    S.options(name="s2").remote()
+    assert ray_tpu.get(ray_tpu.get_actor("s2").ping.remote()) == "pong"
+
+
+def test_hard_node_affinity_to_dead_node_fails_fast(rt):
+    victim = ray_tpu.nodes()[0]["NodeID"]
+    rt.kill_node(victim)
+
+    @ray_tpu.remote(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(victim, soft=False)
+    )
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(f.remote(), timeout=5)
+
+
+def test_feasible_but_busy_task_parks_then_runs(rt):
+    # Occupy every CPU, then submit one more task; it must park (not spin)
+    # and run when capacity frees.
+    import threading
+
+    gate = threading.Event()
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        gate.wait(5)
+        return "hog"
+
+    hogs = [hog.remote() for _ in range(3)]  # 3 nodes x 4 CPU all busy
+    time.sleep(0.3)
+
+    @ray_tpu.remote(num_cpus=4)
+    def late():
+        return "late"
+
+    late_ref = late.remote()
+    time.sleep(0.3)
+    rounds_before = rt.metrics["sched_rounds"]
+    time.sleep(0.5)
+    assert rt.metrics["sched_rounds"] - rounds_before < 20  # parked, not spinning
+    gate.set()
+    assert ray_tpu.get(late_ref, timeout=10) == "late"
+    ray_tpu.get(hogs)

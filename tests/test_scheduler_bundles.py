@@ -1,0 +1,162 @@
+"""PG bundle packer kernel tests (semantics: bundle_scheduling_policy.cc,
+pinned the way gcs_placement_group_scheduler_test.cc drives the reference)."""
+import numpy as np
+
+from ray_tpu.scheduler import CPU, GPU, MEMORY, schedule_bundles, sort_bundles
+from ray_tpu.scheduler.binpack import (
+    bin_pack_residual,
+    pick_best_node_type,
+    sort_demands,
+    utilization_scores,
+)
+
+R = 16
+
+
+def mk_nodes(specs):
+    n = len(specs)
+    totals = np.zeros((n, R), dtype=np.float32)
+    for i, s in enumerate(specs):
+        for col, q in s.items():
+            totals[i, col] = q
+    return totals, totals.copy(), np.ones(n, dtype=bool)
+
+
+def bundle(cpu=0.0, gpu=0.0, mem=0.0):
+    d = np.zeros(R, dtype=np.float32)
+    d[CPU], d[GPU], d[MEMORY] = cpu, gpu, mem
+    return d
+
+
+def test_sort_priority_gpu_first_then_mem_then_cpu():
+    bundles = np.stack(
+        [bundle(cpu=4), bundle(gpu=1), bundle(cpu=1, mem=10), bundle(gpu=2)]
+    )
+    order = sort_bundles(bundles)
+    assert list(order[:2]) == [3, 1]  # GPU-heavy first
+    assert list(order[2:]) == [2, 0]  # then memory-heavy
+
+
+def test_pack_fills_one_node_first():
+    totals, avail, alive = mk_nodes([{CPU: 8}, {CPU: 8}])
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=2)] * 3), strategy="PACK"
+    )
+    assert ok
+    assert len(set(nodes.tolist())) == 1  # all on one node
+
+
+def test_pack_overflows_to_second_node():
+    totals, avail, alive = mk_nodes([{CPU: 4}, {CPU: 4}])
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=2)] * 4), strategy="PACK"
+    )
+    assert ok
+    assert sorted(np.bincount(nodes, minlength=2).tolist()) == [2, 2]
+
+
+def test_pack_fails_when_no_capacity():
+    totals, avail, alive = mk_nodes([{CPU: 2}])
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=2)] * 2), strategy="PACK"
+    )
+    assert not ok
+
+
+def test_strict_pack_single_node():
+    totals, avail, alive = mk_nodes([{CPU: 4}, {CPU: 16}])
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=3)] * 4), strategy="STRICT_PACK"
+    )
+    assert ok
+    assert set(nodes.tolist()) == {1}
+
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=8)] * 4), strategy="STRICT_PACK"
+    )
+    assert not ok  # 32 CPUs fit nowhere
+
+
+def test_spread_prefers_distinct_nodes_then_reuses():
+    totals, avail, alive = mk_nodes([{CPU: 8}, {CPU: 8}, {CPU: 8}])
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=1)] * 5), strategy="SPREAD"
+    )
+    assert ok
+    counts = np.bincount(nodes, minlength=3)
+    assert (counts >= 1).all()  # every node used before reuse
+
+
+def test_strict_spread_requires_distinct_nodes():
+    totals, avail, alive = mk_nodes([{CPU: 8}, {CPU: 8}])
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=1)] * 2), strategy="STRICT_SPREAD"
+    )
+    assert ok
+    assert sorted(nodes.tolist()) == [0, 1]
+    nodes, ok, _ = schedule_bundles(
+        totals, avail, alive, np.stack([bundle(cpu=1)] * 3), strategy="STRICT_SPREAD"
+    )
+    assert not ok
+
+
+def test_gpu_bundles_land_on_gpu_nodes():
+    totals, avail, alive = mk_nodes([{CPU: 8}, {CPU: 8, GPU: 2}])
+    bundles = np.stack([bundle(cpu=1, gpu=1), bundle(cpu=1)])
+    nodes, ok, _ = schedule_bundles(totals, avail, alive, bundles, strategy="PACK")
+    assert ok
+    assert nodes[0] == 1
+
+
+# -- autoscaler binpack -----------------------------------------------------
+
+
+def test_bin_pack_residual_first_fit():
+    nodes_avail = np.zeros((2, R), dtype=np.float32)
+    nodes_avail[0, CPU] = 4
+    nodes_avail[1, CPU] = 4
+    demands = np.zeros((3, R), dtype=np.float32)
+    demands[:, CPU] = 3
+    order = sort_demands(demands)
+    res = bin_pack_residual(nodes_avail, demands[order])
+    placed = np.asarray(res.node)
+    assert (placed >= 0).sum() == 2  # third demand of 3 CPUs doesn't fit
+    out = np.asarray(res.avail_out)
+    assert out[:, CPU].tolist() == [1.0, 1.0]
+
+
+def test_bin_pack_strict_spread():
+    nodes_avail = np.zeros((2, R), dtype=np.float32)
+    nodes_avail[:, CPU] = 8
+    demands = np.zeros((3, R), dtype=np.float32)
+    demands[:, CPU] = 1
+    res = bin_pack_residual(nodes_avail, demands, strict_spread=True)
+    placed = np.asarray(res.node)
+    assert (placed >= 0).sum() == 2  # only 2 distinct nodes
+
+
+def test_sort_demands_complex_then_heavy():
+    demands = np.zeros((3, R), dtype=np.float32)
+    demands[0, CPU] = 8  # heavy, simple
+    demands[1, CPU], demands[1, GPU] = 1, 1  # complex
+    demands[2, CPU] = 2
+    order = sort_demands(demands)
+    assert order[0] == 1
+    assert order[1] == 0
+
+
+def test_utilization_scorer_picks_matching_type():
+    # Type 0: CPU-only node; type 1: GPU node. CPU demands should pick type 0
+    # (gpu_ok dominates).
+    types = np.zeros((2, R), dtype=np.float32)
+    types[0, CPU] = 8
+    types[1, CPU], types[1, GPU] = 8, 4
+    demands = np.zeros((4, R), dtype=np.float32)
+    demands[:, CPU] = 2
+    scores = utilization_scores(types, demands)
+    assert pick_best_node_type(scores) == 0
+
+    gpu_demands = demands.copy()
+    gpu_demands[:, GPU] = 1
+    scores = utilization_scores(types, gpu_demands)
+    assert pick_best_node_type(scores) == 1

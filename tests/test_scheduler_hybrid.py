@@ -1,0 +1,181 @@
+"""Hybrid policy kernel tests — semantics pinned against the reference
+HybridSchedulingPolicy (hybrid_scheduling_policy.cc), mirroring its unit
+suite (policy/tests/)."""
+import numpy as np
+import pytest
+
+from ray_tpu.scheduler import (
+    CPU,
+    GPU,
+    MEMORY,
+    OBJECT_STORE_MEMORY,
+    HybridConfig,
+    hybrid_schedule_batch,
+    hybrid_schedule_reference,
+    hybrid_schedule_rounds,
+)
+
+R = 16
+
+
+def mk_nodes(specs):
+    """specs: list of {col: qty} totals; avail starts equal to totals."""
+    n = len(specs)
+    totals = np.zeros((n, R), dtype=np.float32)
+    for i, s in enumerate(specs):
+        for col, q in s.items():
+            totals[i, col] = q
+    return totals, totals.copy(), np.ones(n, dtype=bool)
+
+
+def demand(**cols):
+    d = np.zeros(R, dtype=np.float32)
+    mapping = {"cpu": CPU, "mem": MEMORY, "obj": OBJECT_STORE_MEMORY, "gpu": GPU}
+    for k, v in cols.items():
+        d[mapping[k]] = v
+    return d
+
+
+def run_batch(totals, avail, alive, demands, config=HybridConfig(), k=1):
+    b = len(demands)
+    return hybrid_schedule_batch(
+        totals,
+        avail,
+        alive,
+        np.stack(demands).astype(np.float32),
+        np.zeros(b, dtype=np.int32),
+        np.zeros(b, dtype=bool),
+        np.uint32(0),
+        config=config,
+        num_candidates=k,
+    )
+
+
+def test_infeasible_returns_minus_one():
+    totals, avail, alive = mk_nodes([{CPU: 2}, {CPU: 4}])
+    res = run_batch(totals, avail, alive, [demand(cpu=8)])
+    assert int(res.node[0]) == -1
+
+
+def test_feasible_but_unavailable_queues_without_grant():
+    totals, avail, alive = mk_nodes([{CPU: 4}])
+    avail[0, CPU] = 0.0  # busy
+    res = run_batch(totals, avail, alive, [demand(cpu=4)])
+    assert int(res.node[0]) == 0
+    assert not bool(res.available[0])
+    # require_available drops it entirely
+    res2 = run_batch(
+        totals, avail, alive, [demand(cpu=4)],
+        config=HybridConfig(require_available=True),
+    )
+    assert int(res2.node[0]) == -1
+
+
+def test_prefers_lower_utilization_node():
+    totals, avail, alive = mk_nodes([{CPU: 8, MEMORY: 100}, {CPU: 8, MEMORY: 100}])
+    avail[0, CPU] = 1.0  # node0 busy: util 7/8 > 0.5 threshold
+    res = run_batch(totals, avail, alive, [demand(cpu=1)])
+    assert int(res.node[0]) == 1
+    assert bool(res.available[0])
+
+
+def test_spread_threshold_zeroes_low_utilization():
+    # Both nodes below threshold → identical score 0 → tie goes to node 0
+    # (lowest id) with k=1.
+    totals, avail, alive = mk_nodes([{CPU: 10}, {CPU: 10}])
+    avail[0, CPU] = 7.0  # util .3 < .5 → score 0
+    res = run_batch(totals, avail, alive, [demand(cpu=1)])
+    assert int(res.node[0]) == 0
+
+
+def test_batch_deducts_between_requests():
+    totals, avail, alive = mk_nodes([{CPU: 2}, {CPU: 2}])
+    res = run_batch(totals, avail, alive, [demand(cpu=2)] * 2)
+    picked = sorted(int(x) for x in res.node)
+    assert picked == [0, 1]  # second request must see node busy
+    assert np.allclose(np.asarray(res.avail_out)[:, CPU], 0.0)
+
+
+def test_accel_node_avoided_by_cpu_tasks():
+    totals, avail, alive = mk_nodes([{CPU: 8, GPU: 4}, {CPU: 8}])
+    res = run_batch(totals, avail, alive, [demand(cpu=1)])
+    assert int(res.node[0]) == 1
+    res_gpu = run_batch(totals, avail, alive, [demand(cpu=1, gpu=1)])
+    assert int(res_gpu.node[0]) == 0
+
+
+def test_force_spillback_avoids_preferred():
+    totals, avail, alive = mk_nodes([{CPU: 8}, {CPU: 8}])
+    res = hybrid_schedule_batch(
+        totals,
+        avail,
+        alive,
+        np.stack([demand(cpu=1)]),
+        np.array([0], dtype=np.int32),
+        np.array([True], dtype=bool),
+        np.uint32(0),
+        config=HybridConfig(),
+        num_candidates=1,
+    )
+    assert int(res.node[0]) == 1
+
+
+def test_matches_reference_model_on_random_clusters():
+    rng = np.random.default_rng(42)
+    for trial in range(5):
+        n = int(rng.integers(2, 12))
+        specs = []
+        for _ in range(n):
+            specs.append(
+                {
+                    CPU: float(rng.integers(1, 16)),
+                    MEMORY: float(rng.integers(1, 64)),
+                }
+            )
+        totals, avail, alive = mk_nodes(specs)
+        avail[:, CPU] = np.floor(avail[:, CPU] * rng.uniform(0.2, 1.0, n))
+        demands = [
+            demand(cpu=float(rng.integers(1, 4))) for _ in range(6)
+        ]
+        res = run_batch(totals, avail, alive, demands, k=1)
+        ref_nodes, ref_granted, _ = hybrid_schedule_reference(
+            totals,
+            avail,
+            alive,
+            np.stack(demands),
+            np.zeros(len(demands), dtype=np.int32),
+            np.zeros(len(demands), dtype=bool),
+            config=HybridConfig(),
+            rng=None,
+            top_k_override=1,
+        )
+        np.testing.assert_array_equal(np.asarray(res.node), ref_nodes)
+        np.testing.assert_array_equal(np.asarray(res.available), ref_granted)
+
+
+def test_rounds_mode_places_everything_when_capacity_exists():
+    totals, avail, alive = mk_nodes([{CPU: 8}] * 4)
+    demands = np.zeros((32, R), dtype=np.float32)
+    demands[:, CPU] = 1.0
+    res = hybrid_schedule_rounds(
+        totals, avail, alive, demands, np.uint32(0), rounds=8
+    )
+    nodes = np.asarray(res.node)
+    assert (nodes >= 0).all()
+    # capacity respected per node
+    counts = np.bincount(nodes, minlength=4)
+    assert (counts <= 8).all()
+    assert counts.sum() == 32
+
+
+def test_rounds_mode_respects_capacity_limits():
+    totals, avail, alive = mk_nodes([{CPU: 2}, {CPU: 2}])
+    demands = np.zeros((10, R), dtype=np.float32)
+    demands[:, CPU] = 1.0
+    res = hybrid_schedule_rounds(
+        totals, avail, alive, demands, np.uint32(1), rounds=6
+    )
+    nodes = np.asarray(res.node)
+    assert (nodes >= 0).sum() == 4  # only 4 CPUs exist
+    out = np.asarray(res.avail_out)
+    assert out[:, CPU].min() >= -1e-4
