@@ -1,0 +1,1 @@
+"""Model zoo: TPU-first model implementations (pure-JAX pytree functions)."""

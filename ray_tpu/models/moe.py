@@ -1,0 +1,72 @@
+"""Switch-style Mixture-of-Experts with expert parallelism over ``tp``.
+
+The reference only forwards ``expert_parallel_size`` to vLLM (SURVEY §2.3).
+Here EP is native: expert weight stacks carry a leading E axis sharded over
+the mesh ``tp`` axis, and dispatch is the GShard dense-einsum formulation
+(one-hot dispatch/combine tensors — static shapes, MXU-friendly; XLA turns
+the einsums into an all-to-all across the expert axis). Top-1 routing with
+capacity dropping, Switch-Transformer style.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_moe(n_experts: int, d_model: int, d_ff: int, n_layers: int, key, dtype):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    s_in = d_model**-0.5
+    s_ff = d_ff**-0.5
+
+    def init(k, *shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "router": init(k1, n_layers, d_model, n_experts, scale=s_in),
+        "w_gate": init(k2, n_layers, n_experts, d_model, d_ff, scale=s_in),
+        "w_up": init(k3, n_layers, n_experts, d_model, d_ff, scale=s_in),
+        "w_down": init(k4, n_layers, n_experts, d_ff, d_model, scale=s_ff),
+    }
+
+
+def moe_specs(lp):
+    """Experts sharded over tp (= the EP axis); router replicated."""
+    return {
+        "router": P(lp, None, None),
+        "w_gate": P(lp, "tp", None, None),
+        "w_up": P(lp, "tp", None, None),
+        "w_down": P(lp, "tp", None, None),
+    }
+
+
+def moe_apply(p, x: jax.Array, capacity_factor: float = 1.25) -> jax.Array:
+    """x: [B, T, D] -> [B, T, D]."""
+    b, t, d = x.shape
+    n = b * t
+    e = p["router"].shape[-1]
+    cap = max(1, int(capacity_factor * n / e))
+    xf = x.reshape(n, d)
+
+    logits = (xf.astype(jnp.float32) @ p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)          # [N, E]
+    gate = jnp.max(probs, axis=-1)                    # [N]
+    expert = jnp.argmax(probs, axis=-1)               # [N]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [N, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0   # position within expert
+    keep = (pos >= 0) & (pos < cap)
+    pos_clipped = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+    # dispatch[n, e, c] — GShard dense dispatch tensor
+    dispatch = (
+        onehot * keep
+    )[:, :, None] * jax.nn.one_hot(pos_clipped, cap, dtype=jnp.float32)
+    combine = dispatch * gate[:, None, None]
+
+    xin = jnp.einsum("nec,nd->ecd", dispatch, xf.astype(jnp.float32)).astype(
+        x.dtype
+    )
+    g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, p["w_gate"]))
+    u = jnp.einsum("ecd,edf->ecf", xin, p["w_up"])
+    y = jnp.einsum("ecf,efd->ecd", g * u, p["w_down"])
+    out = jnp.einsum("nec,ecd->nd", combine, y.astype(jnp.float32))
+    return out.astype(x.dtype).reshape(b, t, d)

@@ -1,0 +1,267 @@
+"""Flagship model: LLaMA-style decoder, TPU-first.
+
+Design (vs the reference, which wraps vLLM/torch and has no native model):
+
+- Pure-functional pytree params; layer weights stacked on a leading axis so
+  the forward is a ``lax.scan`` over layers (one compile of one block).
+- bfloat16 compute, fp32 RMSNorm/softmax accumulators (MXU-friendly).
+- 4D parallelism on the canonical mesh (parallel/mesh.py):
+  * dp — batch sharding (gradient psum inserted by XLA),
+  * tp — Megatron-style head/hidden sharding via parameter PartitionSpecs,
+  * pp — GPipe microbatching over ppermute (ops/pipeline.py),
+  * sp — ring attention over ppermute (ops/ring_attention.py),
+  * ep — MoE experts sharded over the tp axis (models/moe.py).
+- Under jit the whole train step is one XLA program; pp/sp sections run
+  manual (shard_map axis_names={'pp','sp'}), dp/tp stay auto.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.layers import (
+    apply_rope,
+    attention_reference,
+    rms_norm,
+    rope_freqs,
+    swiglu,
+)
+from ray_tpu.ops.pipeline import pipeline_apply
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.models import moe as moe_mod
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    n_experts: int = 0          # 0 = dense MLP; >0 = Switch-MoE every layer
+    expert_capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
+    """Stacked-layer parameter pytree."""
+    k = jax.random.split(key, 12)
+    d, hd = cfg.d_model, cfg.head_dim
+    L = cfg.n_layers
+    dt = cfg.dtype
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dt)
+
+    def dense_init(key, *shape, scale=None):
+        fan_in = shape[-2]
+        scale = scale or fan_in**-0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    blocks = {
+        "ln1": norm_init(L, d),
+        "ln2": norm_init(L, d),
+        "wq": dense_init(k[0], L, d, cfg.n_heads * hd),
+        "wk": dense_init(k[1], L, d, cfg.n_kv_heads * hd),
+        "wv": dense_init(k[2], L, d, cfg.n_kv_heads * hd),
+        "wo": dense_init(k[3], L, cfg.n_heads * hd, d),
+    }
+    if cfg.n_experts > 0:
+        blocks["moe"] = moe_mod.init_moe(
+            cfg.n_experts, d, cfg.d_ff, L, k[4], dt
+        )
+    else:
+        blocks["w_gate"] = dense_init(k[5], L, d, cfg.d_ff)
+        blocks["w_up"] = dense_init(k[6], L, d, cfg.d_ff)
+        blocks["w_down"] = dense_init(k[7], L, cfg.d_ff, d)
+    return {
+        "embed": dense_init(k[8], cfg.vocab_size, d, scale=0.02),
+        "blocks": blocks,
+        "ln_f": norm_init(d),
+        "head": dense_init(k[9], d, cfg.vocab_size),
+    }
+
+
+def param_specs(cfg: ModelConfig, pp: int = 1) -> Dict[str, Any]:
+    """PartitionSpec tree: Megatron tp sharding; layer axis sharded over pp
+    when pipelined (each stage holds its slice of the stack)."""
+    lp = "pp" if pp > 1 else None
+    blocks = {
+        "ln1": P(lp, None),
+        "ln2": P(lp, None),
+        "wq": P(lp, None, "tp"),
+        "wk": P(lp, None, "tp"),
+        "wv": P(lp, None, "tp"),
+        "wo": P(lp, "tp", None),
+    }
+    if cfg.n_experts > 0:
+        blocks["moe"] = moe_mod.moe_specs(lp)
+    else:
+        blocks["w_gate"] = P(lp, None, "tp")
+        blocks["w_up"] = P(lp, None, "tp")
+        blocks["w_down"] = P(lp, "tp", None)
+    return {
+        "embed": P("tp", None),
+        "blocks": blocks,
+        "ln_f": P(None),
+        "head": P(None, "tp"),
+    }
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    pp = mesh.shape.get("pp", 1)
+    specs = param_specs(cfg, pp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray),
+    )
+
+
+def _block(cfg: ModelConfig, p: Dict[str, jax.Array], h: jax.Array,
+           angles: jax.Array, *, sp_manual: bool) -> jax.Array:
+    """One decoder block. h: [B, T(_local), D]; angles already offset."""
+    b, t, d = h.shape
+    hd = cfg.head_dim
+    x = rms_norm(h, p["ln1"])
+    q = (x @ p["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = (x @ p["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (x @ p["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = apply_rope(q, angles)
+    k = apply_rope(k, angles)
+    if sp_manual:
+        attn = ring_attention(q, k, v, "sp", causal=True)
+    else:
+        attn = attention_reference(q, k, v, causal=True)
+    h = h + attn.reshape(b, t, -1) @ p["wo"]
+    x = rms_norm(h, p["ln2"])
+    if cfg.n_experts > 0:
+        y = moe_mod.moe_apply(p["moe"], x, cfg.expert_capacity_factor)
+    else:
+        y = swiglu(x, p["w_gate"], p["w_up"], p["w_down"])
+    return h + y
+
+
+def _scan_blocks(cfg: ModelConfig, blocks, h, angles, *, sp_manual: bool):
+    def body(h, layer_p):
+        return _block(cfg, layer_p, h, angles, sp_manual=sp_manual), None
+
+    h, _ = jax.lax.scan(body, h, blocks)
+    return h
+
+
+def forward(
+    params,
+    tokens: jax.Array,  # int32 [B, T]
+    cfg: ModelConfig,
+    mesh: Optional[Mesh] = None,
+    *,
+    num_microbatches: int = 0,
+) -> jax.Array:
+    """Logits [B, T, V]. Dispatches to plain / ring-SP / pipelined paths
+    based on the mesh shape (pp/sp manual, dp/tp auto)."""
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    b, t = tokens.shape
+    h = params["embed"][tokens].astype(cfg.dtype)
+    angles_full = rope_freqs(cfg.head_dim, t, cfg.rope_theta)
+
+    if pp == 1 and sp == 1:
+        h = _scan_blocks(cfg, params["blocks"], h, angles_full, sp_manual=False)
+    elif pp == 1:
+        # sequence-parallel only: ring attention over sp
+        def sp_body(blocks, h_loc):
+            t_loc = h_loc.shape[1]
+            off = jax.lax.axis_index("sp") * t_loc
+            ang = jax.lax.dynamic_slice_in_dim(angles_full, off, t_loc)
+            return _scan_blocks(cfg, blocks, h_loc, ang, sp_manual=True)
+
+        h = jax.shard_map(
+            sp_body,
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp", None)),
+            out_specs=P(None, "sp", None),
+            axis_names={"sp"},
+            check_vma=True,
+        )(params["blocks"], h)
+    else:
+        # pipeline (optionally + sp): stage-stacked blocks over pp
+        m = num_microbatches or max(1, 2 * pp)
+        assert b % m == 0, f"batch {b} not divisible by microbatches {m}"
+        stages = jax.tree.map(
+            lambda x: x.reshape((pp, x.shape[0] // pp) + x.shape[1:]),
+            params["blocks"],
+        )
+        h_mb = h.reshape((m, b // m) + h.shape[1:])
+
+        def pp_body(stage_blocks, x_mb):
+            # local view keeps the sharded stage axis as size 1 — drop it
+            stage_blocks = jax.tree.map(lambda a: a[0], stage_blocks)
+            t_loc = x_mb.shape[2]
+            if sp > 1:
+                off = jax.lax.axis_index("sp") * t_loc
+            else:
+                off = 0
+            ang = jax.lax.dynamic_slice_in_dim(angles_full, off, t_loc)
+
+            def stage_fn(blocks, x_one):
+                return _scan_blocks(
+                    cfg, blocks, x_one, ang, sp_manual=sp > 1
+                )
+
+            return pipeline_apply(stage_fn, stage_blocks, x_mb, "pp")
+
+        in_layer_spec = P("pp")  # stage axis sharded; rest auto
+        h_mb = jax.shard_map(
+            pp_body,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: in_layer_spec, stages),
+                P(None, None, "sp", None) if sp > 1 else P(),
+            ),
+            out_specs=P(None, None, "sp", None) if sp > 1 else P(),
+            axis_names={"pp", "sp"},
+            check_vma=True,
+        )(stages, h_mb)
+        h = h_mb.reshape((b,) + h_mb.shape[2:])
+
+    h = rms_norm(h, params["ln_f"])
+    return (h @ params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: ModelConfig, mesh=None, *, num_microbatches=0):
+    """Causal LM loss: predict tokens[1:] from tokens[:-1]."""
+    logits = forward(
+        params, tokens[:, :-1], cfg, mesh, num_microbatches=num_microbatches
+    )
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: ModelConfig, optimizer, mesh=None, *, num_microbatches=0):
+    """Returns jittable (params, opt_state, tokens) -> (params, opt_state, loss)."""
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, mesh, num_microbatches=num_microbatches
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return train_step

@@ -1,0 +1,10 @@
+"""Compute ops: layers, ring attention, pipeline schedule, pallas kernels."""
+from .layers import (  # noqa: F401
+    apply_rope,
+    attention_reference,
+    rms_norm,
+    rope_freqs,
+    swiglu,
+)
+from .ring_attention import ring_attention  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401

@@ -1,0 +1,10 @@
+"""Varying-manual-axes (vma) typing helpers for partial-manual shard_map."""
+from __future__ import annotations
+
+import jax
+
+
+def match_vma(x: jax.Array, ref: jax.Array) -> jax.Array:
+    """Promote x's varying-manual-axes set to include ref's."""
+    missing = tuple(jax.typeof(ref).vma - jax.typeof(x).vma)
+    return jax.lax.pcast(x, missing, to="varying") if missing else x

@@ -1,0 +1,78 @@
+"""Ring attention: sequence/context parallelism over the mesh ``sp`` axis.
+
+Net-new relative to the reference (SURVEY §2.3: no SP/CP exists there — it
+only rents bigger vLLM TP configs). This is blockwise attention with an
+online-softmax accumulator where each device holds a sequence shard and the
+K/V shards rotate around the ring via ``jax.lax.ppermute`` — ICI traffic
+overlaps with the local block matmuls under XLA async collectives.
+
+Use inside shard_map with sequence sharded over ``axis_name``:
+    q, k, v: [B, T_local, H, D] per device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+from ray_tpu.ops._vma import match_vma as _match_vma
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    hkv = k.shape[2]
+    groups = h // hkv
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    qh = q.reshape(b, t, hkv, groups, d)
+
+    q_pos = idx * t + jnp.arange(t)  # global positions of local queries
+
+    def block(carry, step):
+        o, m, l, k_cur, v_cur = carry
+        kv_idx = (idx - step) % sp
+        k_pos = kv_idx * t + jnp.arange(t)
+        s = (
+            jnp.einsum(
+                "bthgd,bshd->bhgts",
+                qh.astype(jnp.float32),
+                k_cur.astype(jnp.float32),
+            )
+            * scale
+        )
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+            s = jnp.where(mask[None, None, None], s, -1e30)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows: exp(-1e30 - m) underflows to 0 safely
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhgts,bshd->bthgd", p, v_cur.astype(jnp.float32))
+        o_new = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    # Initial accumulators must carry the same varying-manual-axes type as
+    # the q/k/v inputs (which may be varying over pp too, inside a pipeline
+    # stage) so the scan carry type stays consistent.
+    o0 = _match_vma(jnp.zeros((b, t, hkv, groups, d), jnp.float32), q)
+    m0 = _match_vma(jnp.full((b, hkv, groups, t), -jnp.inf, jnp.float32), q)
+    l0 = _match_vma(jnp.zeros((b, hkv, groups, t), jnp.float32), q)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        block, (o0, m0, l0, k, v), jnp.arange(sp)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    out = (o / denom).reshape(b, t, h, d)
+    return out.astype(q.dtype)

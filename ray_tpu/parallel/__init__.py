@@ -1,0 +1,8 @@
+"""Parallelism: mesh construction, axis conventions, sharding helpers."""
+from .mesh import (  # noqa: F401
+    AXES,
+    MeshConfig,
+    build_mesh,
+    local_mesh,
+    sharding,
+)

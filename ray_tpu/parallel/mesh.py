@@ -1,0 +1,89 @@
+"""Device-mesh management: the TPU-native replacement for process groups.
+
+The reference builds parallelism from worker actors + NCCL groups
+(/root/reference/python/ray/util/collective/collective.py,
+train/torch/config.py:44). On TPU the equivalent is a named
+``jax.sharding.Mesh`` over the chips with XLA collectives riding ICI; this
+module owns mesh construction and the canonical axis names used by every
+model/op in the framework:
+
+- ``dp`` — data parallel (batch)
+- ``pp`` — pipeline parallel (layer stages over ppermute)
+- ``tp`` — tensor parallel (heads / hidden, Megatron-style)
+- ``sp`` — sequence/context parallel (ring attention over ppermute)
+- ``ep`` — expert parallel (MoE experts; aliases the dp axis devices)
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp
+
+    @staticmethod
+    def auto(n_devices: int) -> "MeshConfig":
+        """Factor n into a balanced (dp, pp, tp) mesh, largest factors to dp.
+
+        Heuristic for dry-runs/tests; production configs are explicit.
+        """
+        factors = _prime_factors(n_devices)
+        dims = [1, 1, 1]  # dp, pp, tp
+        for f in sorted(factors, reverse=True):
+            i = dims.index(min(dims))
+            dims[i] *= f
+        dp, pp, tp = sorted(dims, reverse=True)
+        return MeshConfig(dp=dp, pp=pp, tp=tp, sp=1)
+
+
+def _prime_factors(n: int) -> list:
+    out, d = [], 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def build_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < config.size:
+        raise ValueError(
+            f"mesh needs {config.size} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[: config.size]).reshape(
+        config.dp, config.pp, config.tp, config.sp
+    )
+    return Mesh(arr, AXES)
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def local_mesh() -> Mesh:
+    """Single-device mesh (all axes size 1) — the degenerate config every
+    model must also run under (single-chip entry point)."""
+    return build_mesh(MeshConfig(), jax.devices()[:1])

@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# Plugins (e.g. jaxtyping's pytest hook) import jax before this conftest, so
+# the env var above can be too late for the platform choice — force it via
+# config too (safe as long as no backend has initialized yet).
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,105 @@
+"""Flagship model tests: numerics parity across parallelism modes on the
+8-device virtual CPU mesh (conftest sets the flags)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.parallel import MeshConfig, build_mesh
+
+CFG = tfm.ModelConfig(
+    vocab_size=128,
+    d_model=32,
+    n_layers=4,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=64,
+    max_seq_len=64,
+    dtype=jnp.float32,  # exact comparisons on CPU
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    key = jax.random.PRNGKey(0)
+    params = tfm.init_params(CFG, key)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, CFG.vocab_size)
+    logits = tfm.forward(params, tokens, CFG)
+    return params, tokens, logits
+
+
+def test_forward_shapes(setup):
+    params, tokens, logits = setup
+    assert logits.shape == (4, 17, CFG.vocab_size)
+    assert jnp.isfinite(logits).all()
+
+
+def test_causality(setup):
+    params, tokens, logits = setup
+    # Perturbing a later token must not change earlier logits.
+    tokens2 = tokens.at[:, 10].set((tokens[:, 10] + 1) % CFG.vocab_size)
+    logits2 = tfm.forward(params, tokens2, CFG)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :10]), np.asarray(logits2[:, :10]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits[:, 10:]), np.asarray(logits2[:, 10:]))
+
+
+def test_sp_ring_attention_matches_dense(setup):
+    params, tokens, _ = setup
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, CFG.vocab_size)
+    dense = tfm.forward(params, toks, CFG)
+    mesh = build_mesh(MeshConfig(sp=4), jax.devices()[:4])
+    ring = tfm.forward(params, toks, CFG, mesh)
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(ring), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_pp_pipeline_matches_dense(setup):
+    params, tokens, _ = setup
+    toks = jax.random.randint(jax.random.PRNGKey(3), (8, 12), 0, CFG.vocab_size)
+    dense = tfm.forward(params, toks, CFG)
+    mesh = build_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    piped = tfm.forward(params, toks, CFG, mesh, num_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(piped), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_full_mesh_train_step_runs_and_matches(devices8):
+    mesh = build_mesh(MeshConfig(dp=2, pp=2, sp=2), devices8)
+    params = tfm.init_params(CFG, jax.random.PRNGKey(0))
+    params = tfm.shard_params(params, CFG, mesh)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 17), 0, CFG.vocab_size)
+    step = jax.jit(tfm.make_train_step(CFG, opt, mesh, num_microbatches=2))
+    p2, s2, loss = step(params, opt_state, tokens)
+    assert jnp.isfinite(loss)
+    # one more step: loss should change (params updated)
+    _, _, loss2 = step(p2, s2, tokens)
+    assert float(loss2) != float(loss)
+    assert float(loss2) < float(loss) + 1.0
+
+
+def test_moe_model_runs():
+    cfg = tfm.ModelConfig(
+        vocab_size=64,
+        d_model=16,
+        n_layers=2,
+        n_heads=2,
+        n_kv_heads=2,
+        d_ff=32,
+        n_experts=4,
+        dtype=jnp.float32,
+    )
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab_size)
+    logits = tfm.forward(params, tokens, cfg)
+    assert logits.shape == (2, 9, cfg.vocab_size)
+    assert jnp.isfinite(logits).all()
+    loss = tfm.loss_fn(params, tokens, cfg)
+    assert jnp.isfinite(loss)

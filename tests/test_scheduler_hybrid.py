@@ -179,3 +179,68 @@ def test_rounds_mode_respects_capacity_limits():
     assert (nodes >= 0).sum() == 4  # only 4 CPUs exist
     out = np.asarray(res.avail_out)
     assert out[:, CPU].min() >= -1e-4
+
+
+def test_shapes_kernel_places_and_respects_capacity():
+    from ray_tpu.scheduler.hybrid import dedupe_shapes, hybrid_schedule_shapes
+
+    rng = np.random.default_rng(7)
+    n = 16
+    totals = np.zeros((n, R), dtype=np.float32)
+    totals[:, CPU] = 8.0
+    totals[:, MEMORY] = 32.0
+    avail = totals.copy()
+    alive = np.ones(n, dtype=bool)
+    demands = np.zeros((100, R), dtype=np.float32)
+    kind = rng.choice(3, 100, p=[0.5, 0.3, 0.2])
+    demands[:, CPU] = np.where(kind == 0, 0.5, np.where(kind == 1, 1.0, 2.0))
+    demands[kind == 2, MEMORY] = 4.0
+
+    shapes, ids = dedupe_shapes(demands)
+    res = hybrid_schedule_shapes(
+        totals, avail, alive, shapes, ids, np.uint32(0)
+    )
+    nodes = np.asarray(res.node)
+    out = np.asarray(res.avail_out)
+    # total capacity: 128 CPU; total demand = sum
+    total_cpu = demands[:, CPU].sum()
+    assert total_cpu < 128.0
+    assert (nodes >= 0).all()  # everything fits, everything placed
+    # per-node deduction exact
+    for i in range(n):
+        used = demands[nodes == i].sum(axis=0)
+        np.testing.assert_allclose(out[i], totals[i] - used, atol=1e-3)
+
+
+def test_shapes_kernel_unplaceable_overflow():
+    from ray_tpu.scheduler.hybrid import dedupe_shapes, hybrid_schedule_shapes
+
+    totals = np.zeros((2, R), dtype=np.float32)
+    totals[:, CPU] = 2.0
+    avail = totals.copy()
+    alive = np.ones(2, dtype=bool)
+    demands = np.zeros((10, R), dtype=np.float32)
+    demands[:, CPU] = 1.0
+    shapes, ids = dedupe_shapes(demands)
+    res = hybrid_schedule_shapes(totals, avail, alive, shapes, ids, np.uint32(0))
+    nodes = np.asarray(res.node)
+    assert (nodes >= 0).sum() == 4
+    assert np.asarray(res.avail_out)[:, CPU].min() >= -1e-4
+
+
+def test_shapes_kernel_infeasible_shape():
+    from ray_tpu.scheduler.hybrid import dedupe_shapes, hybrid_schedule_shapes
+
+    totals = np.zeros((2, R), dtype=np.float32)
+    totals[:, CPU] = 4.0
+    avail = totals.copy()
+    alive = np.ones(2, dtype=bool)
+    demands = np.zeros((3, R), dtype=np.float32)
+    demands[0, CPU] = 1.0
+    demands[1, GPU] = 1.0  # no GPU anywhere: infeasible
+    demands[2, CPU] = 2.0
+    shapes, ids = dedupe_shapes(demands)
+    res = hybrid_schedule_shapes(totals, avail, alive, shapes, ids, np.uint32(0))
+    nodes = np.asarray(res.node)
+    assert nodes[1] == -1
+    assert nodes[0] >= 0 and nodes[2] >= 0
