@@ -1,0 +1,2 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling on the binpack kernels."""
+from .autoscaler import Autoscaler, NodeTypeConfig, SimNodeProvider  # noqa: F401

@@ -1,0 +1,210 @@
+"""Autoscaler: pending demand → node launches/terminations.
+
+V2-shaped (declarative reconcile, /root/reference/python/ray/autoscaler/v2/
+scheduler.py:782,1016-1060) with the scoring/packing math running through the
+batched kernels in ray_tpu.scheduler.binpack:
+
+  tick():
+    1. read pending demand from the runtime (queued + infeasible leases and
+       unplaced PG bundles — GcsAutoscalerStateManager's ClusterResourceState)
+    2. enforce min_workers per type
+    3. residual = bin_pack_residual(current availability, demands)
+    4. while residual nonempty and below max: pick node type via the
+       utilization scorer (get_nodes_for semantics), add hypothetical node,
+       recompute residual
+    5. launch via the NodeProvider; terminate nodes idle past idle_timeout
+
+The SimNodeProvider adds/removes nodes of the in-process runtime — the
+fake_multi_node provider analog (autoscaler/_private/fake_multi_node/).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.scheduler.binpack import (
+    bin_pack_residual,
+    pick_best_node_type,
+    sort_demands,
+    utilization_scores,
+)
+
+NODE_TYPE_LABEL = "ray_tpu.io/node-type"
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class ScalingDecision:
+    launch: Dict[str, int] = field(default_factory=dict)  # type -> count
+    terminate: List[str] = field(default_factory=list)  # node ids
+
+
+class SimNodeProvider:
+    """Cloud provider stand-in: nodes materialize in the runtime."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        return self.runtime.add_node(
+            dict(node_type.resources), labels={NODE_TYPE_LABEL: node_type.name}
+        )
+
+    def terminate_node(self, node_id: str) -> None:
+        self.runtime.kill_node(node_id)
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return [n for n in self.runtime.nodes_info() if n["Alive"]]
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        runtime,
+        node_types: List[NodeTypeConfig],
+        *,
+        provider: Optional[SimNodeProvider] = None,
+        idle_timeout_s: float = 60.0,
+        tick_interval_s: float = 1.0,
+    ):
+        self.runtime = runtime
+        self.node_types = {t.name: t for t in node_types}
+        self.provider = provider or SimNodeProvider(runtime)
+        self.idle_timeout_s = idle_timeout_s
+        self.tick_interval_s = tick_interval_s
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- control loop ---------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="ray_tpu-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - keep reconciling
+                pass
+
+    # -- one reconcile pass --------------------------------------------
+    def tick(self) -> ScalingDecision:
+        decision = self.plan()
+        for type_name, count in decision.launch.items():
+            for _ in range(count):
+                self.provider.create_node(self.node_types[type_name])
+        for node_id in decision.terminate:
+            self.provider.terminate_node(node_id)
+        return decision
+
+    def plan(self) -> ScalingDecision:
+        decision = ScalingDecision()
+        nodes = self.provider.non_terminated_nodes()
+        counts: Dict[str, int] = {t: 0 for t in self.node_types}
+        for n in nodes:
+            t = n["Labels"].get(NODE_TYPE_LABEL)
+            if t in counts:
+                counts[t] += 1
+
+        # 1. min_workers fill (_add_min_workers_nodes)
+        for t in self.node_types.values():
+            if counts[t.name] < t.min_workers:
+                decision.launch[t.name] = t.min_workers - counts[t.name]
+                counts[t.name] = t.min_workers
+
+        # 2. demand-driven launches
+        demands = self.runtime.pending_resource_demands()
+        if demands:
+            width = self.runtime.vocab.capacity
+            dmat = np.stack(
+                [
+                    self.runtime.vocab.pack(d).astype(np.float32)
+                    for d in demands
+                ]
+            )[:, :width]
+            dmat = dmat[sort_demands(dmat)]
+            avail_rows = [
+                self.runtime.vocab.pack(n["Available"])[:width] for n in nodes
+            ]
+            # nodes already queued for launch (min_workers fill) count as
+            # capacity — otherwise demand double-provisions on cold start
+            for type_name, count in decision.launch.items():
+                row = self.runtime.vocab.pack(
+                    self.node_types[type_name].resources
+                )[:width]
+                avail_rows.extend([row] * count)
+            avail = (
+                np.stack(avail_rows)
+                if avail_rows
+                else np.zeros((0, width), np.float32)
+            )
+            res = bin_pack_residual(avail, dmat)
+            unfulfilled = dmat[np.asarray(res.node) < 0]
+            type_rows = {
+                t.name: self.runtime.vocab.pack(t.resources)[:width]
+                for t in self.node_types.values()
+            }
+            names = list(type_rows)
+            guard = 0
+            while len(unfulfilled) and guard < 64:
+                guard += 1
+                allowed = [
+                    n
+                    for n in names
+                    if counts[n] + decision.launch.get(n, 0)
+                    < self.node_types[n].max_workers
+                ]
+                if not allowed:
+                    break
+                types_mat = np.stack([type_rows[n] for n in allowed])
+                scores = utilization_scores(types_mat, unfulfilled)
+                best = pick_best_node_type(scores)
+                if best < 0:
+                    break
+                chosen = allowed[best]
+                decision.launch[chosen] = decision.launch.get(chosen, 0) + 1
+                res = bin_pack_residual(
+                    type_rows[chosen][None, :], unfulfilled
+                )
+                unfulfilled = unfulfilled[np.asarray(res.node) < 0]
+
+        # 3. idle termination (keep min_workers)
+        now = time.monotonic()
+        for n in nodes:
+            nid = n["NodeID"]
+            idle = n["Available"] == n["Resources"] and not self.runtime.nodes[
+                nid
+            ].running_tasks
+            if idle:
+                self._idle_since.setdefault(nid, now)
+                t = n["Labels"].get(NODE_TYPE_LABEL)
+                min_w = self.node_types[t].min_workers if t in self.node_types else 0
+                if (
+                    now - self._idle_since[nid] > self.idle_timeout_s
+                    and t in counts
+                    and counts[t] > min_w
+                ):
+                    decision.terminate.append(nid)
+                    counts[t] -= 1
+            else:
+                self._idle_since.pop(nid, None)
+        return decision

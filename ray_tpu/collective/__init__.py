@@ -1,0 +1,28 @@
+"""ray_tpu.util.collective — collective communication API.
+
+API parity with the reference's ray.util.collective
+(/root/reference/python/ray/util/collective/collective.py:146-660). Two
+planes, TPU-first:
+
+- **In-program (the fast path)**: collectives inside jit over a Mesh are XLA
+  collectives on ICI — jax.lax.psum/all_gather/ppermute. That replaces the
+  reference's NCCL plane entirely; nothing to manage here.
+- **Host-level groups (this module)**: actor/task ranks outside jit
+  rendezvous through an in-process "host" backend (the Gloo analog) —
+  allreduce/broadcast/allgather/reducescatter/send/recv with barrier
+  semantics identical to the reference API.
+"""
+from .collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)

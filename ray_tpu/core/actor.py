@@ -232,7 +232,9 @@ class ActorHandle:
         return self._runtime._actors[self._actor_id]
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        # dunders (except __call__, used by serve replicas) stay normal
+        # attribute errors so pickling/copy protocols don't get hijacked
+        if name.startswith("__") and name != "__call__":
             raise AttributeError(name)
         fn = getattr(self._cls, name, None)
         if fn is None or not callable(fn):

@@ -659,6 +659,20 @@ class Runtime:
                 out[k] = out.get(k, 0.0) + v
         return out
 
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        """Resource shapes the cluster cannot currently place — what the
+        autoscaler sees (GcsAutoscalerStateManager::HandleGetClusterResourceState
+        analog, gcs_autoscaler_state_manager.cc:48)."""
+        out: List[Dict[str, float]] = []
+        with self._cond:
+            for spec in self._pending + self._infeasible:
+                if spec.resources:
+                    out.append(dict(spec.resources))
+            for pg in self._pending_pgs:
+                if not pg.removed:
+                    out.extend(dict(b) for b in pg.bundle_specs)
+        return out
+
     def available_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
         for n in self.nodes.values():

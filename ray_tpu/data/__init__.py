@@ -1,0 +1,11 @@
+"""ray_tpu.data — streaming datasets over object-store blocks.
+
+Analog of Ray Data (/root/reference/python/ray/data/): a Dataset is a lazy
+logical plan over blocks; consumption runs a streaming executor that maps
+blocks through the operator chain as parallel tasks with bounded in-flight
+work (backpressure), blocks flowing through the object store as ObjectRefs
+(streaming_executor.py:77 shape, collapsed to a fused operator chain).
+"""
+from .dataset import Dataset, from_items, from_numpy, range_  # noqa: F401
+
+range = range_  # ray_tpu.data.range(n) parity with ray.data.range

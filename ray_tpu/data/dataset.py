@@ -1,0 +1,198 @@
+"""Lazy Dataset + streaming block executor."""
+from __future__ import annotations
+
+import builtins
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+
+# -- block-level task (executed remotely) -----------------------------------
+
+
+@ray_tpu.remote
+def _apply_chain(block: List[Any], ops: List[tuple]) -> List[Any]:
+    for kind, fn, kwargs in ops:
+        if kind == "map":
+            block = [fn(row) for row in block]
+        elif kind == "filter":
+            block = [row for row in block if fn(row)]
+        elif kind == "flat_map":
+            block = [out for row in block for out in fn(row)]
+        elif kind == "map_batches":
+            size = kwargs.get("batch_size") or len(block) or 1
+            out: List[Any] = []
+            for i in range(0, len(block), size):
+                batch = _rows_to_batch(block[i : i + size])
+                result = fn(batch)
+                out.extend(_batch_to_rows(result))
+            block = out
+    return block
+
+
+def _rows_to_batch(rows: List[Any]) -> Dict[str, np.ndarray]:
+    """numpy batch format (the reference's default batch_format="numpy")."""
+    if rows and isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return {"data": np.asarray(rows)}
+
+
+def _batch_to_rows(batch: Any) -> List[Any]:
+    if isinstance(batch, dict):
+        keys = list(batch.keys())
+        n = len(batch[keys[0]])
+        rows = [{k: batch[k][i] for k in keys} for i in range(n)]
+        # unwrap the synthetic "data" column
+        if keys == ["data"]:
+            return [r["data"] for r in rows]
+        return rows
+    return list(batch)
+
+
+# -- dataset ----------------------------------------------------------------
+
+
+class Dataset:
+    """Lazy, immutable; transformations return new Datasets."""
+
+    def __init__(self, input_blocks: List[Any], ops: List[tuple]):
+        self._input_blocks = input_blocks  # host lists (lazy materialization)
+        self._ops = ops
+
+    # transformations (lazy)
+    def map(self, fn: Callable) -> "Dataset":
+        return Dataset(self._input_blocks, self._ops + [("map", fn, {})])
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return Dataset(self._input_blocks, self._ops + [("filter", fn, {})])
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return Dataset(self._input_blocks, self._ops + [("flat_map", fn, {})])
+
+    def map_batches(
+        self, fn: Callable, *, batch_size: Optional[int] = None, **_ignored
+    ) -> "Dataset":
+        return Dataset(
+            self._input_blocks,
+            self._ops + [("map_batches", fn, {"batch_size": batch_size})],
+        )
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self._materialize_rows()
+        return from_items(rows, override_num_blocks=num_blocks)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        rows = self._materialize_rows()
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(rows))
+        return from_items(
+            [rows[i] for i in order], override_num_blocks=len(self._input_blocks)
+        )
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return from_items(
+            self._materialize_rows() + other._materialize_rows(),
+            override_num_blocks=len(self._input_blocks)
+            + len(other._input_blocks),
+        )
+
+    def split(self, n: int) -> List["Dataset"]:
+        rows = self._materialize_rows()
+        splits = np.array_split(np.arange(len(rows)), n)
+        return [
+            from_items([rows[i] for i in idx], override_num_blocks=1)
+            for idx in splits
+        ]
+
+    # execution (streaming)
+    def iter_blocks(self) -> Iterator[List[Any]]:
+        """Streaming executor: bounded in-flight block tasks (backpressure,
+        resource_manager.py semantics collapsed to a window)."""
+        if not self._ops:
+            yield from self._input_blocks
+            return
+        max_in_flight = max(
+            2, int(ray_tpu.cluster_resources().get("CPU", 4))
+        )
+        blocks = list(self._input_blocks)
+        in_flight: List[Any] = []
+        i = 0
+        while i < len(blocks) or in_flight:
+            while i < len(blocks) and len(in_flight) < max_in_flight:
+                in_flight.append(_apply_chain.remote(blocks[i], self._ops))
+                i += 1
+            ready, in_flight = ray_tpu.wait(in_flight, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from block
+
+    def iter_batches(
+        self, *, batch_size: int = 256, batch_format: str = "numpy"
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        buf: List[Any] = []
+        for row in self.iter_rows():
+            buf.append(row)
+            if len(buf) >= batch_size:
+                yield _rows_to_batch(buf)
+                buf = []
+        if buf:
+            yield _rows_to_batch(buf)
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(len(b) for b in self.iter_blocks())
+
+    def materialize(self) -> "Dataset":
+        return from_items(
+            self.take_all(), override_num_blocks=len(self._input_blocks)
+        )
+
+    def num_blocks(self) -> int:
+        return len(self._input_blocks)
+
+    def _materialize_rows(self) -> List[Any]:
+        return self.take_all()
+
+    def __repr__(self) -> str:
+        return (
+            f"Dataset(num_blocks={len(self._input_blocks)}, "
+            f"num_ops={len(self._ops)})"
+        )
+
+
+def from_items(
+    items: Sequence[Any], *, override_num_blocks: Optional[int] = None
+) -> Dataset:
+    items = list(items)
+    n_blocks = override_num_blocks or min(
+        max(1, len(items) // 1000 or 1), 200
+    )
+    idx = np.array_split(np.arange(len(items)), n_blocks)
+    blocks = [[items[i] for i in part] for part in idx]
+    return Dataset(blocks, [])
+
+
+def range_(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return from_items(
+        builtins.range(n), override_num_blocks=override_num_blocks
+    )
+
+
+def from_numpy(arr: np.ndarray, **kwargs) -> Dataset:
+    return from_items(list(arr), **kwargs)

@@ -1,0 +1,19 @@
+"""ray_tpu.serve — model serving: deployments = replica actor fleets.
+
+Analog of Ray Serve (/root/reference/python/ray/serve/): @deployment wraps a
+class/function; serve.run() materializes replica actors behind a router that
+picks replicas power-of-two-choices style (request_router/pow_2_router.py:27);
+a controller loop autoscales replica counts toward
+target_ongoing_requests (autoscaling_policy.py:296); an optional HTTP proxy
+maps POST /<name> onto handles (proxy.py).
+"""
+from .deployment import (  # noqa: F401
+    Application,
+    Deployment,
+    DeploymentHandle,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+)

@@ -1,0 +1,349 @@
+"""Deployments, router, autoscaling controller, HTTP proxy."""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        d = Deployment(
+            self.func_or_class,
+            overrides.pop("name", self.name),
+            self.num_replicas,
+            dict(self.ray_actor_options),
+            self.max_ongoing_requests,
+            self.autoscaling_config,
+        )
+        for k, v in overrides.items():
+            setattr(d, k, v)
+        return d
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    init_args: tuple
+    init_kwargs: dict
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None, **opts):
+    """@serve.deployment decorator (serve/api.py parity)."""
+
+    def wrap(obj):
+        dep_name = name or getattr(obj, "__name__", "deployment")
+        if not isinstance(obj, type):
+            fn = obj
+
+            class _FuncDeployment:
+                def __call__(self, *a, **kw):
+                    return fn(*a, **kw)
+
+            _FuncDeployment.__name__ = dep_name
+            obj = _FuncDeployment
+        d = Deployment(obj, dep_name)
+        for k, v in opts.items():
+            if k == "autoscaling_config" and isinstance(v, dict):
+                v = AutoscalingConfig(**v)
+            setattr(d, k, v)
+        return d
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+@dataclass
+class _Replica:
+    actor: Any
+    ongoing: int = 0
+    draining: bool = False
+
+
+class _ReplicaSet:
+    """Replica fleet + p2c router state for one deployment."""
+
+    def __init__(self, app: Application):
+        self.app = app
+        self.dep = app.deployment
+        self.lock = threading.Lock()
+        self.replicas: List[_Replica] = []
+        self.total_requests = 0
+        self._outstanding: List[tuple] = []  # (ref, _Replica)
+        self._watch_cv = threading.Condition(self.lock)
+        self._watcher: Optional[threading.Thread] = None
+        self._closed = False
+        self._build_actor_class()
+        n0 = (
+            self.dep.autoscaling_config.min_replicas
+            if self.dep.autoscaling_config
+            else self.dep.num_replicas
+        )
+        for _ in range(n0):
+            self._add_replica()
+
+    def _build_actor_class(self):
+        cls = self.dep.func_or_class
+        opts = dict(self.dep.ray_actor_options)
+        opts.setdefault("max_concurrency", 8)
+        init_args = []
+        for a in self.app.init_args:
+            if isinstance(a, Application):
+                a = run(a)  # nested deployment → handle (model composition)
+            init_args.append(a)
+        self._actor_cls = ray_tpu.remote(**opts)(cls)
+        self._init_args = tuple(init_args)
+
+    def _add_replica(self):
+        actor = self._actor_cls.remote(
+            *self._init_args, **self.app.init_kwargs
+        )
+        with self.lock:
+            self.replicas.append(_Replica(actor))
+
+    def _drain_one_replica(self):
+        """Downscale with drain: stop routing to one idle replica and kill
+        it; if none is idle, mark the emptiest as draining and kill it once
+        its in-flight requests complete (the watcher does the final kill)."""
+        with self.lock:
+            active = [r for r in self.replicas if not r.draining]
+            if len(active) <= 1:
+                return
+            idle = [r for r in active if r.ongoing == 0]
+            victim = idle[0] if idle else min(active, key=lambda r: r.ongoing)
+            victim.draining = True
+            if victim.ongoing == 0:
+                self.replicas.remove(victim)
+                kill_now = True
+            else:
+                kill_now = False  # watcher kills at ongoing==0
+        if kill_now:
+            ray_tpu.kill(victim.actor)
+
+    # power-of-two-choices routing (pow_2_router.py:27)
+    def _pick_replica(self) -> _Replica:
+        # caller holds self.lock
+        cands = [r for r in self.replicas if not r.draining]
+        if not cands:
+            cands = list(self.replicas)
+        if len(cands) == 1:
+            return cands[0]
+        a, b = random.sample(cands, 2)
+        return a if a.ongoing <= b.ongoing else b
+
+    def submit(self, method: str, args, kwargs):
+        with self.lock:
+            replica = self._pick_replica()
+            replica.ongoing += 1
+            self.total_requests += 1
+            actor = replica.actor
+        ref = getattr(actor, method).remote(*args, **kwargs)
+        with self._watch_cv:
+            self._outstanding.append((ref, replica))
+            if self._watcher is None or not self._watcher.is_alive():
+                self._watcher = threading.Thread(
+                    target=self._watch_loop,
+                    name=f"serve-watch-{self.dep.name}",
+                    daemon=True,
+                )
+                self._watcher.start()
+            self._watch_cv.notify()
+        return ref
+
+    def _watch_loop(self):
+        """Single completion watcher: decrements in-flight counters when the
+        request's result seals (never on a timeout), and finishes draining
+        replicas."""
+        while True:
+            with self._watch_cv:
+                while not self._outstanding and not self._closed:
+                    self._watch_cv.wait(timeout=1.0)
+                if self._closed:
+                    return
+                snapshot = list(self._outstanding)
+            refs = [ref for ref, _ in snapshot]
+            ready, _ = ray_tpu.wait(
+                refs, num_returns=1, timeout=0.2
+            )
+            if not ready:
+                continue
+            ready_set = {r.hex for r in ready}
+            to_kill = []
+            with self._watch_cv:
+                still = []
+                for ref, replica in self._outstanding:
+                    if ref.hex in ready_set:
+                        replica.ongoing -= 1
+                        if replica.draining and replica.ongoing == 0:
+                            if replica in self.replicas:
+                                self.replicas.remove(replica)
+                            to_kill.append(replica)
+                    else:
+                        still.append((ref, replica))
+                self._outstanding = still
+            for replica in to_kill:
+                ray_tpu.kill(replica.actor)
+
+    def autoscale_tick(self):
+        cfg = self.dep.autoscaling_config
+        if cfg is None:
+            return
+        with self.lock:
+            active = [r for r in self.replicas if not r.draining]
+            n = len(active)
+            avg = sum(r.ongoing for r in active) / max(1, n)
+        if avg > cfg.target_ongoing_requests and n < cfg.max_replicas:
+            self._add_replica()
+        elif avg < cfg.target_ongoing_requests / 2 and n > cfg.min_replicas:
+            self._drain_one_replica()
+
+    def close(self):
+        with self._watch_cv:
+            self._closed = True
+            self._watch_cv.notify_all()
+
+    @property
+    def num_replicas(self) -> int:
+        with self.lock:
+            return len([r for r in self.replicas if not r.draining])
+
+
+class DeploymentHandle:
+    """Client handle (serve DeploymentHandle parity): handle.remote(...) or
+    handle.method.remote(...)."""
+
+    def __init__(self, rs: _ReplicaSet, method: str = "__call__"):
+        self._rs = rs
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._rs.submit(self._method, args, kwargs)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._rs, name)
+
+    @property
+    def num_replicas(self) -> int:
+        return self._rs.num_replicas
+
+
+_apps: Dict[str, _ReplicaSet] = {}
+_controller_thread: Optional[threading.Thread] = None
+_controller_stop = threading.Event()
+_http_server = None
+
+
+def _controller_loop():
+    """ServeController reconcile loop (controller.py:121 analog)."""
+    while not _controller_stop.wait(0.25):
+        for rs in list(_apps.values()):
+            try:
+                rs.autoscale_tick()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
+    global _controller_thread
+    key = name or app.deployment.name
+    if key in _apps:
+        return DeploymentHandle(_apps[key])
+    rs = _ReplicaSet(app)
+    _apps[key] = rs
+    if _controller_thread is None or not _controller_thread.is_alive():
+        _controller_stop.clear()
+        _controller_thread = threading.Thread(
+            target=_controller_loop, name="serve-controller", daemon=True
+        )
+        _controller_thread.start()
+    return DeploymentHandle(rs)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(_apps[name])
+
+
+def shutdown() -> None:
+    global _http_server
+    _controller_stop.set()
+    for rs in _apps.values():
+        rs.close()
+        for replica in list(rs.replicas):
+            try:
+                ray_tpu.kill(replica.actor)
+            except Exception:  # noqa: BLE001
+                pass
+    _apps.clear()
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server = None
+
+
+def start_http_proxy(port: int = 8000) -> int:
+    """Minimal HTTP ingress: POST /<deployment> with a JSON body calls the
+    deployment's __call__ with the parsed payload (proxy.py analog)."""
+    global _http_server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            name = self.path.strip("/").split("/")[0]
+            rs = _apps.get(name)
+            if rs is None:
+                self.send_response(404)
+                self.end_headers()
+                self.wfile.write(b'{"error": "no such deployment"}')
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            payload = (
+                json.loads(self.rfile.read(length)) if length else None
+            )
+            try:
+                ref = rs.submit("__call__", (payload,), {})
+                result = ray_tpu.get(ref, timeout=60)
+                body = json.dumps({"result": result}).encode()
+                self.send_response(200)
+            except Exception as exc:  # noqa: BLE001
+                body = json.dumps({"error": repr(exc)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    _http_server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(
+        target=_http_server.serve_forever, name="serve-proxy", daemon=True
+    ).start()
+    return _http_server.server_address[1]

@@ -1,0 +1,19 @@
+"""ray_tpu.train — distributed training orchestration.
+
+Analog of Ray Train v2 (/root/reference/python/ray/train/v2/): a controller
+creates a placement-group-gang of worker actors, wires rank/world-size
+context, runs the user train loop on every worker, and restarts the group
+from the latest checkpoint on failure. The compute inside the loop is
+jax/pjit over the mesh (ray_tpu.parallel) — workers here are the *control*
+plane, exactly the reference JaxTrainer split (train/v2/jax/jax_trainer.py:20,
+config.py:44-104).
+"""
+from .checkpoint import Checkpoint  # noqa: F401
+from .session import get_context, report  # noqa: F401
+from .trainer import (  # noqa: F401
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)

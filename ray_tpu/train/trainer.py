@@ -1,0 +1,220 @@
+"""JaxTrainer: controller + worker-group execution with fault tolerance.
+
+Reference shape: train/v2 controller & worker group
+(/root/reference/python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:113) and JaxTrainer/JaxConfig (train/v2/jax/). Workers are
+actors gang-placed via a placement group; each runs the user's
+train_loop_per_worker with a TrainContext carrying rank/world info and the
+restore checkpoint. On worker failure the whole group restarts from the
+latest reported checkpoint (FailurePolicy semantics).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.scheduling_strategies import PlacementGroupSchedulingStrategy
+from .checkpoint import Checkpoint
+from .session import TrainContext, _set_context
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@ray_tpu.remote
+class _TrainWorker:
+    """One rank of the worker group."""
+
+    def __init__(self, rank: int, world_size: int, experiment_name: str,
+                 trial_dir: str):
+        self.ctx = TrainContext(
+            world_rank=rank,
+            world_size=world_size,
+            local_rank=rank,
+            experiment_name=experiment_name,
+            trial_dir=trial_dir,
+        )
+        # Multi-host wiring (jax.distributed coordinator env) — parity with
+        # JaxConfig._setup_jax_distributed_environment; in-process runtime
+        # runs all ranks in one host so initialization is a no-op here.
+        os.environ.setdefault("RAY_TPU_WORLD_SIZE", str(world_size))
+
+    def run(self, fn: Callable, config: Dict[str, Any],
+            restore: Optional[str]) -> List[Dict[str, Any]]:
+        self.ctx.latest_checkpoint = (
+            Checkpoint(restore) if restore else None
+        )
+        self.ctx._reports = []
+        _set_context(self.ctx)
+        try:
+            fn(config)
+        finally:
+            _set_context(None)
+        # checkpoints are serialized by path
+        return [
+            {
+                "metrics": r["metrics"],
+                "checkpoint": r["checkpoint"].path if r["checkpoint"] else None,
+            }
+            for r in self.ctx._reports
+        ]
+
+
+class JaxTrainer:
+    """Data-parallel trainer driving a gang of workers.
+
+    train_loop_per_worker(config) runs on every rank; use
+    ray_tpu.train.get_context() / report() inside it.
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict[str, Any]], None],
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: ScalingConfig = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.config = dict(train_loop_config or {})
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train-{uuid.uuid4().hex[:6]}"
+        storage = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results"
+        )
+        trial_dir = os.path.join(storage, name)
+        os.makedirs(trial_dir, exist_ok=True)
+
+        max_failures = self.run_config.failure_config.max_failures
+        restore_path: Optional[str] = None
+        attempt = 0
+        while True:
+            try:
+                reports = self._run_attempt(name, trial_dir, restore_path)
+                return self._build_result(trial_dir, reports)
+            except Exception as exc:  # noqa: BLE001
+                attempt += 1
+                restore_path = self._latest_checkpoint_path(trial_dir)
+                if attempt > max_failures:
+                    return Result(
+                        metrics={},
+                        checkpoint=(
+                            Checkpoint(restore_path) if restore_path else None
+                        ),
+                        path=trial_dir,
+                        error=exc,
+                    )
+
+    # -- internals ------------------------------------------------------
+    def _run_attempt(self, name, trial_dir, restore_path):
+        n = self.scaling.num_workers
+        res = self.scaling.worker_resources()
+        pg = ray_tpu.placement_group(
+            [dict(res)] * n, strategy=self.scaling.placement_strategy
+        )
+        if not pg.wait(timeout_seconds=30):
+            raise TimeoutError(
+                f"placement group for {n} workers x {res} not schedulable"
+            )
+        workers = []
+        try:
+            workers = [
+                _TrainWorker.options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=i
+                    ),
+                    resources={},  # held by the bundle reservation
+                ).remote(i, n, name, trial_dir)
+                for i in range(n)
+            ]
+            refs = [
+                w.run.remote(self.train_loop, self.config, restore_path)
+                for w in workers
+            ]
+            reports_per_rank = ray_tpu.get(refs)
+            return reports_per_rank[0]  # rank-0 reports are authoritative
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+            ray_tpu.remove_placement_group(pg)
+
+    def _latest_checkpoint_path(self, trial_dir: str) -> Optional[str]:
+        # 1. durable pointer written by train.report (works for checkpoint
+        # dirs outside trial_dir too)
+        pointer = os.path.join(trial_dir, "_latest_checkpoint")
+        if os.path.isfile(pointer):
+            with open(pointer) as f:
+                path = f.read().strip()
+            if os.path.isdir(path):
+                return path
+        # 2. fall back to the checkpoint_* naming convention inside trial_dir
+        ckpts = sorted(
+            d for d in os.listdir(trial_dir) if d.startswith("checkpoint_")
+        ) if os.path.isdir(trial_dir) else []
+        return os.path.join(trial_dir, ckpts[-1]) if ckpts else None
+
+    def _build_result(self, trial_dir, reports) -> Result:
+        metrics = reports[-1]["metrics"] if reports else {}
+        ckpt_path = None
+        for r in reversed(reports):
+            if r["checkpoint"]:
+                ckpt_path = r["checkpoint"]
+                break
+        if ckpt_path is None:
+            ckpt_path = self._latest_checkpoint_path(trial_dir)
+        return Result(
+            metrics=metrics,
+            checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
+            path=trial_dir,
+            metrics_history=[r["metrics"] for r in reports],
+        )

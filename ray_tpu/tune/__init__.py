@@ -1,0 +1,16 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Analog of Ray Tune (/root/reference/python/ray/tune/): a Tuner runs N trials
+(each an actor holding the user function), samples configs from a search
+space, and drives trial schedulers (ASHA successive halving, PBT
+exploit/explore) off the metrics stream reported by tune.report().
+"""
+from .search import choice, grid_search, loguniform, randint, uniform  # noqa: F401
+from .tuner import (  # noqa: F401
+    ASHAScheduler,
+    PopulationBasedTraining,
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    report,
+)

@@ -1,0 +1,77 @@
+"""Search-space primitives (ray.tune.search parity: tune.choice etc.)."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Domain:
+    kind: str
+    args: tuple
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        if self.kind == "choice":
+            return self.args[0][int(rng.integers(0, len(self.args[0])))]
+        if self.kind == "uniform":
+            lo, hi = self.args
+            return float(rng.uniform(lo, hi))
+        if self.kind == "loguniform":
+            lo, hi = self.args
+            return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+        if self.kind == "randint":
+            lo, hi = self.args
+            return int(rng.integers(lo, hi))
+        raise ValueError(self.kind)
+
+
+def choice(options: List[Any]) -> Domain:
+    return Domain("choice", (list(options),))
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain("uniform", (low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    return Domain("loguniform", (low, high))
+
+
+def randint(low: int, high: int) -> Domain:
+    return Domain("randint", (low, high))
+
+
+@dataclass(frozen=True)
+class GridSearch:
+    values: tuple
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(tuple(values))
+
+
+def expand_param_space(
+    space: Dict[str, Any], num_samples: int, seed: int = 0
+) -> List[Dict[str, Any]]:
+    """Materialize configs: cartesian product of grid axes × num_samples
+    random draws of Domain axes (tune.run semantics)."""
+    rng = np.random.default_rng(seed)
+    grids = {k: v.values for k, v in space.items() if isinstance(v, GridSearch)}
+    grid_combos: List[Dict[str, Any]] = [{}]
+    for k, values in grids.items():
+        grid_combos = [
+            {**combo, k: val} for combo in grid_combos for val in values
+        ]
+    configs = []
+    for _ in range(num_samples):
+        for combo in grid_combos:
+            cfg = dict(combo)
+            for k, v in space.items():
+                if isinstance(v, GridSearch):
+                    continue
+                cfg[k] = v.sample(rng) if isinstance(v, Domain) else v
+            configs.append(cfg)
+    return configs
