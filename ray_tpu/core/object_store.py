@@ -62,12 +62,49 @@ class _Entry:
     creating_task: Optional[str] = None  # lineage: task id that creates this
 
 
-class ObjectStore:
-    """Process-wide store; thread-safe."""
+NATIVE_THRESHOLD_BYTES = 64 * 1024
 
-    def __init__(self) -> None:
+
+class _NativeHandle:
+    __slots__ = ("hex",)
+
+    def __init__(self, hex_id: str):
+        self.hex = hex_id
+
+
+class ObjectStore:
+    """Process-wide store; thread-safe.
+
+    Large numpy arrays are spilled into the native shared-memory arena
+    (ray_tpu.native, the plasma analog) and read back as zero-copy views;
+    small/other objects stay in-process (the CoreWorkerMemoryStore split at
+    max_direct_call_object_size, ray_config_def.h:218).
+    """
+
+    def __init__(self, native=None) -> None:
         self._lock = threading.Lock()
         self._objects: Dict[str, _Entry] = {}
+        self._native = native
+
+    def _maybe_nativize(self, ref: "ObjectRef", value: Any):
+        import numpy as np
+
+        if (
+            self._native is not None
+            and isinstance(value, np.ndarray)
+            and value.nbytes >= NATIVE_THRESHOLD_BYTES
+        ):
+            try:
+                self._native.put_numpy(ref.hex, value)
+                return _NativeHandle(ref.hex)
+            except (MemoryError, KeyError, OSError):
+                return value
+        return value
+
+    def _denativize(self, value: Any) -> Any:
+        if isinstance(value, _NativeHandle):
+            return self._native.get_numpy(value.hex)
+        return value
 
     def create(self, ref: ObjectRef, creating_task: Optional[str] = None) -> None:
         with self._lock:
@@ -75,6 +112,8 @@ class ObjectStore:
                 self._objects[ref.hex] = _Entry(creating_task=creating_task)
 
     def seal(self, ref: ObjectRef, value: Any, is_error: bool = False) -> None:
+        if not is_error:
+            value = self._maybe_nativize(ref, value)
         with self._lock:
             entry = self._objects.setdefault(ref.hex, _Entry())
             entry.value = value
@@ -95,7 +134,7 @@ class ObjectStore:
             if isinstance(entry.value, BaseException):
                 raise entry.value
             raise TaskError(RuntimeError(str(entry.value)))
-        return entry.value
+        return self._denativize(entry.value)
 
     def wait_many(
         self,
@@ -162,8 +201,13 @@ class ObjectStore:
 
     def free(self, refs: List[ObjectRef]) -> None:
         with self._lock:
-            for r in refs:
-                self._objects.pop(r.hex, None)
+            entries = [self._objects.pop(r.hex, None) for r in refs]
+        for e in entries:
+            if e is not None and isinstance(e.value, _NativeHandle):
+                try:
+                    self._native.delete(e.value.hex)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import threading
 import traceback
 import uuid
@@ -121,7 +122,20 @@ class Runtime:
     ):
         self.vocab = ResourceVocab()
         self.view = ClusterView(self.vocab)
-        self.store = ObjectStore()
+        native = None
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu.native import NativeObjectStore
+
+                native = NativeObjectStore(
+                    capacity=int(
+                        os.environ.get("RAY_TPU_STORE_BYTES", 1 << 28)
+                    )
+                )
+            except Exception:  # noqa: BLE001 - toolchain missing → in-proc only
+                logger.warning("native object store unavailable; using in-process")
+        self.native_store = native
+        self.store = ObjectStore(native)
         self.nodes: Dict[str, Node] = {}
         self.hybrid_config = hybrid_config
         self.use_device_scheduler = use_device_scheduler
@@ -636,6 +650,8 @@ class Runtime:
         for node in self.nodes.values():
             node.pool.shutdown(wait=False, cancel_futures=True)
         self._sched_thread.join(timeout=2)
+        if self.native_store is not None:
+            self.native_store.close(unlink=True)
 
     # introspection (ray.nodes / state API analog)
     def nodes_info(self) -> List[Dict[str, Any]]:

@@ -1,0 +1,3 @@
+"""Native (C++) runtime components, built on demand with the system g++."""
+from .build import build_native  # noqa: F401
+from .shm_store import NativeObjectStore  # noqa: F401

@@ -1,0 +1,44 @@
+"""On-demand compilation of the native components (no pybind11 — pure C ABI
+consumed via ctypes, per the environment constraints)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+
+SOURCES = {"objstore": "object_store.cc"}
+
+
+def build_native(name: str = "objstore") -> str:
+    """Compile (if stale) and return the path to lib<name>.so."""
+    src = os.path.join(_HERE, SOURCES[name])
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    with _lock:
+        if (
+            os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+        ):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + ".tmp"
+        subprocess.run(
+            [
+                "g++",
+                "-O2",
+                "-std=c++17",
+                "-shared",
+                "-fPIC",
+                "-o",
+                tmp,
+                src,
+                "-lpthread",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, out)
+    return out

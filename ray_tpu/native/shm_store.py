@@ -1,0 +1,185 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+Zero-copy path: ``put_numpy`` writes the array into the mmap arena;
+``get_numpy`` returns an ndarray VIEW over the same shared pages — any
+process that opens the same store file sees the bytes without a copy (the
+plasma fd-passing model, by shared file instead of fd fling).
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .build import build_native
+
+_ID_LEN = 28
+
+
+class NativeObjectStore:
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        capacity: int = 1 << 28,  # 256 MiB default arena
+        table_slots: int = 1 << 14,
+        create: bool = True,
+    ):
+        lib = ctypes.CDLL(build_native("objstore"))
+        lib.rtpu_store_open.restype = ctypes.c_void_p
+        lib.rtpu_store_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.rtpu_store_create.restype = ctypes.c_int64
+        lib.rtpu_store_create.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        for fn in ("rtpu_store_seal", "rtpu_store_release", "rtpu_store_delete"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_store_get.restype = ctypes.c_int
+        lib.rtpu_store_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rtpu_store_base.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self.path = path or os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_store_{os.getpid()}.shm"
+        )
+        self._owns_file = path is None
+        self._h = lib.rtpu_store_open(
+            self.path.encode(), capacity, table_slots, 1 if create else 0
+        )
+        if not self._h:
+            raise OSError(f"failed to open native store at {self.path}")
+
+    # -- raw bytes ------------------------------------------------------
+    def _norm_id(self, object_id: str) -> bytes:
+        b = object_id.encode()
+        if len(b) != _ID_LEN:
+            # non-canonical ids get a collision-safe digest form
+            import hashlib
+
+            b = hashlib.sha256(b).hexdigest()[:_ID_LEN].encode()
+        return b
+
+    def put_bytes(self, object_id: str, data: bytes) -> None:
+        oid = self._norm_id(object_id)
+        off = self._lib.rtpu_store_create(self._h, oid, len(data))
+        if off == -2:
+            raise KeyError(f"object {object_id} already in store")
+        if off < 0:
+            raise MemoryError(f"native store allocation failed ({off})")
+        base = self._lib.rtpu_store_base(self._h)
+        ctypes.memmove(
+            ctypes.addressof(base.contents) + off, data, len(data)
+        )
+        self._lib.rtpu_store_seal(self._h, oid)
+
+    def get_buffer(self, object_id: str) -> Tuple[int, int]:
+        oid = self._norm_id(object_id)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_store_get(
+            self._h, oid, ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc == -1:
+            raise KeyError(object_id)
+        if rc == -2:
+            raise BlockingIOError(f"object {object_id} not sealed yet")
+        if rc != 0:
+            raise OSError(f"store get failed ({rc})")
+        return off.value, size.value
+
+    def get_bytes(self, object_id: str) -> bytes:
+        off, size = self.get_buffer(object_id)
+        base = self._lib.rtpu_store_base(self._h)
+        out = ctypes.string_at(ctypes.addressof(base.contents) + off, size)
+        self._lib.rtpu_store_release(self._h, self._norm_id(object_id))
+        return out
+
+    # -- zero-copy numpy ------------------------------------------------
+    def put_numpy(self, object_id: str, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        header = json.dumps(
+            {"dtype": arr.dtype.str, "shape": list(arr.shape)}
+        ).encode()
+        payload = (
+            len(header).to_bytes(4, "little") + header + arr.tobytes()
+        )
+        # one memcpy into shared memory; readers are zero-copy
+        self.put_bytes(object_id, payload)
+
+    def get_numpy(self, object_id: str) -> np.ndarray:
+        """Returns a read-only view over the shared pages (no copy)."""
+        off, size = self.get_buffer(object_id)
+        base = self._lib.rtpu_store_base(self._h)
+        addr = ctypes.addressof(base.contents) + off
+        raw = (ctypes.c_uint8 * size).from_address(addr)
+        mv = memoryview(raw)
+        hlen = int.from_bytes(mv[:4], "little")
+        meta = json.loads(bytes(mv[4 : 4 + hlen]))
+        arr = np.frombuffer(
+            mv, dtype=np.dtype(meta["dtype"]), offset=4 + hlen
+        ).reshape(meta["shape"])
+        arr.flags.writeable = False
+        return arr
+
+    def delete(self, object_id: str) -> None:
+        self._lib.rtpu_store_delete(self._h, self._norm_id(object_id))
+
+    def contains(self, object_id: str) -> bool:
+        try:
+            off, _ = self.get_buffer(object_id)
+            self._lib.rtpu_store_release(self._h, self._norm_id(object_id))
+            return True
+        except (KeyError, BlockingIOError):
+            return False
+
+    def stats(self) -> dict:
+        cap = ctypes.c_uint64()
+        used = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        self._lib.rtpu_store_stats(
+            self._h, ctypes.byref(cap), ctypes.byref(used), ctypes.byref(num)
+        )
+        return {
+            "capacity": cap.value,
+            "used": used.value,
+            "num_objects": num.value,
+        }
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            self._lib.rtpu_store_close(self._h)
+            self._h = None
+        if unlink or self._owns_file:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -363,3 +363,21 @@ def test_feasible_but_busy_task_parks_then_runs(rt):
     gate.set()
     assert ray_tpu.get(late_ref, timeout=10) == "late"
     ray_tpu.get(hogs)
+
+
+def test_large_arrays_route_through_native_store(rt):
+    if rt.native_store is None:
+        pytest.skip("native toolchain unavailable")
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(100_000, dtype=np.float32)  # 400 KB > threshold
+
+    ref = produce.remote()
+    out = ray_tpu.get(ref)
+    assert out.shape == (100_000,)
+    assert out[-1] == 99_999.0
+    assert rt.native_store.stats()["num_objects"] >= 1
+    # zero-copy views are read-only
+    with pytest.raises(ValueError):
+        out[0] = 1.0
