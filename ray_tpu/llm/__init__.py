@@ -1,0 +1,11 @@
+"""ray_tpu.llm — LLM batch inference and serving on the native engine.
+
+The reference's ray.llm is config passthrough to vLLM/SGLang
+(/root/reference/python/ray/llm/_internal/). Here the engine is native:
+jitted KV-cache prefill + decode on the flagship model
+(ray_tpu.models.transformer), with batch inference as a Data pipeline stage
+(vllm_engine_proc analog) and serving as a Serve deployment.
+"""
+from .engine import GenerationConfig, LLMEngine  # noqa: F401
+from .processor import LLMProcessor  # noqa: F401
+from .serving import build_llm_deployment  # noqa: F401

@@ -1,0 +1,39 @@
+"""LLM serving: engine replicas behind ray_tpu.serve.
+
+Analog of the reference's serve-side LLM deployments (/root/reference/
+python/ray/llm/_internal/serve/): build_llm_deployment returns a Serve
+application whose replicas each hold an engine; requests are
+{"prompt": str, "max_new_tokens"?: int, "temperature"?: float}.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import ray_tpu.serve as serve
+from .engine import GenerationConfig, LLMEngine
+
+
+def build_llm_deployment(
+    model_config: Any,
+    params: Optional[Any] = None,
+    *,
+    name: str = "llm",
+    num_replicas: int = 1,
+    max_len: int = 256,
+):
+    @serve.deployment(name=name, num_replicas=num_replicas)
+    class LLMServer:
+        def __init__(self):
+            self.engine = LLMEngine(model_config, params, max_len=max_len)
+
+        def __call__(self, request):
+            prompt = request["prompt"]
+            gen = GenerationConfig(
+                max_new_tokens=int(request.get("max_new_tokens", 32)),
+                temperature=float(request.get("temperature", 0.0)),
+                seed=int(request.get("seed", 0)),
+            )
+            text = self.engine.generate([prompt], gen)[0]
+            return {"prompt": prompt, "generated_text": text}
+
+    return LLMServer.bind()

@@ -1,0 +1,95 @@
+"""LLM engine / batch processor / serving tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.llm import GenerationConfig, LLMEngine, LLMProcessor
+from ray_tpu.models import transformer as tfm
+
+CFG = tfm.ModelConfig(
+    vocab_size=258,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=64,
+    max_seq_len=128,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LLMEngine(CFG, max_len=64)
+
+
+def test_generate_shapes_and_determinism(engine):
+    out1 = engine.generate(["hello", "world!"], GenerationConfig(max_new_tokens=8))
+    out2 = engine.generate(["hello", "world!"], GenerationConfig(max_new_tokens=8))
+    assert len(out1) == 2
+    assert out1 == out2  # greedy is deterministic
+
+
+def test_cache_decode_matches_full_forward(engine):
+    """The incremental KV path must agree with the dense forward."""
+    prompt = engine.tokenizer.encode("abc")
+    ids = engine.generate_ids([prompt], GenerationConfig(max_new_tokens=4))[0]
+    # replay: dense forward over prompt+gen, greedy argmax at each step
+    seq = list(prompt)
+    for step in range(4):
+        logits = tfm.forward(engine.params, jnp.asarray([seq]), CFG)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        assert nxt == ids[step], f"divergence at step {step}"
+        seq.append(nxt)
+
+
+def test_sampling_with_temperature(engine):
+    outs = engine.generate_ids(
+        [engine.tokenizer.encode("x")] * 4,
+        GenerationConfig(max_new_tokens=8, temperature=1.5, seed=7, eos_token=-1),
+    )
+    assert len({tuple(o) for o in outs}) > 1  # batch entries diverge
+
+
+def test_variable_length_batch(engine):
+    prompts = [engine.tokenizer.encode(p) for p in ["a", "longer prompt here"]]
+    outs = engine.generate_ids(prompts, GenerationConfig(max_new_tokens=4, eos_token=-1))
+    assert all(len(o) == 4 for o in outs)
+
+
+def test_batch_processor_over_dataset():
+    import ray_tpu.data as rdata
+
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4, "memory": 1e9})
+    try:
+        ds = rdata.from_items(
+            [{"prompt": f"item {i}"} for i in range(8)],
+            override_num_blocks=2,
+        )
+        proc = LLMProcessor(
+            CFG, generation=GenerationConfig(max_new_tokens=4), batch_size=4,
+            max_len=64,
+        )
+        rows = proc.process(ds).take_all()
+        assert len(rows) == 8
+        assert all("generated_text" in r for r in rows)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_llm_serving():
+    import ray_tpu.serve as serve
+    from ray_tpu.llm import build_llm_deployment
+
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4, "memory": 1e9})
+    try:
+        handle = serve.run(build_llm_deployment(CFG, max_len=64))
+        out = ray_tpu.get(
+            handle.remote({"prompt": "hi", "max_new_tokens": 4}), timeout=120
+        )
+        assert out["prompt"] == "hi"
+        assert isinstance(out["generated_text"], str)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
