@@ -23,6 +23,7 @@ from ray_tpu.core.api import (  # noqa: F401
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu.core.placement_group import (  # noqa: F401

@@ -1,0 +1,99 @@
+"""CLI: python -m ray_tpu <command> (reference: ray scripts/scripts.py).
+
+In-process-runtime commands; cluster daemons arrive with the multi-process
+control plane.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import runpy
+import sys
+
+
+def cmd_version(args) -> int:
+    from ray_tpu import __version__
+
+    print(__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Start a cluster of the given shape and print its resource summary."""
+    import ray_tpu
+
+    rt = ray_tpu.init(
+        num_nodes=args.num_nodes,
+        resources_per_node={"CPU": float(args.cpus), "memory": 4e9},
+    )
+    print(json.dumps(
+        {
+            "nodes": len(ray_tpu.nodes()),
+            "cluster_resources": ray_tpu.cluster_resources(),
+            "available_resources": ray_tpu.available_resources(),
+        },
+        indent=2,
+    ))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_job_submit(args) -> int:
+    """Run a workload script with the runtime initialized around it
+    (JobSubmissionClient analog for the in-process runtime)."""
+    import ray_tpu
+
+    ray_tpu.init(
+        num_nodes=args.num_nodes,
+        resources_per_node={"CPU": float(args.cpus), "memory": 4e9},
+        ignore_reinit_error=True,
+    )
+    sys.argv = [args.script] + args.script_args
+    try:
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_bench(args) -> int:
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version")
+
+    s = sub.add_parser("status")
+    s.add_argument("--num-nodes", type=int, default=1)
+    s.add_argument("--cpus", type=int, default=8)
+
+    j = sub.add_parser("job")
+    jsub = j.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--num-nodes", type=int, default=1)
+    js.add_argument("--cpus", type=int, default=8)
+    js.add_argument("script")
+    js.add_argument("script_args", nargs="*")
+
+    sub.add_parser("bench")
+
+    args = p.parse_args()
+    if args.command == "version":
+        return cmd_version(args)
+    if args.command == "status":
+        return cmd_status(args)
+    if args.command == "job":
+        return cmd_job_submit(args)
+    if args.command == "bench":
+        return cmd_bench(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

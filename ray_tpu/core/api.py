@@ -145,6 +145,12 @@ def nodes() -> List[Dict[str, Any]]:
     return get_runtime().nodes_info()
 
 
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace dump of task lifecycle events (ray.timeline parity,
+    reference _private/state.py:1010)."""
+    return get_runtime().events.dump_timeline(filename)
+
+
 def cluster_resources() -> Dict[str, float]:
     return get_runtime().cluster_resources()
 

@@ -162,6 +162,9 @@ class Runtime:
             "leases_spilled_back": 0,
             "sched_rounds": 0,
         }
+        from .events import TaskEventBuffer
+
+        self.events = TaskEventBuffer()
         if resources_per_node is None:
             resources_per_node = {"CPU": 8, "memory": float(4 << 30)}
         for i in range(num_nodes):
@@ -286,6 +289,7 @@ class Runtime:
             self.store.create(ref, creating_task=spec.task_id)
             self._lineage[ref.hex] = spec
         self.metrics["tasks_submitted"] += 1
+        self.events.record(spec.task_id, spec.name, "SUBMITTED")
         self._enqueue(spec)
         return spec.returns
 
@@ -505,6 +509,7 @@ class Runtime:
         if via_pg is None:
             self.view.update_available(node_id, node.ledger.avail_map())
         node.running_tasks[spec.task_id] = spec
+        self.events.record(spec.task_id, spec.name, "SCHEDULED", node.node_id)
         node.pool.submit(self._execute, spec, node, req, via_pg)
 
     # ------------------------------------------------------------------
@@ -517,6 +522,7 @@ class Runtime:
         _context.task_id = spec.task_id
         _context.actor_id = spec.actor_id
         actor_holds_resources = False
+        self.events.record(spec.task_id, spec.name, "RUNNING", node.node_id)
         try:
             args, kwargs = self._resolve_args(spec.args, spec.kwargs)
             result = spec.func(*args, **kwargs)
@@ -528,12 +534,17 @@ class Runtime:
             else:
                 self._seal_results(spec, node, result)
             self.metrics["tasks_finished"] += 1
+            self.events.record(spec.task_id, spec.name, "FINISHED", node.node_id)
         except BaseException as exc:  # noqa: BLE001 - task errors are values
             if spec.retry_exceptions and spec.attempt < spec.max_retries:
                 spec.attempt += 1
                 self._enqueue(spec)
             else:
                 self.metrics["tasks_failed"] += 1
+                self.events.record(
+                    spec.task_id, spec.name, "FAILED", node.node_id,
+                    error=repr(exc),
+                )
                 err = TaskError(exc, spec.name or spec.task_id)
                 err.__cause__ = exc
                 for ref in spec.returns:

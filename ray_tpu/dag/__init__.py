@@ -1,0 +1,12 @@
+"""ray_tpu.dag — lazy task/actor DAGs with a compiled fast path.
+
+Analog of the reference's compiled/accelerated DAGs (/root/reference/python/
+ray/dag/compiled_dag_node.py): ``.bind()`` builds a lazy graph over actor
+methods and functions; ``experimental_compile()`` freezes the topology so
+repeated ``execute()`` calls skip scheduling and dispatch straight through
+the actors' queues (the channel-based bypass, in-process form). For
+device-level graphs the idiomatic TPU answer is already jit/pjit — one XLA
+program IS the compiled DAG — so this module covers the *actor orchestration*
+layer only.
+"""
+from .dag import InputNode, MultiOutputNode  # noqa: F401

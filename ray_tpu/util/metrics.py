@@ -1,0 +1,154 @@
+"""Metrics: typed instruments + Prometheus text exposition.
+
+Analog of the reference's metric pipeline (src/ray/stats/metric.h →
+open_telemetry_metric_recorder → per-node agent → Prometheus scrape,
+python/ray/_private/metrics_agent.py) collapsed to a process-local registry
+with the same instrument types and a /metrics text endpoint.
+"""
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "_Metric"] = {}
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 label_names: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+        with _registry_lock:
+            _registry[name] = self
+
+    def _key(self, labels: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        labels = labels or {}
+        return tuple(str(labels.get(k, "")) for k in self.label_names)
+
+    def _fmt_labels(self, key: Tuple[str, ...]) -> str:
+        if not self.label_names:
+            return ""
+        pairs = ",".join(
+            f'{k}="{v}"' for k, v in zip(self.label_names, key)
+        )
+        return "{" + pairs + "}"
+
+    def samples(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{self._fmt_labels(k)} {v}"
+                for k, v in self._values.items()
+            ] or [f"{self.name} 0"]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, labels: Optional[Dict] = None) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, labels: Optional[Dict] = None) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, labels: Optional[Dict] = None) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, labels: Optional[Dict] = None) -> None:
+        self.inc(-value, labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 label_names: Sequence[str] = ()):
+        super().__init__(name, description, label_names)
+        self.boundaries = sorted(boundaries) or [
+            0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60,
+        ]
+        self._buckets: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._counts: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, labels: Optional[Dict] = None) -> None:
+        k = self._key(labels)
+        with self._lock:
+            b = self._buckets.setdefault(
+                k, [0] * (len(self.boundaries) + 1)
+            )
+            b[bisect_right(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def samples(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            for k, buckets in self._buckets.items():
+                cum = 0
+                base = self._fmt_labels(k)[1:-1] if self.label_names else ""
+                for bound, count in zip(self.boundaries, buckets):
+                    cum += count
+                    lbl = f'le="{bound}"' + (f",{base}" if base else "")
+                    out.append(f"{self.name}_bucket{{{lbl}}} {cum}")
+                cum += buckets[-1]
+                lbl = 'le="+Inf"' + (f",{base}" if base else "")
+                out.append(f"{self.name}_bucket{{{lbl}}} {cum}")
+                tail = "{" + base + "}" if base else ""
+                out.append(f"{self.name}_sum{tail} {self._sums[k]}")
+                out.append(f"{self.name}_count{tail} {self._counts[k]}")
+        return out
+
+
+def prometheus_text() -> str:
+    """Render every registered metric in Prometheus exposition format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        if m.description:
+            lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        lines.extend(m.samples())
+    return "\n".join(lines) + "\n"
+
+
+def start_metrics_server(port: int = 0) -> int:
+    """Prometheus scrape endpoint (GET /metrics)."""
+    import threading as _t
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    _t.Thread(target=server.serve_forever, daemon=True).start()
+    return server.server_address[1]
+
+
+def clear_registry() -> None:
+    with _registry_lock:
+        _registry.clear()

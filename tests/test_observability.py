@@ -1,0 +1,135 @@
+"""Observability tests: metrics, events/timeline, state API."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as rm
+from ray_tpu.util import state as rstate
+
+
+@pytest.fixture()
+def rt():
+    rt = ray_tpu.init(num_nodes=2, resources_per_node={"CPU": 4, "memory": 1e9})
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_metrics_instruments_and_prometheus_text():
+    c = rm.Counter("rtpu_test_total", "test counter", ["kind"])
+    c.inc(labels={"kind": "a"})
+    c.inc(2, labels={"kind": "a"})
+    g = rm.Gauge("rtpu_test_gauge")
+    g.set(42)
+    h = rm.Histogram("rtpu_test_hist", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = rm.prometheus_text()
+    assert 'rtpu_test_total{kind="a"} 3.0' in text
+    assert "rtpu_test_gauge 42.0" in text
+    assert 'rtpu_test_hist_bucket{le="0.1"} 1' in text
+    assert 'rtpu_test_hist_bucket{le="+Inf"} 3' in text
+    assert "rtpu_test_hist_count 3" in text
+
+
+def test_metrics_http_endpoint():
+    rm.Gauge("rtpu_http_gauge").set(7)
+    port = rm.start_metrics_server(port=0)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        body = resp.read().decode()
+    assert "rtpu_http_gauge 7.0" in body
+
+
+def test_task_events_and_timeline(rt, tmp_path):
+    @ray_tpu.remote
+    def work(t):
+        time.sleep(t)
+        return t
+
+    ray_tpu.get([work.remote(0.05) for _ in range(3)])
+
+    states = rt.events.task_states()
+    finished = [e for e in states.values() if e.state == "FINISHED"]
+    assert len(finished) >= 3
+
+    path = tmp_path / "trace.json"
+    spans = ray_tpu.timeline(str(path))
+    slices = [s for s in spans if s["ph"] == "X"]
+    assert len(slices) >= 3
+    assert all(s["dur"] >= 0.04e6 for s in slices if s["name"] == "work")
+    assert json.loads(path.read_text())  # valid chrome-trace JSON
+
+
+def test_state_api(rt):
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    ray_tpu.get([quick.remote() for _ in range(2)])
+    svc = Svc.options(name="state-svc").remote()
+    ray_tpu.get(svc.ping.remote())
+
+    tasks = rstate.list_tasks(filters=[("state", "=", "FINISHED")])
+    assert any(t["name"] == "quick" for t in tasks)
+    actors = rstate.list_actors()
+    assert any(
+        a["class_name"] == "Svc" and a["state"] == "ALIVE" for a in actors
+    )
+    objs = rstate.list_objects()
+    assert any(o["sealed"] for o in objs)
+    assert len(rstate.list_nodes()) == 2
+    summary = rstate.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 3
+
+
+def test_dag_bind_and_compile(rt):
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, k):
+            self.k = k
+
+        def add(self, x):
+            return x + self.k
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    a1 = Adder.remote(1)
+    a2 = Adder.remote(10)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a2.add.bind(square.bind(a1.add.bind(inp))), a1.add.bind(inp)])
+    assert dag.execute(3) == [(3 + 1) ** 2 + 10, 4]
+    compiled = dag.experimental_compile()
+    for i in range(5):
+        assert compiled.execute(i) == [(i + 1) ** 2 + 10, i + 1]
+
+
+def test_cli_status_and_version(rt):
+    # CLI runs in subprocesses; rt fixture only guards runtime cleanup.
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "version"],
+        capture_output=True, text=True, cwd=repo, timeout=60, env=env,
+    )
+    assert out.returncode == 0 and out.stdout.strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "status", "--num-nodes", "2"],
+        capture_output=True, text=True, cwd=repo, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["nodes"] == 2
