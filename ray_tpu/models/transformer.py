@@ -144,6 +144,11 @@ def _block(cfg: ModelConfig, p: Dict[str, jax.Array], h: jax.Array,
     k = apply_rope(k, angles)
     if sp_manual:
         attn = ring_attention(q, k, v, "sp", causal=True)
+    elif jax.default_backend() not in ("cpu",):
+        # TPU: pallas flash kernel (falls back internally on ragged shapes)
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True)
     else:
         attn = attention_reference(q, k, v, causal=True)
     h = h + attn.reshape(b, t, -1) @ p["wo"]

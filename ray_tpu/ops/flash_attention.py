@@ -1,0 +1,132 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise attention with an online-softmax accumulator: Q stays resident in
+VMEM per grid step while K/V blocks stream HBM→VMEM; scores never
+materialize in HBM (the memory win), and the causal grid skips fully-masked
+K blocks (the compute win). Grid: (batch·kv_heads·groups, q_blocks).
+
+Single-chip counterpart of ops/ring_attention.py (which handles the
+sequence-sharded case over ICI); together they are the long-context story
+the reference lacks natively (SURVEY §2.3).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .layers import attention_reference
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d]; o_ref: [1, block_q, d]
+    _, block_q, d = q_ref.shape
+    s = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0] * scale
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(kb, carry):
+        m, l, o = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        scores = jnp.dot(
+            q, k_blk.T, preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+        m_blk = jnp.max(scores, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(scores - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, o_new
+
+    num_kb = s // block_k
+    if causal:
+        # K blocks strictly above this Q block's diagonal are fully masked.
+        num_kb_live = jnp.minimum(
+            num_kb, (qi + 1) * block_q // block_k + 1
+        )
+    else:
+        num_kb_live = num_kb
+    m, l, o = jax.lax.fori_loop(0, num_kb_live, body, (m0, l0, o0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    b, t, h, d = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    groups = h // hkv
+    if t % block_q or s % block_k:
+        # ragged tails fall back to the fused-XLA reference path
+        return attention_reference(q, k, v, causal=causal)
+    scale = 1.0 / (d**0.5)
+
+    # layout: fold (batch, kv_head, group) into the grid's first axis; GQA
+    # shares each K/V head across `groups` Q heads.
+    qg = (
+        q.reshape(b, t, hkv, groups, d)
+        .transpose(0, 2, 3, 1, 4)
+        .reshape(b * hkv * groups, t, d)
+    )
+    kg = (
+        k.transpose(0, 2, 1, 3)[:, :, None]
+        .repeat(groups, 2)
+        .reshape(b * hkv * groups, s, d)
+    )
+    vg = (
+        v.transpose(0, 2, 1, 3)[:, :, None]
+        .repeat(groups, 2)
+        .reshape(b * hkv * groups, s, d)
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(qg.shape[0], t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        interpret=interpret,
+    )(qg, kg, vg)
+    return (
+        out.reshape(b, hkv, groups, t, d)
+        .transpose(0, 3, 1, 2, 4)
+        .reshape(b, t, h, d)
+    )
