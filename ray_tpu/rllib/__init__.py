@@ -1,0 +1,10 @@
+"""ray_tpu.rllib — RL training: env-runner actors + jax learner.
+
+Analog of the reference RLlib core loop (/root/reference/rllib/algorithms/
+algorithm.py + core/learner/learner_group.py + EnvRunnerGroup): parallel
+env-runner actors collect rollouts under the current policy; a jitted
+learner applies GAE + the PPO clipped surrogate with optax. Model compute is
+pure jax (pjit-able for larger policies).
+"""
+from .cartpole import CartPoleEnv  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401

@@ -1,0 +1,252 @@
+"""PPO: env-runner actors + jitted clipped-surrogate learner."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from .cartpole import CartPoleEnv
+
+
+# -- policy/value MLP (pure-jax pytree) -------------------------------------
+
+
+def init_policy(key, obs_size: int, num_actions: int, hidden: int = 64):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def dense(k, i, o):
+        return {
+            "w": jax.random.normal(k, (i, o), jnp.float32) * (i**-0.5),
+            "b": jnp.zeros((o,), jnp.float32),
+        }
+
+    return {
+        "torso": [dense(k1, obs_size, hidden), dense(k2, hidden, hidden)],
+        "pi": dense(k3, hidden, num_actions),
+        "vf": dense(k4, hidden, 1),
+    }
+
+
+def policy_forward(params, obs):
+    h = obs
+    for layer in params["torso"]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+# -- rollout worker ----------------------------------------------------------
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """Collects one rollout segment per call under given policy params."""
+
+    def __init__(self, env_factory: Callable, seed: int):
+        self.env = env_factory()
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+        self.completed_returns: List[float] = []
+
+    def rollout(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = (
+            [], [], [], [], [], [],
+        )
+        self.completed_returns = []
+        for _ in range(num_steps):
+            logits, value = policy_forward(
+                params, jnp.asarray(self.obs[None])
+            )
+            probs = np.asarray(jax.nn.softmax(logits[0]))
+            action = int(self.rng.choice(len(probs), p=probs / probs.sum()))
+            logp = float(np.log(probs[action] + 1e-9))
+            nobs, reward, term, trunc, _ = self.env.step(action)
+            obs_buf.append(self.obs)
+            act_buf.append(action)
+            rew_buf.append(reward)
+            done_buf.append(term or trunc)
+            logp_buf.append(logp)
+            val_buf.append(float(value[0]))
+            self.episode_return += reward
+            if term or trunc:
+                self.completed_returns.append(self.episode_return)
+                self.episode_return = 0.0
+                self.obs, _ = self.env.reset()
+            else:
+                self.obs = nobs
+        _, last_value = policy_forward(params, jnp.asarray(self.obs[None]))
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "dones": np.asarray(done_buf, np.bool_),
+            "logp": np.asarray(logp_buf, np.float32),
+            "values": np.asarray(val_buf, np.float32),
+            "last_value": float(last_value[0]),
+            "episode_returns": np.asarray(self.completed_returns, np.float32),
+        }
+
+
+# -- learner -----------------------------------------------------------------
+
+
+def compute_gae(rewards, values, dones, last_value, gamma, lam):
+    adv = np.zeros_like(rewards)
+    gae = 0.0
+    next_value = last_value
+    for t in reversed(range(len(rewards))):
+        nonterminal = 0.0 if dones[t] else 1.0
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        gae = delta + gamma * lam * nonterminal * gae
+        adv[t] = gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+@dataclass
+class PPOConfig:
+    env_factory: Callable = CartPoleEnv
+    num_env_runners: int = 2
+    rollout_steps: int = 256          # per runner per iteration
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-3
+    num_sgd_epochs: int = 6
+    minibatch_size: int = 128
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    hidden: int = 64
+    seed: int = 0
+
+
+class PPO:
+    """Algorithm driver (reference Algorithm.train() shape)."""
+
+    def __init__(self, config: PPOConfig = PPOConfig()):
+        self.config = config
+        env = config.env_factory()
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_policy(
+            key, env.observation_size, env.num_actions, config.hidden
+        )
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.runners = [
+            EnvRunner.remote(config.env_factory, config.seed + 100 + i)
+            for i in range(config.num_env_runners)
+        ]
+        self._key = key
+        self.iteration = 0
+
+        cfg = config
+
+        @jax.jit
+        def sgd_step(params, opt_state, batch):
+            def loss_fn(params):
+                logits, values = policy_forward(params, batch["obs"])
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][:, None], 1
+                )[:, 0]
+                ratio = jnp.exp(logp - batch["logp"])
+                adv = batch["advantages"]
+                surr = jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv,
+                )
+                pi_loss = -jnp.mean(surr)
+                vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+                )
+                total = (
+                    pi_loss
+                    + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy
+                )
+                return total, (pi_loss, vf_loss, entropy)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._sgd_step = sgd_step
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: parallel rollouts → GAE → minibatch SGD epochs."""
+        cfg = self.config
+        self.iteration += 1
+        refs = [
+            r.rollout.remote(self.params, cfg.rollout_steps)
+            for r in self.runners
+        ]
+        segments = ray_tpu.get(refs)
+
+        obs, acts, logps, advs, rets, ep_returns = [], [], [], [], [], []
+        for seg in segments:
+            adv, ret = compute_gae(
+                seg["rewards"], seg["values"], seg["dones"],
+                seg["last_value"], cfg.gamma, cfg.gae_lambda,
+            )
+            obs.append(seg["obs"])
+            acts.append(seg["actions"])
+            logps.append(seg["logp"])
+            advs.append(adv)
+            rets.append(ret)
+            ep_returns.extend(seg["episode_returns"].tolist())
+        batch = {
+            "obs": np.concatenate(obs),
+            "actions": np.concatenate(acts),
+            "logp": np.concatenate(logps),
+            "advantages": np.concatenate(advs),
+            "returns": np.concatenate(rets),
+        }
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(batch["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        loss = pi_loss = vf_loss = entropy = 0.0
+        for _ in range(cfg.num_sgd_epochs):
+            order = rng.permutation(n)
+            for i in range(0, n, cfg.minibatch_size):
+                idx = order[i : i + cfg.minibatch_size]
+                mb = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
+                self.params, self.opt_state, loss, aux = self._sgd_step(
+                    self.params, self.opt_state, mb
+                )
+                pi_loss, vf_loss, entropy = aux
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(ep_returns)) if ep_returns else float("nan")
+            ),
+            "num_episodes": len(ep_returns),
+            "num_env_steps": n,
+            "total_loss": float(loss),
+            "policy_loss": float(pi_loss),
+            "vf_loss": float(vf_loss),
+            "entropy": float(entropy),
+        }
+
+    def save(self, path: str):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        return Checkpoint.from_state({"params": self.params}, path)
+
+    def restore(self, path: str):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        self.params = Checkpoint(path).load_state()["params"]

@@ -1,0 +1,42 @@
+"""RL: env correctness + PPO learning signal on CartPole."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig, CartPoleEnv
+
+
+def test_cartpole_dynamics():
+    env = CartPoleEnv(seed=0)
+    obs, _ = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    for _ in range(600):
+        obs, r, term, trunc, _ = env.step(1)
+        total += r
+        if term or trunc:
+            break
+    assert term  # constant action falls over
+    assert 5 < total < 200
+
+
+def test_ppo_improves_on_cartpole(tmp_path):
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4, "memory": 1e9})
+    try:
+        algo = PPO(PPOConfig(num_env_runners=2, rollout_steps=256, seed=3))
+        first = algo.train()
+        assert first["num_env_steps"] == 512
+        early = first["episode_return_mean"]
+        last = None
+        for _ in range(7):
+            last = algo.train()
+        # learning signal: later mean return beats the first iteration's
+        assert last["episode_return_mean"] > early + 10, (early, last)
+        # checkpoint round trip
+        ckpt = algo.save(str(tmp_path / "ppo_ckpt"))
+        algo2 = PPO(PPOConfig(num_env_runners=1, rollout_steps=64))
+        algo2.restore(str(tmp_path / "ppo_ckpt"))
+        r = algo2.train()
+        assert np.isfinite(r["total_loss"])
+    finally:
+        ray_tpu.shutdown()
