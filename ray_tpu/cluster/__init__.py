@@ -1,0 +1,32 @@
+"""Distributed multi-process runtime: head (GCS analog), node agents
+(raylet analog), worker subprocesses, and the driver client — over gRPC.
+
+Lazy exports keep worker-subprocess startup light (head/agent pull in the
+scheduler kernels; workers only need rpc + common).
+"""
+from typing import Any
+
+_EXPORTS = {
+    "RemoteRuntime": ("ray_tpu.cluster.client", "RemoteRuntime"),
+    "connect": ("ray_tpu.cluster.client", "connect"),
+    "Cluster": ("ray_tpu.cluster.cluster_utils", "Cluster"),
+    "HeadServer": ("ray_tpu.cluster.head", "HeadServer"),
+    "NodeAgent": ("ray_tpu.cluster.agent", "NodeAgent"),
+    "LeaseRequest": ("ray_tpu.cluster.common", "LeaseRequest"),
+    "NodeInfo": ("ray_tpu.cluster.common", "NodeInfo"),
+    "RpcClient": ("ray_tpu.cluster.rpc", "RpcClient"),
+    "RpcServer": ("ray_tpu.cluster.rpc", "RpcServer"),
+    "RpcError": ("ray_tpu.cluster.rpc", "RpcError"),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

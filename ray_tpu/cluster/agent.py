@@ -1,0 +1,652 @@
+"""Node agent: the per-node runtime (raylet analog).
+
+One process per node, the equivalent of the reference's raylet
+(/root/reference/src/ray/raylet/node_manager.h:140): it owns the node's
+authoritative resource ledger (grant-or-reject admission,
+local_lease_manager.h:39-61), a pool of worker subprocesses
+(worker_pool.h), the node's shared-memory object store (the plasma
+store runs inside the raylet process — plasma/store_runner.h:28), and
+object pulls from remote nodes (pull_manager.h). It heartbeats resource
+snapshots to the head (raylet_report_resources_period_milliseconds=100).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.scheduler import NodeResourceLedger, ResourceRequest, ResourceVocab
+
+from .common import (
+    REPORT_PERIOD_S,
+    LeaseRequest,
+    NodeInfo,
+    NodeReport,
+    SealInfo,
+    new_id,
+)
+from .rpc import RpcClient, RpcError, RpcServer
+
+logger = logging.getLogger("ray_tpu.cluster.agent")
+
+_EPS = 1e-9
+
+
+class _MemStore:
+    """Fallback object store when the native shm arena can't build."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put_bytes(self, oid: str, data: bytes) -> None:
+        with self._lock:
+            self._data[oid] = data
+
+    def get_bytes(self, oid: str) -> bytes:
+        with self._lock:
+            return self._data[oid]
+
+    def contains(self, oid: str) -> bool:
+        with self._lock:
+            return oid in self._data
+
+    def delete(self, oid: str) -> None:
+        with self._lock:
+            self._data.pop(oid, None)
+
+    def close(self, unlink: bool = False) -> None:
+        self._data.clear()
+
+
+class _WorkerHandle:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.client: Optional[RpcClient] = None
+        self.ready = threading.Event()
+        self.actor_id: Optional[str] = None  # pinned for an actor
+        self.lock = threading.Lock()  # serializes pushes (actor ordering)
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        head_address: str,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+        host: str = "127.0.0.1",
+        num_workers: Optional[int] = None,
+        store_capacity: int = 1 << 28,
+        node_id: Optional[str] = None,
+    ):
+        self.node_id = node_id or new_id()
+        self.head_address = head_address
+        self.head = RpcClient(head_address)
+        self.vocab = ResourceVocab()
+        self.ledger = NodeResourceLedger(self.vocab, resources)
+        self.resources = dict(resources)
+        self.labels = dict(labels or {})
+        self._lock = threading.RLock()
+        self._shutdown = False
+
+        # --- object store (plasma-in-raylet analog) ---
+        self.store_path = os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_store_{self.node_id}.shm"
+        )
+        try:
+            from ray_tpu.native import NativeObjectStore
+
+            self.store = NativeObjectStore(
+                path=self.store_path, capacity=store_capacity
+            )
+        except Exception:  # noqa: BLE001 - toolchain missing
+            logger.warning("native store unavailable; using in-memory store")
+            self.store = _MemStore()
+            self.store_path = ""
+
+        # --- bundle (placement group) reservations ---
+        # pg_id -> {"state": prepared|committed, "bundles": {idx: avail_map}}
+        self._bundles: Dict[str, dict] = {}
+
+        # --- RPC surface ---
+        handlers = {
+            "ExecuteLease": self._h_execute_lease,
+            "StoreObject": self._h_store_object,
+            "FetchObject": self._h_fetch_object,
+            "DeleteObjects": self._h_delete_objects,
+            "GetObjectForWorker": self._h_get_object_for_worker,
+            "WorkerPut": self._h_worker_put,
+            "WorkerSealed": self._h_worker_sealed,
+            "RegisterWorker": self._h_register_worker,
+            "PrepareBundles": self._h_prepare_bundles,
+            "CommitBundles": self._h_commit_bundles,
+            "RollbackBundles": self._h_rollback_bundles,
+            "ReturnBundles": self._h_return_bundles,
+            "KillActor": self._h_kill_actor,
+            "Shutdown": self._h_shutdown,
+            "Ping": lambda r: "pong",
+        }
+        self._server = RpcServer(handlers, host=host, port=0)
+        self.address = self._server.address
+
+        # --- worker pool (worker_pool.h analog) ---
+        if num_workers is None:
+            num_workers = max(2, min(int(resources.get("CPU", 2)), 8))
+        self._workers: Dict[str, _WorkerHandle] = {}
+        self._idle: List[str] = []
+        self._idle_cv = threading.Condition(self._lock)
+        self._actor_workers: Dict[str, str] = {}  # actor_id -> worker_id
+        self._actor_allocs: Dict[str, Any] = {}  # actor_id -> held lease alloc
+        self._num_workers = num_workers
+        for _ in range(num_workers):
+            self._spawn_worker()
+
+        # remote-fetch client cache (peer addresses come from head lookups)
+        self._peer_clients: Dict[str, RpcClient] = {}
+
+        self._exec_pool = ThreadPoolExecutor(
+            max_workers=num_workers + 4, thread_name_prefix=f"agent-{self.node_id[:6]}"
+        )
+
+        reply = self.head.call(
+            "RegisterNode",
+            NodeInfo(
+                node_id=self.node_id,
+                address=self.address,
+                resources=dict(resources),
+                labels=self.labels,
+            ),
+            retries=30,
+            retry_interval=0.2,
+        )
+        assert reply["node_id"] == self.node_id
+        self._report_thread = threading.Thread(
+            target=self._report_loop, name="agent-report", daemon=True
+        )
+        self._report_thread.start()
+
+    # ------------------------------------------------------------------
+    # worker pool
+    # ------------------------------------------------------------------
+    def _spawn_worker(self) -> _WorkerHandle:
+        worker_id = new_id()
+        env = dict(os.environ)
+        env["RAY_TPU_HEAD_ADDRESS"] = self.head_address
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.cluster.worker",
+                "--agent",
+                self.address,
+                "--worker-id",
+                worker_id,
+                "--store",
+                self.store_path,
+            ],
+            env=env,
+        )
+        handle = _WorkerHandle(worker_id, proc)
+        with self._lock:
+            self._workers[worker_id] = handle
+        return handle
+
+    def _h_register_worker(self, req: dict) -> dict:
+        with self._idle_cv:
+            handle = self._workers.get(req["worker_id"])
+            if handle is None:
+                return {"ok": False}
+            handle.client = RpcClient(req["address"])
+            handle.ready.set()
+            self._idle.append(handle.worker_id)
+            self._idle_cv.notify_all()
+        return {"ok": True, "node_id": self.node_id}
+
+    def _pop_idle_worker(self, timeout: float = 60.0) -> Optional[_WorkerHandle]:
+        deadline = time.monotonic() + timeout
+        with self._idle_cv:
+            while not self._idle:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._shutdown:
+                    return None
+                self._idle_cv.wait(timeout=min(remaining, 0.5))
+            return self._workers[self._idle.pop()]
+
+    def _return_worker(self, handle: _WorkerHandle) -> None:
+        with self._idle_cv:
+            if handle.actor_id is None and handle.worker_id in self._workers:
+                self._idle.append(handle.worker_id)
+                self._idle_cv.notify_all()
+
+    def _on_worker_death(self, handle: _WorkerHandle, running: List[LeaseRequest]) -> None:
+        """A worker process died (socket/process detection in worker_pool.cc)."""
+        with self._idle_cv:
+            self._workers.pop(handle.worker_id, None)
+            if handle.worker_id in self._idle:
+                self._idle.remove(handle.worker_id)
+            actor_id = handle.actor_id
+            if actor_id:
+                self._actor_workers.pop(actor_id, None)
+                self._release(self._actor_allocs.pop(actor_id, None))
+        try:
+            handle.proc.kill()
+        except OSError:
+            pass
+        report: Dict[str, Any] = {"node_id": self.node_id}
+        if actor_id:
+            report["actors_dead"] = [
+                {"actor_id": actor_id, "reason": "worker process died"}
+            ]
+        if running:
+            report["failed"] = [
+                {
+                    "task_id": s.task_id,
+                    "reason": f"worker died running {s.name}",
+                    "retryable": s.kind == "task",
+                }
+                for s in running
+            ]
+        self._report_to_head(report)
+        if not self._shutdown and len(self._workers) < self._num_workers:
+            self._spawn_worker()
+
+    # ------------------------------------------------------------------
+    # lease admission + execution
+    # ------------------------------------------------------------------
+    def _h_execute_lease(self, spec: LeaseRequest) -> dict:
+        req = ResourceRequest.from_map(self.vocab, spec.resources)
+        if spec.kind == "actor_method":
+            with self._lock:
+                worker_id = self._actor_workers.get(spec.actor_id)
+                handle = self._workers.get(worker_id) if worker_id else None
+            if handle is None:
+                return {"status": "reject", "available": self.ledger.avail_map()}
+            self._exec_pool.submit(self._run_on_worker, spec, handle, None)
+            return {"status": "granted"}
+        if spec.pg_reservation is not None:
+            if not self._bundle_allocate(spec.pg_reservation, spec.resources):
+                return {"status": "reject", "available": self.ledger.avail_map()}
+            alloc = ("pg", spec.pg_reservation, dict(spec.resources))
+        elif self.ledger.try_allocate(req):
+            alloc = ("ledger", req)
+        else:
+            # stale head view → reject with the authoritative snapshot
+            return {"status": "reject", "available": self.ledger.avail_map()}
+        self._exec_pool.submit(self._dispatch_to_worker, spec, alloc)
+        return {"status": "granted"}
+
+    def _dispatch_to_worker(self, spec: LeaseRequest, alloc) -> None:
+        handle = self._pop_idle_worker()
+        if handle is None:
+            self._release(alloc)
+            self._report_to_head(
+                {
+                    "node_id": self.node_id,
+                    "failed": [
+                        {
+                            "task_id": spec.task_id,
+                            "reason": "no worker available",
+                            "retryable": True,
+                        }
+                    ],
+                }
+            )
+            return
+        if spec.kind == "actor_creation":
+            with self._lock:
+                handle.actor_id = spec.actor_id
+                self._actor_workers[spec.actor_id] = handle.worker_id
+            # an actor pins its worker for life; backfill the pool
+            if len(self._workers) <= self._num_workers:
+                self._spawn_worker()
+        self._run_on_worker(spec, handle, alloc)
+
+    def _run_on_worker(
+        self, spec: LeaseRequest, handle: _WorkerHandle, alloc
+    ) -> None:
+        try:
+            with handle.lock:  # per-worker ordering (actor sequential exec)
+                reply = handle.client.call(
+                    "PushTask",
+                    {
+                        "task_id": spec.task_id,
+                        "kind": spec.kind,
+                        "actor_id": spec.actor_id,
+                        "payload": spec.payload,
+                        "return_ids": spec.return_ids,
+                        "name": spec.name,
+                        "runtime_env": spec.runtime_env,
+                        "retry_exceptions": (
+                            spec.retry_exceptions
+                            and spec.attempt < spec.max_retries
+                        ),
+                    },
+                    timeout=None,
+                )
+        except RpcError:
+            self._release(alloc)
+            if not self._shutdown:
+                self._on_worker_death(handle, [spec])
+            return
+        status = reply.get("status")
+        if spec.kind == "actor_creation" and status == "ok":
+            # a live actor holds its lease resources for its lifetime
+            # (GcsActorScheduler lease semantics); released on death/kill.
+            with self._lock:
+                self._actor_allocs[spec.actor_id] = alloc
+        else:
+            self._release(alloc)
+        report: Dict[str, Any] = {
+            "node_id": self.node_id,
+            "available": self.ledger.avail_map(),
+            "finished": [spec.task_id],
+        }
+        if status == "retry":
+            report.pop("finished")
+            report["failed"] = [
+                {
+                    "task_id": spec.task_id,
+                    "reason": reply.get("error_repr", "task raised"),
+                    "retryable": True,
+                }
+            ]
+        else:
+            report["seals"] = reply.get("seals", [])
+            if spec.kind == "actor_creation" and status == "ok":
+                report["actors_alive"] = [
+                    {
+                        "actor_id": spec.actor_id,
+                        "node_id": self.node_id,
+                        "address": self.address,
+                    }
+                ]
+            elif spec.kind == "actor_creation":
+                report["actors_dead"] = [
+                    {
+                        "actor_id": spec.actor_id,
+                        "reason": reply.get("error_repr", "init failed"),
+                    }
+                ]
+        if spec.kind != "actor_method" and spec.kind != "actor_creation":
+            self._return_worker(handle)
+        elif spec.kind == "actor_method":
+            pass  # pinned worker stays with the actor
+        self._report_to_head(report)
+
+    def _release(self, alloc) -> None:
+        if alloc is None:
+            return
+        if alloc[0] == "ledger":
+            self.ledger.release(alloc[1])
+        else:
+            self._bundle_release(alloc[1], alloc[2])
+
+    # ------------------------------------------------------------------
+    # placement-group bundles (PlacementGroupResourceManager analog,
+    # raylet/placement_group_resource_manager.cc)
+    # ------------------------------------------------------------------
+    def _h_prepare_bundles(self, req: dict) -> dict:
+        pg_id, bundles = req["pg_id"], req["bundles"]
+        agg: Dict[str, float] = {}
+        for b in bundles.values():
+            for k, v in b.items():
+                agg[k] = agg.get(k, 0.0) + float(v)
+        r = ResourceRequest.from_map(self.vocab, agg)
+        if not self.ledger.try_allocate(r):
+            return {"ok": False}
+        with self._lock:
+            self._bundles[pg_id] = {
+                "state": "prepared",
+                "agg": agg,
+                "bundles": {int(i): dict(b) for i, b in bundles.items()},
+            }
+        return {"ok": True}
+
+    def _h_commit_bundles(self, req: dict) -> None:
+        with self._lock:
+            entry = self._bundles.get(req["pg_id"])
+            if entry is not None:
+                entry["state"] = "committed"
+
+    def _h_rollback_bundles(self, req: dict) -> None:
+        self._h_return_bundles(req)
+
+    def _h_return_bundles(self, req: dict) -> None:
+        with self._lock:
+            entry = self._bundles.pop(req["pg_id"], None)
+        if entry is not None:
+            self.ledger.release(
+                ResourceRequest.from_map(self.vocab, entry["agg"])
+            )
+
+    def _bundle_allocate(self, reservation, resources: Dict[str, float]) -> bool:
+        pg_id, idx = reservation
+        with self._lock:
+            entry = self._bundles.get(pg_id)
+            if entry is None:
+                return False
+            bundle = entry["bundles"].get(int(idx))
+            if bundle is None:
+                return False
+            for k, v in resources.items():
+                if bundle.get(k, 0.0) < v - _EPS:
+                    return False
+            for k, v in resources.items():
+                bundle[k] = bundle.get(k, 0.0) - v
+            return True
+
+    def _bundle_release(self, reservation, resources: Dict[str, float]) -> None:
+        pg_id, idx = reservation
+        with self._lock:
+            entry = self._bundles.get(pg_id)
+            if entry is None:
+                return
+            bundle = entry["bundles"].get(int(idx))
+            if bundle is None:
+                return
+            for k, v in resources.items():
+                bundle[k] = bundle.get(k, 0.0) + v
+
+    # ------------------------------------------------------------------
+    # object plane
+    # ------------------------------------------------------------------
+    def _h_store_object(self, req: dict) -> None:
+        self.store.put_bytes(req["object_id"], req["data"])
+
+    def _h_fetch_object(self, req: dict) -> bytes:
+        return self.store.get_bytes(req["object_id"])
+
+    def _h_delete_objects(self, req: dict) -> None:
+        for oid in req["object_ids"]:
+            try:
+                self.store.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _h_worker_put(self, req: dict) -> None:
+        """Worker fallback put when the shm arena is unavailable/full."""
+        self.store.put_bytes(req["object_id"], req["data"])
+
+    def _h_worker_sealed(self, req: dict) -> None:
+        """Out-of-band seal from a worker (ray_tpu.put inside a task)."""
+        self._report_to_head(
+            {"node_id": self.node_id, "seals": req["seals"]}
+        )
+
+    def _h_get_object_for_worker(self, req: dict) -> dict:
+        """Local miss → pull from a remote node (PullManager analog,
+        object_manager/pull_manager.h:40): locate via head, fetch chunked
+        from the peer agent, cache into the local store."""
+        oid = req["object_id"]
+        if self.store.contains(oid):
+            return self._local_reply(oid)
+        deadline = time.monotonic() + (req.get("timeout") or 60.0)
+        while time.monotonic() < deadline:
+            reply = self.head.call(
+                "WaitObject",
+                {"object_id": oid, "timeout": 2.0},
+                timeout=15.0,
+            )
+            status = reply["status"]
+            if status == "error":
+                return {"status": "error", "error": reply["error"]}
+            if status == "inline":
+                return {"status": "inline", "data": reply["data"]}
+            if status == "located":
+                for nid, addr in reply["locations"]:
+                    if nid == self.node_id:
+                        if self.store.contains(oid):
+                            return self._local_reply(oid)
+                        continue
+                    try:
+                        data = self._peer(nid, addr).call(
+                            "FetchObject", {"object_id": oid}, timeout=60.0
+                        )
+                    except (RpcError, KeyError):
+                        continue
+                    try:
+                        self.store.put_bytes(oid, data)
+                        # advertise the new copy (object directory update)
+                        self._report_to_head(
+                            {
+                                "node_id": self.node_id,
+                                "seals": [
+                                    SealInfo(
+                                        object_id=oid,
+                                        node_id=self.node_id,
+                                        size=len(data),
+                                    )
+                                ],
+                            }
+                        )
+                        return self._local_reply(oid)
+                    except Exception:  # noqa: BLE001 - arena full
+                        return {"status": "inline", "data": data}
+        return {"status": "timeout"}
+
+    def _local_reply(self, oid: str) -> dict:
+        """Workers read 'local' objects straight from the shm arena; with the
+        in-memory fallback store (no shared pages) ship the bytes inline."""
+        if self.store_path:
+            return {"status": "local"}
+        return {"status": "inline", "data": self.store.get_bytes(oid)}
+
+    def _peer(self, node_id: str, address: str) -> RpcClient:
+        with self._lock:
+            client = self._peer_clients.get(node_id)
+            if client is None or client.address != address:
+                client = RpcClient(address)
+                self._peer_clients[node_id] = client
+            return client
+
+    # ------------------------------------------------------------------
+    # reporting (RaySyncer RESOURCE_VIEW analog)
+    # ------------------------------------------------------------------
+    def _report_to_head(self, report: Dict[str, Any]) -> None:
+        try:
+            self.head.call("ReportSeals", report, timeout=10.0)
+        except RpcError:
+            logger.warning("head unreachable; dropping report")
+
+    def _report_loop(self) -> None:
+        version = 0
+        while not self._shutdown:
+            time.sleep(REPORT_PERIOD_S)
+            version += 1
+            # respawn workers that died outside a push (including ones that
+            # crashed at startup before ever registering)
+            with self._lock:
+                dead = [
+                    h
+                    for h in self._workers.values()
+                    if h.proc.poll() is not None
+                ]
+            for h in dead:
+                self._on_worker_death(h, [])
+            try:
+                self.head.call(
+                    "NodeReport",
+                    NodeReport(
+                        node_id=self.node_id,
+                        available=self.ledger.avail_map(),
+                        version=version,
+                    ),
+                    timeout=5.0,
+                )
+            except RpcError:
+                continue
+
+    # ------------------------------------------------------------------
+    # actor + lifecycle control
+    # ------------------------------------------------------------------
+    def _h_kill_actor(self, req: dict) -> None:
+        with self._lock:
+            worker_id = self._actor_workers.pop(req["actor_id"], None)
+            handle = self._workers.pop(worker_id, None) if worker_id else None
+            self._release(self._actor_allocs.pop(req["actor_id"], None))
+        if handle is not None:
+            try:
+                handle.proc.kill()
+            except OSError:
+                pass
+            if not self._shutdown:
+                self._spawn_worker()
+
+    def _h_shutdown(self, req=None) -> None:
+        threading.Thread(target=self.shutdown, daemon=True).start()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._idle_cv:
+            self._idle_cv.notify_all()
+        for handle in list(self._workers.values()):
+            try:
+                handle.proc.terminate()
+            except OSError:
+                pass
+        self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        try:
+            self.store.close(unlink=True)
+        except Exception:  # noqa: BLE001
+            pass
+        self._server.stop()
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="ray_tpu node agent")
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--resources", default='{"CPU": 4}')
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--num-workers", type=int, default=None)
+    parser.add_argument("--node-id", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    agent = NodeAgent(
+        head_address=args.head,
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        num_workers=args.num_workers,
+        node_id=args.node_id,
+    )
+    print(f"ray_tpu agent {agent.node_id} listening on {agent.address}", flush=True)
+    try:
+        while not agent._shutdown:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        agent.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,387 @@
+"""Driver-side client runtime for a distributed ray_tpu cluster.
+
+``ray_tpu.init(address="host:port")`` swaps the in-process Runtime for a
+``RemoteRuntime`` — the same duck-typed surface the public API calls
+(submit / put_object / get_object / wait / actors / PGs), but every
+operation is an RPC to the head or a node agent. This is the moral
+equivalent of the reference driver's CoreWorker connecting to the GCS
+and raylets (/root/reference/python/ray/_private/worker.py:1406), and it
+doubles as the Ray-Client analog (util/client/) since a driver can be
+anywhere with connectivity to the cluster.
+"""
+from __future__ import annotations
+
+import inspect
+import pickle
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.core.object_store import GetTimeoutError, ObjectRef
+from ray_tpu.core.runtime import TaskSpec
+
+from .common import INLINE_OBJECT_MAX, LeaseRequest, new_id
+from .rpc import RpcClient, RpcError
+
+_BY_VALUE_REGISTERED: set = set()
+
+
+def _ship_module_by_value(obj: Any) -> None:
+    """User code living outside site-packages (driver scripts, test files)
+    isn't importable on workers — pickle its module by value (the reference
+    ships the function definition in the task spec the same way)."""
+    try:
+        mod = inspect.getmodule(obj)
+        if mod is None:
+            return
+        name = getattr(mod, "__name__", "")
+        if name in _BY_VALUE_REGISTERED or name == "__main__":
+            if name == "__main__":
+                return  # cloudpickle already serializes __main__ by value
+            return
+        f = getattr(mod, "__file__", None)
+        if not f:
+            return
+        if (
+            "site-packages" in f
+            or "/ray_tpu/" in f
+            or f.startswith(sys.prefix)
+            or f.startswith(getattr(sys, "base_prefix", sys.prefix))
+        ):
+            return
+        cloudpickle.register_pickle_by_value(mod)
+        _BY_VALUE_REGISTERED.add(name)
+    except Exception:  # noqa: BLE001 - best-effort
+        pass
+
+
+class _RemoteStore:
+    """ray.wait support against the head's object directory."""
+
+    def __init__(self, runtime: "RemoteRuntime"):
+        self._rt = runtime
+
+    def wait_many(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            still: List[ObjectRef] = []
+            for r in pending:
+                if len(ready) >= num_returns:
+                    still.append(r)
+                    continue
+                remaining = 0.05
+                if deadline is not None:
+                    remaining = min(remaining, max(0.0, deadline - time.monotonic()))
+                reply = self._rt.head.call(
+                    "WaitObject",
+                    {"object_id": r.hex, "timeout": remaining},
+                    timeout=15.0,
+                )
+                if reply["status"] != "pending":
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        return ready, pending
+
+
+class RemotePlacementGroup:
+    """Driver-side PG handle for cluster mode (util/placement_group.py
+    analog); picklable — it carries only ids/specs."""
+
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            get_runtime().wait_placement_group(self.id, timeout=timeout_seconds)
+            return True
+        except TimeoutError:
+            return False
+
+    def __repr__(self) -> str:
+        return f"RemotePlacementGroup({self.id[:8]}, {self.strategy})"
+
+
+class RemoteActorHandle:
+    def __init__(self, runtime: "RemoteRuntime", actor_id: str, cls: type):
+        self._runtime = runtime
+        self._actor_id = actor_id
+        self._cls = cls
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _RemoteMethod(self._runtime, self._actor_id, name)
+
+    def __reduce__(self):
+        return (_rebuild_actor_handle, (self._actor_id, self._cls))
+
+
+def _rebuild_actor_handle(actor_id: str, cls: type):
+    from ray_tpu.core.runtime import get_runtime
+
+    return RemoteActorHandle(get_runtime(), actor_id, cls)
+
+
+class _RemoteMethod:
+    def __init__(self, runtime: "RemoteRuntime", actor_id: str, method: str):
+        self._runtime = runtime
+        self._actor_id = actor_id
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._runtime.submit_actor_method(
+            self._actor_id, self._method, args, kwargs
+        )
+
+
+class RemoteRuntime:
+    """Duck-typed Runtime whose backend is a live cluster."""
+
+    is_remote = True
+
+    def __init__(self, address: str, runtime_env: Optional[dict] = None):
+        self.address = address
+        self.head = RpcClient(address)
+        self.head.call("Ping", timeout=10.0, retries=20, retry_interval=0.25)
+        self.runtime_env = runtime_env
+        self._agents: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+        self.store = _RemoteStore(self)
+        self.metrics: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # tasks
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        _ship_module_by_value(spec.func)
+        lease = LeaseRequest(
+            task_id=spec.task_id,
+            name=spec.name,
+            payload=cloudpickle.dumps((spec.func, spec.args, spec.kwargs)),
+            return_ids=[r.hex for r in spec.returns],
+            resources=spec.resources,
+            kind="task",
+            max_retries=spec.max_retries,
+            retry_exceptions=spec.retry_exceptions,
+            strategy=spec.strategy,
+            runtime_env=self.runtime_env,
+        )
+        self.head.call("SubmitLease", lease)
+        return spec.returns
+
+    def submit_actor_method(
+        self, actor_id: str, method: str, args: tuple, kwargs: dict
+    ) -> ObjectRef:
+        ref = ObjectRef.new(owner=actor_id)
+        lease = LeaseRequest(
+            task_id=new_id(),
+            name=f"{actor_id[:8]}.{method}",
+            payload=cloudpickle.dumps((method, args, kwargs)),
+            return_ids=[ref.hex],
+            resources={},
+            kind="actor_method",
+            actor_id=actor_id,
+            max_retries=0,
+        )
+        self.head.call("SubmitLease", lease)
+        return ref
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(
+        self,
+        cls: type,
+        args: tuple,
+        kwargs: dict,
+        *,
+        resources: Dict[str, float],
+        name: Optional[str] = None,
+        max_restarts: int = 0,
+        scheduling_strategy: Any = None,
+        **_ignored,
+    ) -> RemoteActorHandle:
+        _ship_module_by_value(cls)
+        actor_id = new_id()
+        lease = LeaseRequest(
+            task_id=new_id(),
+            name=f"{cls.__name__}.__init__",
+            payload=cloudpickle.dumps((cls, args, kwargs)),
+            return_ids=[],
+            resources=resources,
+            kind="actor_creation",
+            actor_id=actor_id,
+            max_retries=0,
+            strategy=scheduling_strategy,
+            runtime_env=self.runtime_env,
+        )
+        self.head.call(
+            "CreateActor",
+            {
+                "spec": lease,
+                "name": name,
+                "class_name": cls.__name__,
+                "max_restarts": max_restarts,
+            },
+        )
+        return RemoteActorHandle(self, actor_id, cls)
+
+    def get_actor(self, name: str) -> RemoteActorHandle:
+        info = self.head.call("GetActor", {"name": name})
+        return RemoteActorHandle(self, info.actor_id, object)
+
+    def kill_actor(self, handle: RemoteActorHandle, no_restart: bool = True) -> None:
+        self.head.call(
+            "KillActor", {"actor_id": handle._actor_id, "no_restart": no_restart}
+        )
+
+    def wait_actor_alive(self, handle: RemoteActorHandle, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.head.call("GetActor", {"actor_id": handle._actor_id})
+            if info.state == "ALIVE":
+                return info
+            if info.state == "DEAD":
+                raise RuntimeError(f"actor {handle._actor_id} died during creation")
+            time.sleep(0.05)
+        raise TimeoutError("actor did not become alive in time")
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+    def put_object(self, value: Any) -> ObjectRef:
+        ref = ObjectRef.new(owner="driver")
+        data = cloudpickle.dumps(value)
+        self.head.call("PutObject", {"object_id": ref.hex, "data": data})
+        return ref
+
+    def get_object(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            poll = 2.0
+            if deadline is not None:
+                poll = min(poll, max(0.0, deadline - time.monotonic()))
+            reply = self.head.call(
+                "WaitObject", {"object_id": ref.hex, "timeout": poll}, timeout=30.0
+            )
+            status = reply["status"]
+            if status == "inline":
+                return pickle.loads(reply["data"])
+            if status == "error":
+                raise pickle.loads(reply["error"])
+            if status == "located":
+                for nid, addr in reply["locations"]:
+                    try:
+                        data = self._agent(nid, addr).call(
+                            "FetchObject", {"object_id": ref.hex}, timeout=120.0
+                        )
+                        return pickle.loads(data)
+                    except (RpcError, KeyError):
+                        continue
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+
+    def free_objects(self, refs: List[ObjectRef]) -> None:
+        self.head.call("FreeObjects", {"object_ids": [r.hex for r in refs]})
+
+    def _agent(self, node_id: str, address: str) -> RpcClient:
+        with self._lock:
+            client = self._agents.get(node_id)
+            if client is None or client.address != address:
+                client = RpcClient(address)
+                self._agents[node_id] = client
+            return client
+
+    # ------------------------------------------------------------------
+    # placement groups
+    # ------------------------------------------------------------------
+    def create_placement_group(
+        self, bundles: List[Dict[str, float]], strategy: str = "PACK"
+    ) -> str:
+        reply = self.head.call(
+            "CreatePlacementGroup", {"bundles": bundles, "strategy": strategy}
+        )
+        return reply["pg_id"]
+
+    def wait_placement_group(self, pg_id: str, timeout: float = 30.0) -> List[str]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            reply = self.head.call(
+                "WaitPlacementGroup", {"pg_id": pg_id, "timeout": 2.0}
+            )
+            if reply["ready"]:
+                return reply["node_per_bundle"]
+            time.sleep(0.05)
+        raise TimeoutError(f"placement group {pg_id} not ready in {timeout}s")
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        self.head.call("RemovePlacementGroup", {"pg_id": pg_id})
+
+    # ------------------------------------------------------------------
+    # kv + introspection
+    # ------------------------------------------------------------------
+    def kv_put(self, key: str, value: bytes) -> None:
+        self.head.call("KvPut", {"key": key, "value": value})
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.head.call("KvGet", {"key": key})
+
+    def kv_del(self, key: str) -> None:
+        self.head.call("KvDel", {"key": key})
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.head.call("KvKeys", {"prefix": prefix})
+
+    def nodes_info(self) -> List[Dict[str, Any]]:
+        return self.head.call("ClusterInfo")["nodes"]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.nodes_info():
+            if not n["Alive"]:
+                continue
+            for k, v in n["Resources"].items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.nodes_info():
+            if not n["Alive"]:
+                continue
+            for k, v in n["Available"].items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def query_state(self, kind: str = "summary") -> Any:
+        return self.head.call("QueryState", {"kind": kind})
+
+    def shutdown(self) -> None:
+        self.head.close()
+        with self._lock:
+            for client in self._agents.values():
+                client.close()
+            self._agents.clear()
+
+
+def connect(address: str, runtime_env: Optional[dict] = None) -> RemoteRuntime:
+    return RemoteRuntime(address, runtime_env=runtime_env)

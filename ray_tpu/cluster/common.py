@@ -1,0 +1,91 @@
+"""Shared control-plane message types for the distributed runtime.
+
+These are the moral equivalent of the reference's protobuf messages
+(/root/reference/src/ray/protobuf/common.proto, gcs_service.proto,
+node_manager.proto) — dataclasses shipped over the generic gRPC layer.
+"""
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# Values at or below this ride inline through the head's object table
+# (max_direct_call_object_size analog, ray_config_def.h:218).
+INLINE_OBJECT_MAX = 100 * 1024
+
+# Resource report cadence (raylet_report_resources_period_milliseconds=100,
+# ray_config_def.h:65) and health-check strikes (gcs_health_check_manager.h:60).
+REPORT_PERIOD_S = 0.1
+HEALTH_TIMEOUT_S = 3.0
+
+
+def new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    address: str  # agent RPC address
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+
+
+@dataclass
+class LeaseRequest:
+    """A task / actor-creation / actor-method lease (LeaseSpecification
+    analog, src/ray/common/lease/)."""
+
+    task_id: str
+    name: str
+    payload: bytes  # cloudpickled (func, args, kwargs)
+    return_ids: List[str]
+    resources: Dict[str, float]
+    kind: str = "task"  # task | actor_creation | actor_method
+    actor_id: Optional[str] = None
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    attempt: int = 0
+    strategy: Any = None
+    runtime_env: Optional[dict] = None
+    # set by the head when routing:
+    target_node: Optional[str] = None
+    pg_reservation: Optional[Tuple[str, int]] = None  # (pg_id, bundle_idx)
+
+
+@dataclass
+class SealInfo:
+    """Worker -> agent -> head: an object became available."""
+
+    object_id: str
+    node_id: str
+    size: int = 0
+    inline_value: Optional[bytes] = None  # pickled value if small
+    is_error: bool = False
+    error: Optional[bytes] = None  # pickled exception
+
+
+@dataclass
+class NodeReport:
+    """Agent -> head periodic report (RaySyncer RESOURCE_VIEW analog,
+    src/ray/ray_syncer/ray_syncer.h:81)."""
+
+    node_id: str
+    available: Dict[str, float]
+    seals: List[SealInfo] = field(default_factory=list)
+    finished_leases: List[str] = field(default_factory=list)
+    version: int = 0
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    name: Optional[str]
+    node_id: Optional[str] = None
+    address: Optional[str] = None  # agent address hosting the actor
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    class_name: str = ""
+    max_restarts: int = 0
+    num_restarts: int = 0

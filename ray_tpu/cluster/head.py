@@ -1,0 +1,979 @@
+"""Head server: the cluster control plane (GCS analog).
+
+One process per cluster, the equivalent of the reference's ``gcs_server``
+(/root/reference/src/ray/gcs/gcs_server.h:255-319): node membership + health
+checks, the object directory, the actor directory, placement groups with
+2-phase commit, an internal KV store — and, unlike the reference, the *task*
+scheduler too: every lease in the cluster is placed here by the batched
+JAX hybrid kernel over the dense global resource view (the north-star
+design — the raylet's per-request ``ScheduleAndGrantLeases`` scan,
+cluster_lease_manager.cc:196, becomes one batched kernel call per round).
+Agents keep authoritative per-node ledgers and grant-or-reject, so a stale
+view degrades into spillback-and-retry exactly like the reference
+(local_lease_manager.h:39-61).
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.scheduler import (
+    ClusterView,
+    HybridConfig,
+    ResourceRequest,
+    ResourceVocab,
+    hybrid_schedule_reference,
+    schedule_bundles,
+)
+from ray_tpu.scheduler import hybrid as hybrid_mod
+
+from .common import (
+    HEALTH_TIMEOUT_S,
+    INLINE_OBJECT_MAX,
+    ActorInfo,
+    LeaseRequest,
+    NodeInfo,
+    NodeReport,
+    SealInfo,
+    new_id,
+)
+from .rpc import RpcClient, RpcError, RpcServer
+
+logger = logging.getLogger("ray_tpu.cluster.head")
+
+SCHED_TICK_S = 0.002
+MAX_BATCH = 4096
+DEVICE_KERNEL_MIN_BATCH = 64
+
+
+@dataclass
+class _ObjEntry:
+    """Object-directory row (ownership_object_directory analog)."""
+
+    event: threading.Event = field(default_factory=threading.Event)
+    inline: Optional[bytes] = None
+    error: Optional[bytes] = None
+    locations: set = field(default_factory=set)
+    size: int = 0
+    creating_lease: Optional[str] = None
+
+
+@dataclass
+class _PGState:
+    pg_id: str
+    bundles: List[Dict[str, float]]
+    strategy: str
+    ready: threading.Event = field(default_factory=threading.Event)
+    node_per_bundle: List[str] = field(default_factory=list)
+    removed: bool = False
+
+
+class HeadServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        use_device_scheduler: bool = False,
+    ):
+        self.vocab = ResourceVocab()
+        self.view = ClusterView(self.vocab)
+        self.hybrid_config = HybridConfig()
+        self.use_device_scheduler = use_device_scheduler
+        self._rng = np.random.default_rng(0)
+        self._seed = 0
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self.nodes: Dict[str, NodeInfo] = {}
+        self._clients: Dict[str, RpcClient] = {}
+        self._last_report: Dict[str, float] = {}
+        self._objects: Dict[str, _ObjEntry] = {}
+        self._leases: Dict[str, LeaseRequest] = {}  # lineage: lease_id -> spec
+        self._pending: deque = deque()
+        self._infeasible: List[LeaseRequest] = []
+        self._in_flight: Dict[str, Tuple[LeaseRequest, str]] = {}
+        self._actors: Dict[str, ActorInfo] = {}
+        self._actor_specs: Dict[str, LeaseRequest] = {}
+        self._named_actors: Dict[str, str] = {}
+        self._pgs: Dict[str, _PGState] = {}
+        self._pending_pgs: List[_PGState] = []
+        self._kv: Dict[str, bytes] = {}
+        self._jobs: Dict[str, dict] = {}
+        self._shutdown = False
+        self.metrics: Dict[str, int] = {
+            "leases_submitted": 0,
+            "leases_finished": 0,
+            "leases_spilled_back": 0,
+            "sched_rounds": 0,
+            "nodes_dead": 0,
+        }
+
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="head-dispatch"
+        )
+        handlers = {
+            "RegisterNode": self._h_register_node,
+            "NodeReport": self._h_node_report,
+            "ReportSeals": self._h_report_seals,
+            "SubmitLease": self._h_submit_lease,
+            "PutObject": self._h_put_object,
+            "WaitObject": self._h_wait_object,
+            "FreeObjects": self._h_free_objects,
+            "CreateActor": self._h_create_actor,
+            "GetActor": self._h_get_actor,
+            "KillActor": self._h_kill_actor,
+            "CreatePlacementGroup": self._h_create_pg,
+            "WaitPlacementGroup": self._h_wait_pg,
+            "RemovePlacementGroup": self._h_remove_pg,
+            "KvPut": lambda r: self._kv.__setitem__(r["key"], r["value"]),
+            "KvGet": lambda r: self._kv.get(r["key"]),
+            "KvDel": lambda r: self._kv.pop(r["key"], None) and None,
+            "KvKeys": lambda r: [
+                k for k in self._kv if k.startswith(r.get("prefix", ""))
+            ],
+            "ClusterInfo": self._h_cluster_info,
+            "QueryState": self._h_query_state,
+            "Ping": lambda r: "pong",
+        }
+        self._server = RpcServer(handlers, host=host, port=port)
+        self.address = self._server.address
+
+        self._sched_thread = threading.Thread(
+            target=self._scheduler_loop, name="head-scheduler", daemon=True
+        )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="head-health", daemon=True
+        )
+        self._sched_thread.start()
+        self._health_thread.start()
+
+    # ------------------------------------------------------------------
+    # membership + health (GcsNodeManager / GcsHealthCheckManager analog)
+    # ------------------------------------------------------------------
+    def _h_register_node(self, info: NodeInfo) -> dict:
+        with self._cond:
+            self.nodes[info.node_id] = info
+            self._clients[info.node_id] = RpcClient(info.address)
+            self._last_report[info.node_id] = time.monotonic()
+            self.view.add_node(info.node_id, info.resources, info.labels)
+            # fresh capacity may unblock parked leases / pending PGs
+            self._pending.extend(self._infeasible)
+            self._infeasible.clear()
+            self._cond.notify_all()
+        logger.info("node %s registered at %s", info.node_id, info.address)
+        return {"node_id": info.node_id, "head_address": self.address}
+
+    def _h_node_report(self, report: NodeReport) -> dict:
+        with self._cond:
+            self._last_report[report.node_id] = time.monotonic()
+            node = self.nodes.get(report.node_id)
+            if node is not None and node.alive:
+                self.view.update_available(report.node_id, report.available)
+        if report.seals:
+            self._apply_seals(report.seals)
+        if report.finished_leases:
+            self._finish_leases(report.finished_leases)
+        with self._lock:
+            members = {
+                nid: n.address for nid, n in self.nodes.items() if n.alive
+            }
+        return {"nodes": members}
+
+    def _health_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(HEALTH_TIMEOUT_S / 4)
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for nid, node in self.nodes.items():
+                    if node.alive and now - self._last_report.get(nid, now) > HEALTH_TIMEOUT_S:
+                        dead.append(nid)
+            for nid in dead:
+                logger.warning("node %s missed health checks; marking dead", nid)
+                self._on_node_death(nid)
+
+    def _on_node_death(self, node_id: str) -> None:
+        with self._cond:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            self.metrics["nodes_dead"] += 1
+            self.view.remove_node(node_id)
+            lost_leases = [
+                (lid, spec)
+                for lid, (spec, nid) in self._in_flight.items()
+                if nid == node_id
+            ]
+            for lid, _ in lost_leases:
+                self._in_flight.pop(lid, None)
+            lost_objects = [
+                oid
+                for oid, e in self._objects.items()
+                if e.locations == {node_id} and e.inline is None
+            ]
+            dead_actors = [
+                a for a in self._actors.values() if a.node_id == node_id
+            ]
+            self._cond.notify_all()
+        # in-flight leases on the dead node: retry or fail
+        requeued = set()
+        for lid, spec in lost_leases:
+            requeued.add(lid)
+            self._retry_or_fail(spec, f"node {node_id} died running {spec.name}")
+        # objects whose only copy died: lineage reconstruction — requeue each
+        # creating lease ONCE even if several of its returns were lost
+        for oid in lost_objects:
+            self._recover_object(oid, node_id, requeued)
+        # actors: restart state machine (GcsActorManager analog)
+        for info in dead_actors:
+            self._restart_or_kill_actor(info, f"node {node_id} died")
+
+    def _retry_or_fail(self, spec: LeaseRequest, reason: str) -> None:
+        if spec.kind == "actor_method":
+            self._seal_error_ids(spec.return_ids, RuntimeError(reason))
+            return
+        if spec.attempt < spec.max_retries:
+            spec.attempt += 1
+            spec.target_node = None
+            with self._cond:
+                self.metrics["leases_spilled_back"] += 1
+                self._pending.append(spec)
+                self._cond.notify_all()
+        else:
+            self._seal_error_ids(spec.return_ids, RuntimeError(reason))
+
+    def _recover_object(
+        self, object_id: str, dead_node: str, requeued: set
+    ) -> None:
+        with self._lock:
+            entry = self._objects.get(object_id)
+            if entry is None:
+                return
+            entry.locations.discard(dead_node)
+            if entry.locations or entry.inline is not None:
+                return
+            lease_id = entry.creating_lease
+            spec = self._leases.get(lease_id) if lease_id else None
+            entry.event.clear()
+        if spec is None or spec.kind != "task":
+            self._seal_error_ids(
+                [object_id],
+                RuntimeError(f"object {object_id} lost with node {dead_node}"),
+            )
+            return
+        if spec.task_id in requeued:
+            return  # a sibling return already resubmitted this lease
+        if spec.attempt >= spec.max_retries:
+            self._seal_error_ids(
+                [object_id],
+                RuntimeError(
+                    f"object {object_id} lost; lineage retries exhausted"
+                ),
+            )
+            return
+        requeued.add(spec.task_id)
+        spec.attempt += 1
+        with self._cond:
+            self._pending.append(spec)
+            self._cond.notify_all()
+
+    def _restart_or_kill_actor(self, info: ActorInfo, reason: str) -> None:
+        with self._lock:
+            if info.state == "DEAD":
+                return
+            spec = self._actor_specs.get(info.actor_id)
+            if spec is not None and info.num_restarts < info.max_restarts:
+                info.num_restarts += 1
+                info.state = "RESTARTING"
+                info.node_id = None
+                info.address = None
+                restart = True
+            else:
+                info.state = "DEAD"
+                restart = False
+                # release the name so a replacement can rebind it
+                if info.name and self._named_actors.get(info.name) == info.actor_id:
+                    del self._named_actors[info.name]
+        if restart:
+            clone = LeaseRequest(
+                task_id=new_id(),
+                name=spec.name,
+                payload=spec.payload,
+                return_ids=[],
+                resources=spec.resources,
+                kind="actor_creation",
+                actor_id=info.actor_id,
+                max_retries=0,
+                strategy=spec.strategy,
+                runtime_env=spec.runtime_env,
+            )
+            with self._cond:
+                self._pending.append(clone)
+                self._cond.notify_all()
+        else:
+            logger.info("actor %s is dead: %s", info.actor_id, reason)
+
+    # ------------------------------------------------------------------
+    # object directory (ownership_object_directory + memory store analog)
+    # ------------------------------------------------------------------
+    def _entry(self, object_id: str) -> _ObjEntry:
+        with self._lock:
+            return self._objects.setdefault(object_id, _ObjEntry())
+
+    def _apply_seals(self, seals: List[SealInfo]) -> None:
+        with self._cond:
+            for s in seals:
+                e = self._objects.setdefault(s.object_id, _ObjEntry())
+                if s.is_error:
+                    e.error = s.error
+                else:
+                    if s.inline_value is not None:
+                        e.inline = s.inline_value
+                    e.locations.add(s.node_id)
+                    e.size = s.size
+                e.event.set()
+            self._cond.notify_all()
+
+    def _finish_leases(self, lease_ids: List[str]) -> None:
+        with self._cond:
+            for lid in lease_ids:
+                self._in_flight.pop(lid, None)
+                self.metrics["leases_finished"] += 1
+            # completed leases freed resources somewhere: wake parked work
+            self._pending.extend(self._infeasible)
+            self._infeasible.clear()
+            self._cond.notify_all()
+
+    def _h_report_seals(self, req: dict) -> None:
+        node_id = req.get("node_id")
+        if node_id and req.get("available") is not None:
+            with self._lock:
+                node = self.nodes.get(node_id)
+                if node is not None and node.alive:
+                    self.view.update_available(node_id, req["available"])
+        self._apply_seals(req.get("seals", []))
+        if req.get("finished"):
+            self._finish_leases(req["finished"])
+        for fail in req.get("failed", []):
+            with self._cond:
+                item = self._in_flight.pop(fail["task_id"], None)
+            spec = item[0] if item else self._leases.get(fail["task_id"])
+            if spec is None:
+                continue
+            if fail.get("retryable", True):
+                self._retry_or_fail(spec, fail.get("reason", "worker failure"))
+            else:
+                self._seal_error_ids(
+                    spec.return_ids,
+                    RuntimeError(fail.get("reason", "worker failure")),
+                )
+        for actor_ready in req.get("actors_alive", []):
+            self._mark_actor_alive(**actor_ready)
+        for actor_dead in req.get("actors_dead", []):
+            info = self._actors.get(actor_dead["actor_id"])
+            if info is not None:
+                self._restart_or_kill_actor(info, actor_dead.get("reason", ""))
+
+    def _seal_error_ids(self, object_ids: List[str], exc: BaseException) -> None:
+        blob = pickle.dumps(exc)
+        with self._cond:
+            for oid in object_ids:
+                e = self._objects.setdefault(oid, _ObjEntry())
+                e.error = blob
+                e.event.set()
+            self._cond.notify_all()
+
+    def _h_put_object(self, req: dict) -> dict:
+        """Driver put: small values inline at the head; large ones are
+        forwarded into a node's shared-memory store."""
+        object_id, data = req["object_id"], req["data"]
+        e = self._entry(object_id)
+        if len(data) <= INLINE_OBJECT_MAX:
+            e.inline = data
+            e.size = len(data)
+            e.event.set()
+            return {"where": "inline"}
+        with self._lock:
+            targets = [
+                (nid, self._clients[nid])
+                for nid, n in self.nodes.items()
+                if n.alive
+            ]
+        for nid, client in targets:
+            try:
+                client.call(
+                    "StoreObject", {"object_id": object_id, "data": data}
+                )
+                e.locations.add(nid)
+                e.size = len(data)
+                e.event.set()
+                return {"where": nid}
+            except RpcError:
+                continue
+        # no live nodes: keep it inline regardless of size
+        e.inline = data
+        e.size = len(data)
+        e.event.set()
+        return {"where": "inline"}
+
+    def _h_wait_object(self, req: dict) -> dict:
+        """Long-poll for availability (pubsub long-poll analog,
+        src/ray/pubsub/)."""
+        e = self._entry(req["object_id"])
+        t = req.get("timeout")
+        timeout = min(2.0 if t is None else t, 10.0)
+        if not e.event.wait(timeout):
+            return {"status": "pending"}
+        if e.error is not None:
+            return {"status": "error", "error": e.error}
+        if e.inline is not None:
+            return {"status": "inline", "data": e.inline}
+        with self._lock:
+            locs = [
+                (nid, self.nodes[nid].address)
+                for nid in e.locations
+                if nid in self.nodes and self.nodes[nid].alive
+            ]
+        if not locs:
+            return {"status": "pending"}  # recovery in progress
+        return {"status": "located", "locations": locs}
+
+    def _h_free_objects(self, req: dict) -> None:
+        ids = req["object_ids"]
+        with self._lock:
+            by_node: Dict[str, List[str]] = {}
+            for oid in ids:
+                e = self._objects.pop(oid, None)
+                if e is None:
+                    continue
+                for nid in e.locations:
+                    by_node.setdefault(nid, []).append(oid)
+            clients = {nid: self._clients[nid] for nid in by_node if nid in self._clients}
+        for nid, oids in by_node.items():
+            client = clients.get(nid)
+            if client is None:
+                continue
+            try:
+                client.call("DeleteObjects", {"object_ids": oids})
+            except RpcError:
+                pass
+
+    # ------------------------------------------------------------------
+    # lease intake + the batched scheduler
+    # ------------------------------------------------------------------
+    def _h_submit_lease(self, spec: LeaseRequest) -> dict:
+        for oid in spec.return_ids:
+            e = self._entry(oid)
+            e.creating_lease = spec.task_id
+        with self._cond:
+            self._leases[spec.task_id] = spec
+            self.metrics["leases_submitted"] += 1
+            self._pending.append(spec)
+            self._cond.notify_all()
+        return {"queued": True}
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._pending_pgs and not self._shutdown:
+                    self._cond.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                batch = []
+                while self._pending and len(batch) < MAX_BATCH:
+                    batch.append(self._pending.popleft())
+            try:
+                self._try_schedule_pgs()
+                if batch:
+                    self._schedule_batch(batch)
+            except Exception:  # pragma: no cover - scheduler must survive
+                logger.exception("scheduler round failed; requeueing")
+                with self._cond:
+                    self._pending.extend(batch)
+            time.sleep(SCHED_TICK_S)
+
+    def _schedule_batch(self, batch: List[LeaseRequest]) -> None:
+        self.metrics["sched_rounds"] += 1
+        kernel_batch: List[LeaseRequest] = []
+        for spec in batch:
+            routed = self._route_constrained(spec)
+            if routed == "kernel":
+                kernel_batch.append(spec)
+        if not kernel_batch:
+            return
+        with self._lock:
+            # snapshot copies: RPC threads mutate the view concurrently
+            # (node add/remove, resource reports); rows never shift, so
+            # row->node_id stays valid after release.
+            t0, a0, al0 = self.view.active_arrays()
+            totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
+            n = self.view.num_nodes
+        if n == 0 or not alive.any():
+            with self._cond:
+                self._infeasible.extend(kernel_batch)
+            return
+        demands = np.stack(
+            [
+                ResourceRequest.from_map(self.vocab, s.resources).dense(
+                    totals.shape[1]
+                )
+                for s in kernel_batch
+            ]
+        )
+        prefer = np.zeros(len(kernel_batch), dtype=np.int32)
+        force_spill = np.zeros(len(kernel_batch), dtype=bool)
+        if (
+            self.use_device_scheduler
+            and len(kernel_batch) >= DEVICE_KERNEL_MIN_BATCH
+        ):
+            import jax.numpy as jnp
+
+            self._seed += 1
+            res = hybrid_mod.hybrid_schedule_batch(
+                jnp.asarray(totals),
+                jnp.asarray(avail),
+                jnp.asarray(alive),
+                jnp.asarray(demands),
+                jnp.asarray(prefer),
+                jnp.asarray(force_spill),
+                np.uint32(self._seed),
+                config=self.hybrid_config,
+            )
+            rows = np.asarray(res.node)
+            granted = np.asarray(res.available)
+        else:
+            rows, granted, avail_after = hybrid_schedule_reference(
+                totals,
+                avail,
+                alive,
+                demands,
+                prefer,
+                force_spill,
+                config=self.hybrid_config,
+                rng=self._rng,
+            )
+        for spec, row, ok, demand in zip(kernel_batch, rows, granted, demands):
+            if row < 0 or not ok:
+                with self._cond:
+                    self._infeasible.append(spec)
+                continue
+            with self._lock:
+                node_id = self.view.node_id(int(row))
+                # optimistic deduction so later rounds see the placement; the
+                # agent's authoritative report will overwrite the row.
+                self.view.subtract(int(row), demand)
+            self._dispatch(spec, node_id)
+
+    def _route_constrained(self, spec: LeaseRequest):
+        """Actor methods, node affinity, and PG-bound leases bypass the
+        kernel (composite policy dispatch, composite_scheduling_policy.cc)."""
+        from ray_tpu.core.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+            PlacementGroupSchedulingStrategy,
+        )
+
+        if spec.kind == "actor_method":
+            info = self._actors.get(spec.actor_id)
+            if info is None or info.state == "DEAD":
+                self._seal_error_ids(
+                    spec.return_ids,
+                    RuntimeError(f"actor {spec.actor_id} is dead"),
+                )
+                return "done"
+            if info.state != "ALIVE":
+                with self._cond:
+                    self._infeasible.append(spec)
+                return "done"
+            self._dispatch(spec, info.node_id)
+            return "done"
+        strat = spec.strategy
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            node = self.nodes.get(strat.node_id)
+            if node is not None and node.alive:
+                self._dispatch(spec, strat.node_id)
+                return "done"
+            if strat.soft:
+                return "kernel"
+            self._seal_error_ids(
+                spec.return_ids,
+                RuntimeError(
+                    f"node affinity target {strat.node_id} is dead/unknown"
+                ),
+            )
+            return "done"
+        if isinstance(strat, PlacementGroupSchedulingStrategy):
+            pg = self._pgs.get(strat.placement_group.id)
+            if pg is None or pg.removed:
+                self._seal_error_ids(
+                    spec.return_ids, RuntimeError("placement group removed")
+                )
+                return "done"
+            if not pg.ready.is_set():
+                with self._cond:
+                    self._infeasible.append(spec)
+                return "done"
+            idx = strat.placement_group_bundle_index
+            if idx is None or idx < 0:
+                idx = self._pick_pg_bundle(pg, spec.resources)
+            if idx is None:
+                with self._cond:
+                    self._infeasible.append(spec)
+                return "done"
+            spec.pg_reservation = (pg.pg_id, int(idx))
+            self._dispatch(spec, pg.node_per_bundle[int(idx)])
+            return "done"
+        return "kernel"
+
+    def _pick_pg_bundle(self, pg: _PGState, resources: Dict[str, float]):
+        for i, b in enumerate(pg.bundles):
+            if all(b.get(k, 0.0) >= v for k, v in resources.items()):
+                return i
+        return None
+
+    def _dispatch(self, spec: LeaseRequest, node_id: str) -> None:
+        spec.target_node = node_id
+        with self._lock:
+            client = self._clients.get(node_id)
+            node = self.nodes.get(node_id)
+            self._in_flight[spec.task_id] = (spec, node_id)
+        if client is None or node is None or not node.alive:
+            with self._cond:
+                self._in_flight.pop(spec.task_id, None)
+                self._pending.append(spec)
+            return
+        self._dispatch_pool.submit(self._dispatch_blocking, spec, node_id, client)
+
+    def _dispatch_blocking(
+        self, spec: LeaseRequest, node_id: str, client: RpcClient
+    ) -> None:
+        try:
+            reply = client.call("ExecuteLease", spec, timeout=30.0)
+        except RpcError:
+            with self._cond:
+                self._in_flight.pop(spec.task_id, None)
+            self._retry_or_fail(spec, f"agent {node_id} unreachable")
+            return
+        if reply.get("status") == "reject":
+            # stale view: grant-or-reject → spill back to the queue
+            with self._cond:
+                self.metrics["leases_spilled_back"] += 1
+                self._in_flight.pop(spec.task_id, None)
+                if reply.get("available") is not None:
+                    node = self.nodes.get(node_id)
+                    if node is not None and node.alive:
+                        self.view.update_available(node_id, reply["available"])
+                self._pending.append(spec)
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # actors (GcsActorManager / GcsActorScheduler analog)
+    # ------------------------------------------------------------------
+    def _h_create_actor(self, req: dict) -> dict:
+        spec: LeaseRequest = req["spec"]
+        name = req.get("name")
+        info = ActorInfo(
+            actor_id=spec.actor_id,
+            name=name,
+            class_name=req.get("class_name", ""),
+            max_restarts=req.get("max_restarts", 0),
+        )
+        with self._cond:
+            if name:
+                if name in self._named_actors:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named_actors[name] = spec.actor_id
+            self._actors[spec.actor_id] = info
+            self._actor_specs[spec.actor_id] = spec
+            self._leases[spec.task_id] = spec
+            self._pending.append(spec)
+            self._cond.notify_all()
+        return {"actor_id": spec.actor_id}
+
+    def _mark_actor_alive(self, actor_id: str, node_id: str, address: str) -> None:
+        with self._cond:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = "ALIVE"
+            info.node_id = node_id
+            info.address = address
+            # parked actor-method leases can now route
+            self._pending.extend(self._infeasible)
+            self._infeasible.clear()
+            self._cond.notify_all()
+
+    def _h_get_actor(self, req: dict) -> ActorInfo:
+        actor_id = req.get("actor_id")
+        if actor_id is None:
+            name = req["name"]
+            actor_id = self._named_actors.get(name)
+            if actor_id is None:
+                raise ValueError(f"no actor named {name!r}")
+        info = self._actors.get(actor_id)
+        if info is None:
+            raise ValueError(f"unknown actor {actor_id}")
+        return info
+
+    def _h_kill_actor(self, req: dict) -> None:
+        info = self._actors.get(req["actor_id"])
+        if info is None:
+            return
+        no_restart = req.get("no_restart", True)
+        with self._lock:
+            if no_restart:
+                info.max_restarts = info.num_restarts  # exhaust the budget
+            node_id = info.node_id
+            client = self._clients.get(node_id) if node_id else None
+        if client is not None:
+            try:
+                client.call("KillActor", {"actor_id": info.actor_id})
+            except RpcError:
+                pass
+        self._restart_or_kill_actor(info, "killed by user")
+
+    # ------------------------------------------------------------------
+    # placement groups (GcsPlacementGroupManager/Scheduler analog, with the
+    # batched bundle kernels + 2PC prepare/commit to agents)
+    # ------------------------------------------------------------------
+    def _h_create_pg(self, req: dict) -> dict:
+        state = _PGState(
+            pg_id=req.get("pg_id") or new_id(),
+            bundles=[dict(b) for b in req["bundles"]],
+            strategy=req.get("strategy", "PACK"),
+        )
+        with self._cond:
+            self._pgs[state.pg_id] = state
+            self._pending_pgs.append(state)
+            self._cond.notify_all()
+        return {"pg_id": state.pg_id}
+
+    def _try_schedule_pgs(self) -> None:
+        with self._lock:
+            pending = list(self._pending_pgs)
+        for state in pending:
+            if state.removed:
+                with self._lock:
+                    if state in self._pending_pgs:
+                        self._pending_pgs.remove(state)
+                continue
+            if self._schedule_pg(state):
+                with self._cond:
+                    if state in self._pending_pgs:
+                        self._pending_pgs.remove(state)
+                    self._pending.extend(self._infeasible)
+                    self._infeasible.clear()
+                    self._cond.notify_all()
+
+    def _schedule_pg(self, state: _PGState) -> bool:
+        with self._lock:
+            t0, a0, al0 = self.view.active_arrays()
+            totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
+            num_nodes = self.view.num_nodes
+        if num_nodes == 0 or not alive.any():
+            return False
+        bundles = np.stack(
+            [
+                ResourceRequest.from_map(self.vocab, b).dense(totals.shape[1])
+                for b in state.bundles
+            ]
+        )
+        rows, success, _ = schedule_bundles(
+            totals, avail, alive, bundles, state.strategy
+        )
+        if not success:
+            return False
+        chosen = [self.view.node_id(int(r)) for r in rows]
+        # 2PC: prepare on every involved agent, commit if all granted
+        # (PrepareBundleResources/CommitBundleResources,
+        # gcs_placement_group_scheduler.cc:192,219).
+        by_node: Dict[str, List[int]] = {}
+        for i, nid in enumerate(chosen):
+            by_node.setdefault(nid, []).append(i)
+        prepared: List[Tuple[str, List[int]]] = []
+        ok = True
+        for nid, idxs in by_node.items():
+            client = self._clients.get(nid)
+            try:
+                reply = client.call(
+                    "PrepareBundles",
+                    {
+                        "pg_id": state.pg_id,
+                        "bundles": {i: state.bundles[i] for i in idxs},
+                    },
+                )
+                if not reply.get("ok"):
+                    ok = False
+                    break
+                prepared.append((nid, idxs))
+            except (RpcError, AttributeError):
+                ok = False
+                break
+        if not ok:
+            for nid, _ in prepared:
+                try:
+                    self._clients[nid].call(
+                        "RollbackBundles", {"pg_id": state.pg_id}
+                    )
+                except RpcError:
+                    pass
+            return False
+        for nid, _ in prepared:
+            try:
+                self._clients[nid].call("CommitBundles", {"pg_id": state.pg_id})
+            except RpcError:
+                pass
+        with self._lock:
+            for i, nid in enumerate(chosen):
+                self.view.subtract(self.view.row_of(nid), bundles[i])
+        state.node_per_bundle = chosen
+        state.ready.set()
+        return True
+
+    def _h_wait_pg(self, req: dict) -> dict:
+        state = self._pgs.get(req["pg_id"])
+        if state is None:
+            raise ValueError(f"unknown placement group {req['pg_id']}")
+        t = req.get("timeout")
+        ready = state.ready.wait(min(2.0 if t is None else t, 10.0))
+        return {
+            "ready": ready,
+            "node_per_bundle": state.node_per_bundle if ready else [],
+        }
+
+    def _h_remove_pg(self, req: dict) -> None:
+        state = self._pgs.get(req["pg_id"])
+        if state is None:
+            return
+        state.removed = True
+        involved = set(state.node_per_bundle)
+        refund: Dict[str, np.ndarray] = {}
+        if state.ready.is_set():
+            with self._lock:
+                width = self.view.active_arrays()[0].shape[1]
+            for i, nid in enumerate(state.node_per_bundle):
+                d = ResourceRequest.from_map(self.vocab, state.bundles[i]).dense(
+                    width
+                )
+                refund[nid] = refund.get(nid, 0) + d
+        for nid in involved:
+            client = self._clients.get(nid)
+            if client is None:
+                continue
+            try:
+                client.call("ReturnBundles", {"pg_id": state.pg_id})
+            except RpcError:
+                continue
+            with self._lock:
+                node = self.nodes.get(nid)
+                if nid in refund and node is not None and node.alive:
+                    self.view.add(self.view.row_of(nid), refund[nid])
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def _h_cluster_info(self, req) -> dict:
+        with self._lock:
+            totals, avail, _ = self.view.active_arrays()
+            nodes = []
+            for nid, n in self.nodes.items():
+                row = self.view.row_of(nid) if n.alive else None
+                nodes.append(
+                    {
+                        "NodeID": nid,
+                        "Alive": n.alive,
+                        "Address": n.address,
+                        "Resources": dict(n.resources),
+                        "Available": self.vocab.unpack(avail[row])
+                        if row is not None
+                        else {},
+                        "Labels": dict(n.labels),
+                    }
+                )
+        return {"nodes": nodes, "metrics": dict(self.metrics)}
+
+    def _h_query_state(self, req: dict) -> Any:
+        kind = req.get("kind", "summary")
+        with self._lock:
+            if kind == "actors":
+                return [dict(vars(a)) for a in self._actors.values()]
+            if kind == "objects":
+                return [
+                    {
+                        "object_id": oid,
+                        "sealed": e.event.is_set(),
+                        "size": e.size,
+                        "locations": sorted(e.locations),
+                        "error": e.error is not None,
+                    }
+                    for oid, e in self._objects.items()
+                ]
+            if kind == "placement_groups":
+                return [
+                    {
+                        "pg_id": p.pg_id,
+                        "strategy": p.strategy,
+                        "ready": p.ready.is_set(),
+                        "bundles": p.bundles,
+                        "nodes": p.node_per_bundle,
+                    }
+                    for p in self._pgs.values()
+                ]
+            if kind == "leases":
+                return {
+                    "pending": len(self._pending),
+                    "infeasible": len(self._infeasible),
+                    "in_flight": len(self._in_flight),
+                }
+            return {
+                "metrics": dict(self.metrics),
+                "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                "num_actors": len(self._actors),
+                "num_objects": len(self._objects),
+            }
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        with self._lock:
+            clients = list(self._clients.values())
+        for client in clients:
+            try:
+                client.call("Shutdown", timeout=1.0)
+            except RpcError:
+                pass
+        self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
+        self._server.stop()
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess in tests
+    import argparse
+
+    parser = argparse.ArgumentParser(description="ray_tpu head server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=6380)
+    parser.add_argument("--device-scheduler", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    head = HeadServer(
+        host=args.host, port=args.port, use_device_scheduler=args.device_scheduler
+    )
+    print(f"ray_tpu head listening on {head.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        head.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""gRPC plumbing for the distributed runtime.
+
+The reference runs every control-plane boundary over gRPC with protoc-generated
+services (/root/reference/src/ray/rpc/grpc_server.h, src/ray/protobuf/*.proto).
+We keep gRPC as the wire (HTTP/2 framing, flow control, connection reuse) but
+register *generic* unary handlers dispatched by method name with cloudpickle
+payloads — the framework's control messages are Python dataclasses, and a
+dynamic schema keeps the RPC layer to one file instead of 36 .proto files.
+
+Every handler runs server-side in a thread pool; exceptions are pickled and
+re-raised at the caller (the RetryableGrpcClient contract,
+src/ray/rpc/retryable_grpc_client.h — retries here are explicit via
+``RpcClient.call(retries=)``).
+"""
+from __future__ import annotations
+
+import pickle
+import time
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+import grpc
+
+_MAX_MSG = 256 * 1024 * 1024
+_OPTIONS = [
+    ("grpc.max_send_message_length", _MAX_MSG),
+    ("grpc.max_receive_message_length", _MAX_MSG),
+    ("grpc.so_reuseport", 0),
+]
+
+
+class RpcError(Exception):
+    """Transport-level failure (peer dead/unreachable)."""
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, handlers: Dict[str, Callable[[Any], Any]]):
+        self._handlers = handlers
+
+    def service(self, handler_call_details):
+        name = handler_call_details.method.rsplit("/", 1)[-1]
+        fn = self._handlers.get(name)
+        if fn is None:
+            return None
+
+        def unary(request_bytes, context):
+            try:
+                req = cloudpickle.loads(request_bytes)
+                return cloudpickle.dumps((True, fn(req)))
+            except BaseException as exc:  # noqa: BLE001 - shipped to caller
+                try:
+                    return cloudpickle.dumps((False, exc))
+                except Exception:  # unpicklable exception
+                    return cloudpickle.dumps((False, RuntimeError(repr(exc))))
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+
+class RpcServer:
+    """One gRPC server hosting named unary handlers.
+
+    ``handlers`` maps method name -> fn(request_obj) -> response_obj.
+    """
+
+    def __init__(
+        self,
+        handlers: Dict[str, Callable[[Any], Any]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_workers: int = 32,
+    ):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_OPTIONS,
+        )
+        self._server.add_generic_rpc_handlers((_GenericHandler(handlers),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise RpcError(f"could not bind RPC server on {host}:{port}")
+        self.address = f"{host}:{self.port}"
+        self._server.start()
+
+    def stop(self, grace: float = 0.2) -> None:
+        self._server.stop(grace)
+
+
+class RpcClient:
+    """Channel to one peer; ``call(method, payload)`` round-trips an object."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(address, options=_OPTIONS)
+        self._methods: Dict[str, Any] = {}
+
+    def _method(self, name: str):
+        m = self._methods.get(name)
+        if m is None:
+            m = self._channel.unary_unary(
+                f"/rtpu/{name}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            self._methods[name] = m
+        return m
+
+    def call(
+        self,
+        method: str,
+        payload: Any = None,
+        timeout: Optional[float] = 30.0,
+        retries: int = 0,
+        retry_interval: float = 0.1,
+    ) -> Any:
+        data = cloudpickle.dumps(payload)
+        attempt = 0
+        while True:
+            try:
+                raw = self._method(method)(data, timeout=timeout)
+                ok, value = pickle.loads(raw)
+                if not ok:
+                    raise value
+                return value
+            except grpc.RpcError as exc:
+                if attempt >= retries:
+                    raise RpcError(
+                        f"rpc {method} to {self.address} failed: "
+                        f"{exc.code() if hasattr(exc, 'code') else exc}"
+                    ) from exc
+                attempt += 1
+                time.sleep(retry_interval * attempt)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,252 @@
+"""Worker process: executes leases pushed by its node agent.
+
+The analog of the reference's worker process embedding a CoreWorker
+(/root/reference/src/ray/core_worker/): receives ``PushTask`` RPCs
+(task_execution/task_receiver.h:43), resolves ObjectRef arguments
+(DependencyResolver), runs user code, and seals results — small values
+inline (max_direct_call_object_size, ray_config_def.h:218), large ones
+into the node's shared-memory arena (plasma Put). Actor instances live
+in-process for the worker's lifetime; pushes are serialized per worker,
+giving actor-method ordering.
+
+Kept import-light: jax and the rest of ray_tpu load lazily (user code
+triggers them), so a pool of workers forks in well under a second.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import logging
+import os
+import pickle
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from .common import INLINE_OBJECT_MAX, SealInfo
+from .rpc import RpcClient, RpcError, RpcServer
+
+logger = logging.getLogger("ray_tpu.cluster.worker")
+
+
+class Worker:
+    def __init__(self, agent_address: str, worker_id: str, store_path: str):
+        self.worker_id = worker_id
+        self.agent = RpcClient(agent_address)
+        self.node_id = os.environ.get("RAY_TPU_NODE_ID", "")
+        self.store = None
+        if store_path:
+            try:
+                from ray_tpu.native import NativeObjectStore
+
+                self.store = NativeObjectStore(path=store_path, create=False)
+            except Exception:  # noqa: BLE001
+                logger.warning("worker could not open shm store %s", store_path)
+        self._actors: Dict[str, Any] = {}
+        self._env_applied: set = set()
+        self._server = RpcServer(
+            {
+                "PushTask": self._h_push_task,
+                "KillActor": self._h_kill_actor,
+                "Ping": lambda r: "pong",
+            },
+            port=0,
+            max_workers=4,
+        )
+        self.agent.call(
+            "RegisterWorker",
+            {"worker_id": worker_id, "address": self._server.address},
+            retries=20,
+            retry_interval=0.1,
+        )
+
+    # ------------------------------------------------------------------
+    # object plane helpers
+    # ------------------------------------------------------------------
+    def get_object(self, hex_id: str, timeout: Optional[float] = None) -> Any:
+        if self.store is not None:
+            try:
+                return pickle.loads(self.store.get_bytes(hex_id))
+            except (KeyError, BlockingIOError):
+                pass
+        reply = self.agent.call(
+            "GetObjectForWorker",
+            {"object_id": hex_id, "timeout": timeout},
+            timeout=None,
+        )
+        status = reply["status"]
+        if status == "local":
+            return pickle.loads(self.store.get_bytes(hex_id))
+        if status == "inline":
+            return pickle.loads(reply["data"])
+        if status == "error":
+            raise pickle.loads(reply["error"])
+        raise TimeoutError(f"timed out fetching object {hex_id}")
+
+    def put_value(self, object_id: str, value: Any) -> SealInfo:
+        data = cloudpickle.dumps(value)
+        if len(data) <= INLINE_OBJECT_MAX:
+            return SealInfo(
+                object_id=object_id,
+                node_id=self.node_id,
+                size=len(data),
+                inline_value=data,
+            )
+        stored = False
+        if self.store is not None:
+            try:
+                self.store.put_bytes(object_id, data)
+                stored = True
+            except Exception:  # noqa: BLE001 - arena full
+                pass
+        if not stored:
+            self.agent.call(
+                "WorkerPut", {"object_id": object_id, "data": data}, timeout=60.0
+            )
+        return SealInfo(
+            object_id=object_id, node_id=self.node_id, size=len(data)
+        )
+
+    # ------------------------------------------------------------------
+    # runtime envs (the per-lease slice of _private/runtime_env/)
+    # ------------------------------------------------------------------
+    def _apply_runtime_env(self, env: Optional[dict]) -> None:
+        if not env:
+            return
+        for k, v in (env.get("env_vars") or {}).items():
+            os.environ[k] = str(v)
+        key = env.get("working_dir")
+        if key and key not in self._env_applied:
+            self._env_applied.add(key)
+            if key not in sys.path:
+                sys.path.insert(0, key)
+        for p in env.get("py_modules") or []:
+            if p not in sys.path:
+                sys.path.insert(0, p)
+        importlib.invalidate_caches()
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _resolve(self, args: tuple, kwargs: dict):
+        from ray_tpu.core.object_store import ObjectRef
+
+        def rv(x):
+            return self.get_object(x.hex) if isinstance(x, ObjectRef) else x
+
+        return tuple(rv(a) for a in args), {k: rv(v) for k, v in kwargs.items()}
+
+    def _h_push_task(self, req: dict) -> dict:
+        kind = req["kind"]
+        self._set_context(req)
+        try:
+            self._apply_runtime_env(req.get("runtime_env"))
+            if kind == "actor_creation":
+                cls, args, kwargs = cloudpickle.loads(req["payload"])
+                args, kwargs = self._resolve(args, kwargs)
+                self._actors[req["actor_id"]] = cls(*args, **kwargs)
+                result_values: List[Any] = []
+            elif kind == "actor_method":
+                method, args, kwargs = cloudpickle.loads(req["payload"])
+                args, kwargs = self._resolve(args, kwargs)
+                instance = self._actors[req["actor_id"]]
+                out = getattr(instance, method)(*args, **kwargs)
+                result_values = self._split(out, req["return_ids"])
+            else:
+                fn, args, kwargs = cloudpickle.loads(req["payload"])
+                args, kwargs = self._resolve(args, kwargs)
+                out = fn(*args, **kwargs)
+                result_values = self._split(out, req["return_ids"])
+        except BaseException as exc:  # noqa: BLE001 - errors are values
+            if req.get("retry_exceptions"):
+                return {"status": "retry", "error_repr": repr(exc)}
+            tb = traceback.format_exc()
+            logger.debug("task %s failed:\n%s", req["name"], tb)
+            from ray_tpu.core.object_store import TaskError
+
+            err = TaskError(exc, req["name"])
+            err.__cause__ = exc
+            blob = None
+            try:
+                blob = cloudpickle.dumps(err)
+            except Exception:  # noqa: BLE001 - unpicklable exception
+                blob = cloudpickle.dumps(
+                    TaskError(RuntimeError(f"{exc!r}\n{tb}"), req["name"])
+                )
+            seals = [
+                SealInfo(
+                    object_id=oid,
+                    node_id=self.node_id,
+                    is_error=True,
+                    error=blob,
+                )
+                for oid in req["return_ids"]
+            ]
+            return {"status": "error", "error_repr": repr(exc), "seals": seals}
+        finally:
+            self._clear_context()
+        seals = [
+            self.put_value(oid, v)
+            for oid, v in zip(req["return_ids"], result_values)
+        ]
+        return {"status": "ok", "seals": seals}
+
+    def _split(self, out: Any, return_ids: List[str]) -> List[Any]:
+        if len(return_ids) <= 1:
+            return [out] if return_ids else []
+        values = tuple(out)
+        if len(values) != len(return_ids):
+            raise ValueError(
+                f"task returned {len(values)} values, expected {len(return_ids)}"
+            )
+        return list(values)
+
+    def _set_context(self, req: dict) -> None:
+        try:
+            from ray_tpu.core.runtime import get_context
+
+            ctx = get_context()
+            ctx.node_id = self.node_id
+            ctx.task_id = req["task_id"]
+            ctx.actor_id = req.get("actor_id")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _clear_context(self) -> None:
+        try:
+            from ray_tpu.core.runtime import get_context
+
+            ctx = get_context()
+            ctx.node_id = None
+            ctx.task_id = None
+            ctx.actor_id = None
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _h_kill_actor(self, req: dict) -> None:
+        self._actors.pop(req["actor_id"], None)
+
+    def serve_forever(self) -> None:
+        while True:
+            time.sleep(1.0)
+            if os.getppid() == 1:  # agent died; don't linger
+                os._exit(0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--agent", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--store", default="")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.WARNING)
+    worker = Worker(args.agent, args.worker_id, args.store)
+    worker.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@ remote_function.py:314, actor.py:1024)."""
 from __future__ import annotations
 
 import functools
+import os
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -30,24 +31,38 @@ def init(
     num_nodes: int = 1,
     resources_per_node: Optional[Dict[str, float]] = None,
     *,
+    address: Optional[str] = None,
+    runtime_env: Optional[dict] = None,
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
     resources: Optional[Dict[str, float]] = None,
     use_device_scheduler: bool = False,
     ignore_reinit_error: bool = False,
-) -> Runtime:
-    """Start the in-process cluster runtime.
+):
+    """Start the in-process cluster runtime, or connect to a live cluster.
 
-    ``num_nodes`` simulated nodes, each with ``resources_per_node`` — the
-    single-process multi-node model (reference cluster_utils.Cluster,
-    python/ray/cluster_utils.py:137). With ``use_device_scheduler=True``,
-    large scheduling batches run the batched JAX kernel on the default
-    device (TPU when present).
+    With ``address=None``: ``num_nodes`` simulated nodes in-process, each
+    with ``resources_per_node`` — the single-process multi-node model
+    (reference cluster_utils.Cluster, python/ray/cluster_utils.py:137).
+    With ``address="host:port"``: connect this driver to a running
+    multi-process cluster's head (the distributed runtime in
+    ray_tpu.cluster; the reference's ray.init(address=...) +
+    Ray-Client mode). With ``use_device_scheduler=True``, large
+    scheduling batches run the batched JAX kernel on the default device
+    (TPU when present).
     """
     if runtime_initialized():
         if ignore_reinit_error:
             return get_runtime()
         raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+    if address is None:
+        address = os.environ.get("RAY_TPU_HEAD_ADDRESS") or None
+    if address is not None:
+        from ray_tpu.cluster.client import RemoteRuntime
+
+        remote_rt = RemoteRuntime(address, runtime_env=runtime_env)
+        set_runtime(remote_rt)
+        return remote_rt
     if resources_per_node is None:
         resources_per_node = {}
         if num_cpus is not None:
@@ -113,6 +128,10 @@ def wait(
 
 
 def kill(actor_handle, *, no_restart: bool = True) -> None:
+    rt = get_runtime()
+    if getattr(rt, "is_remote", False):
+        rt.kill_actor(actor_handle, no_restart=no_restart)
+        return
     state = actor_handle._actor_state
     state.mark_died(restart=not no_restart)
     rt = get_runtime()
@@ -131,6 +150,8 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
     the thread-pool model cannot be preempted, like non-force cancel in the
     reference)."""
     rt = get_runtime()
+    if getattr(rt, "is_remote", False):
+        return  # best-effort: remote cancel not yet supported
     with rt._cond:
         for q in (rt._pending, rt._infeasible):
             for spec in list(q):
@@ -148,7 +169,10 @@ def nodes() -> List[Dict[str, Any]]:
 def timeline(filename: Optional[str] = None) -> List[dict]:
     """Chrome-trace dump of task lifecycle events (ray.timeline parity,
     reference _private/state.py:1010)."""
-    return get_runtime().events.dump_timeline(filename)
+    rt = get_runtime()
+    if getattr(rt, "is_remote", False):
+        return []  # driver-side timeline only exists for the local runtime
+    return rt.events.dump_timeline(filename)
 
 
 def cluster_resources() -> Dict[str, float]:
@@ -161,6 +185,8 @@ def available_resources() -> Dict[str, float]:
 
 def get_actor(name: str):
     rt = get_runtime()
+    if getattr(rt, "is_remote", False):
+        return rt.get_actor(name)
     actor_id = rt._named_actors.get(name)
     if actor_id is None:
         raise ValueError(f"no actor named {name!r}")
@@ -265,6 +291,27 @@ class ActorClass:
     def remote(self, *args, **kwargs):
         rt = get_runtime()
         opts = self._options
+        if getattr(rt, "is_remote", False):
+            for unsupported in ("max_task_retries", "max_concurrency"):
+                v = opts.get(unsupported)
+                if v not in (None, 0, 1):
+                    import warnings
+
+                    warnings.warn(
+                        f"{unsupported}={v} is not yet supported by the "
+                        "distributed cluster backend; actor methods run "
+                        "serially with no automatic method retries",
+                        stacklevel=2,
+                    )
+            return rt.create_actor(
+                self._cls,
+                args,
+                kwargs,
+                resources=_resource_map(opts, is_actor=True),
+                name=opts.get("name"),
+                max_restarts=opts.get("max_restarts", 0),
+                scheduling_strategy=opts.get("scheduling_strategy"),
+            )
         return actor_mod.create_actor(
             rt,
             self._cls,

@@ -168,19 +168,27 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
-) -> PlacementGroup:
+):
     from .runtime import get_runtime
 
     rt = get_runtime()
+    if getattr(rt, "is_remote", False):
+        from ray_tpu.cluster.client import RemotePlacementGroup
+
+        pg_id = rt.create_placement_group(list(bundles), strategy)
+        return RemotePlacementGroup(pg_id, list(bundles), strategy)
     state = PlacementGroupState(rt, bundles, strategy, name=name)
     rt.register_pg(state)
     return PlacementGroup(state)
 
 
-def remove_placement_group(pg: PlacementGroup) -> None:
+def remove_placement_group(pg) -> None:
     from .runtime import get_runtime
 
     rt = get_runtime()
+    if getattr(rt, "is_remote", False):
+        rt.remove_placement_group(pg.id)
+        return
     pg._state.remove()
     rt._pgs.pop(pg.id, None)
     rt.notify_resources_changed()

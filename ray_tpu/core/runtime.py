@@ -718,7 +718,19 @@ _runtime_lock = threading.Lock()
 
 
 def get_runtime() -> Runtime:
+    global _runtime
     if _runtime is None:
+        # Inside a cluster worker process the head address is in the env —
+        # nested ray_tpu API calls connect as a client automatically (the
+        # reference's workers similarly auto-connect to their cluster).
+        addr = os.environ.get("RAY_TPU_HEAD_ADDRESS")
+        if addr:
+            from ray_tpu.cluster.client import RemoteRuntime
+
+            with _runtime_lock:
+                if _runtime is None:
+                    _runtime = RemoteRuntime(addr)
+            return _runtime
         raise RuntimeError("ray_tpu.init() has not been called")
     return _runtime
 
