@@ -14,6 +14,7 @@ _EXPORTS = {
     "NodeAgent": ("ray_tpu.cluster.agent", "NodeAgent"),
     "LeaseRequest": ("ray_tpu.cluster.common", "LeaseRequest"),
     "NodeInfo": ("ray_tpu.cluster.common", "NodeInfo"),
+    "JobSubmissionClient": ("ray_tpu.cluster.jobs", "JobSubmissionClient"),
     "RpcClient": ("ray_tpu.cluster.rpc", "RpcClient"),
     "RpcServer": ("ray_tpu.cluster.rpc", "RpcServer"),
     "RpcError": ("ray_tpu.cluster.rpc", "RpcError"),

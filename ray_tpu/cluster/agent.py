@@ -145,6 +145,8 @@ class NodeAgent:
         self._idle_cv = threading.Condition(self._lock)
         self._actor_workers: Dict[str, str] = {}  # actor_id -> worker_id
         self._actor_allocs: Dict[str, Any] = {}  # actor_id -> held lease alloc
+        self._actor_fifo: Dict[str, list] = {}  # actor_id -> ordered methods
+        self._actor_draining: set = set()
         self._num_workers = num_workers
         for _ in range(num_workers):
             self._spawn_worker()
@@ -268,9 +270,18 @@ class NodeAgent:
             with self._lock:
                 worker_id = self._actor_workers.get(spec.actor_id)
                 handle = self._workers.get(worker_id) if worker_id else None
-            if handle is None:
-                return {"status": "reject", "available": self.ledger.avail_map()}
-            self._exec_pool.submit(self._run_on_worker, spec, handle, None)
+                if handle is None:
+                    return {
+                        "status": "reject",
+                        "available": self.ledger.avail_map(),
+                    }
+                # per-actor FIFO: the pool must not reorder method calls
+                fifo = self._actor_fifo.setdefault(spec.actor_id, [])
+                fifo.append(spec)
+                if spec.actor_id in self._actor_draining:
+                    return {"status": "granted"}
+                self._actor_draining.add(spec.actor_id)
+            self._exec_pool.submit(self._drain_actor_fifo, spec.actor_id)
             return {"status": "granted"}
         if spec.pg_reservation is not None:
             if not self._bundle_allocate(spec.pg_reservation, spec.resources):
@@ -283,6 +294,32 @@ class NodeAgent:
             return {"status": "reject", "available": self.ledger.avail_map()}
         self._exec_pool.submit(self._dispatch_to_worker, spec, alloc)
         return {"status": "granted"}
+
+    def _drain_actor_fifo(self, actor_id: str) -> None:
+        while True:
+            with self._lock:
+                fifo = self._actor_fifo.get(actor_id)
+                if not fifo:
+                    self._actor_draining.discard(actor_id)
+                    return
+                spec = fifo.pop(0)
+                worker_id = self._actor_workers.get(actor_id)
+                handle = self._workers.get(worker_id) if worker_id else None
+            if handle is None:
+                self._report_to_head(
+                    {
+                        "node_id": self.node_id,
+                        "failed": [
+                            {
+                                "task_id": spec.task_id,
+                                "reason": "actor worker is gone",
+                                "retryable": False,
+                            }
+                        ],
+                    }
+                )
+                continue
+            self._run_on_worker(spec, handle, None)
 
     def _dispatch_to_worker(self, spec: LeaseRequest, alloc) -> None:
         handle = self._pop_idle_worker()
@@ -489,8 +526,11 @@ class NodeAgent:
         oid = req["object_id"]
         if self.store.contains(oid):
             return self._local_reply(oid)
-        deadline = time.monotonic() + (req.get("timeout") or 60.0)
-        while time.monotonic() < deadline:
+        # timeout=None means wait as long as the dependency takes (task-arg
+        # waits are unbounded in the reference's LeaseDependencyManager).
+        timeout = req.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while deadline is None or time.monotonic() < deadline:
             reply = self.head.call(
                 "WaitObject",
                 {"object_id": oid, "timeout": 2.0},
@@ -573,7 +613,7 @@ class NodeAgent:
             for h in dead:
                 self._on_worker_death(h, [])
             try:
-                self.head.call(
+                reply = self.head.call(
                     "NodeReport",
                     NodeReport(
                         node_id=self.node_id,
@@ -582,6 +622,21 @@ class NodeAgent:
                     ),
                     timeout=5.0,
                 )
+                if not reply.get("alive", True):
+                    # a transient heartbeat gap got us declared dead —
+                    # rejoin (the reference node would restart its raylet;
+                    # we can simply re-register the same node id).
+                    logger.warning("head declared us dead; re-registering")
+                    self.head.call(
+                        "RegisterNode",
+                        NodeInfo(
+                            node_id=self.node_id,
+                            address=self.address,
+                            resources=dict(self.resources),
+                            labels=self.labels,
+                        ),
+                        timeout=5.0,
+                    )
             except RpcError:
                 continue
 

@@ -22,8 +22,11 @@ from .rpc import RpcClient, RpcError
 
 
 class Cluster:
-    def __init__(self, use_device_scheduler: bool = False):
-        self.head = HeadServer(use_device_scheduler=use_device_scheduler)
+    def __init__(self, use_device_scheduler: bool = False, dashboard: bool = False):
+        self.head = HeadServer(
+            use_device_scheduler=use_device_scheduler,
+            dashboard_port=0 if dashboard else None,
+        )
         self.address = self.head.address
         self._agents: Dict[str, subprocess.Popen] = {}
         self._counter = 0

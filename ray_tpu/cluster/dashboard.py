@@ -1,0 +1,144 @@
+"""Dashboard: HTTP observability + REST job API on the head.
+
+The lightweight analog of the reference dashboard head
+(/root/reference/python/ray/dashboard/head.py, aiohttp) and its job REST
+module (dashboard/modules/job/): JSON state endpoints, a Prometheus
+text exposition endpoint (the metrics-agent scrape surface,
+_private/metrics_agent.py), and job submit/list/logs over HTTP.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+from aiohttp import web
+
+
+class Dashboard:
+    def __init__(self, head, host: str = "127.0.0.1", port: int = 0):
+        self.head = head
+        self.host = host
+        self._port = port
+        self.port: Optional[int] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="dashboard", daemon=True
+        )
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    # ------------------------------------------------------------------
+    def _routes(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/", self._index),
+                web.get("/api/cluster_status", self._cluster_status),
+                web.get("/api/nodes", self._nodes),
+                web.get("/api/actors", self._actors),
+                web.get("/api/objects", self._objects),
+                web.get("/api/placement_groups", self._pgs),
+                web.get("/api/jobs", self._jobs),
+                web.post("/api/jobs", self._submit_job),
+                web.get("/api/jobs/{job_id}", self._job_status),
+                web.get("/api/jobs/{job_id}/logs", self._job_logs),
+                web.get("/metrics", self._metrics),
+            ]
+        )
+        return app
+
+    def _serve(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        runner = web.AppRunner(self._routes())
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self._port)
+        self._loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        self._loop.run_forever()
+        self._loop.run_until_complete(runner.cleanup())
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------
+    def _json(self, data) -> web.Response:
+        return web.json_response(data, dumps=lambda d: json.dumps(d, default=str))
+
+    async def _index(self, request) -> web.Response:
+        info = self.head._h_query_state({"kind": "summary"})
+        html = (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            "<h1>ray_tpu cluster</h1>"
+            f"<pre>{json.dumps(info, indent=2, default=str)}</pre>"
+            "<p>endpoints: /api/cluster_status /api/nodes /api/actors "
+            "/api/objects /api/placement_groups /api/jobs /metrics</p>"
+            "</body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
+
+    async def _cluster_status(self, request) -> web.Response:
+        return self._json(self.head._h_cluster_info(None))
+
+    async def _nodes(self, request) -> web.Response:
+        return self._json(self.head._h_cluster_info(None)["nodes"])
+
+    async def _actors(self, request) -> web.Response:
+        return self._json(self.head._h_query_state({"kind": "actors"}))
+
+    async def _objects(self, request) -> web.Response:
+        return self._json(self.head._h_query_state({"kind": "objects"}))
+
+    async def _pgs(self, request) -> web.Response:
+        return self._json(self.head._h_query_state({"kind": "placement_groups"}))
+
+    async def _jobs(self, request) -> web.Response:
+        return self._json(self.head.jobs.list())
+
+    async def _submit_job(self, request) -> web.Response:
+        body = await request.json()
+        job_id = self.head.jobs.submit(
+            entrypoint=body["entrypoint"],
+            runtime_env=body.get("runtime_env"),
+            submission_id=body.get("submission_id"),
+            metadata=body.get("metadata"),
+        )
+        return self._json({"job_id": job_id})
+
+    async def _job_status(self, request) -> web.Response:
+        try:
+            return self._json(self.head.jobs.status(request.match_info["job_id"]))
+        except ValueError:
+            raise web.HTTPNotFound()
+
+    async def _job_logs(self, request) -> web.Response:
+        try:
+            logs = self.head.jobs.logs(request.match_info["job_id"])
+        except ValueError:
+            raise web.HTTPNotFound()
+        return web.Response(text=logs, content_type="text/plain")
+
+    async def _metrics(self, request) -> web.Response:
+        """Prometheus text exposition (metrics-agent scrape analog)."""
+        lines = []
+        info = self.head._h_cluster_info(None)
+        for name, value in info["metrics"].items():
+            lines.append(f"# TYPE ray_tpu_{name} counter")
+            lines.append(f"ray_tpu_{name} {value}")
+        alive = sum(1 for n in info["nodes"] if n["Alive"])
+        lines.append("# TYPE ray_tpu_nodes_alive gauge")
+        lines.append(f"ray_tpu_nodes_alive {alive}")
+        for n in info["nodes"]:
+            nid = n["NodeID"]
+            for res, avail in (n["Available"] or {}).items():
+                safe = res.replace("-", "_").replace(".", "_").replace("/", "_")
+                lines.append(
+                    f'ray_tpu_node_available{{node="{nid}",resource="{safe}"}} {avail}'
+                )
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")

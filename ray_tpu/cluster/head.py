@@ -54,6 +54,13 @@ MAX_BATCH = 4096
 DEVICE_KERNEL_MIN_BATCH = 64
 
 
+def _best_effort(fn, *args, **kwargs):
+    try:
+        fn(*args, **kwargs)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 @dataclass
 class _ObjEntry:
     """Object-directory row (ownership_object_directory analog)."""
@@ -82,6 +89,7 @@ class HeadServer:
         host: str = "127.0.0.1",
         port: int = 0,
         use_device_scheduler: bool = False,
+        dashboard_port: Optional[int] = None,
     ):
         self.vocab = ResourceVocab()
         self.view = ClusterView(self.vocab)
@@ -103,8 +111,11 @@ class HeadServer:
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
         self._named_actors: Dict[str, str] = {}
+        self._actor_send: Dict[str, deque] = {}  # per-actor ordered sender
+        self._actor_sending: set = set()
         self._pgs: Dict[str, _PGState] = {}
         self._pending_pgs: List[_PGState] = []
+        self._pgs_dirty = True  # retry pending PGs only after view changes
         self._kv: Dict[str, bytes] = {}
         self._jobs: Dict[str, dict] = {}
         self._shutdown = False
@@ -141,10 +152,29 @@ class HeadServer:
             ],
             "ClusterInfo": self._h_cluster_info,
             "QueryState": self._h_query_state,
+            "SubmitJob": lambda r: self.jobs.submit(
+                entrypoint=r["entrypoint"],
+                runtime_env=r.get("runtime_env"),
+                submission_id=r.get("submission_id"),
+                metadata=r.get("metadata"),
+            ),
+            "JobStatus": lambda r: self.jobs.status(r["job_id"]),
+            "JobLogs": lambda r: self.jobs.logs(r["job_id"]),
+            "ListJobs": lambda r: self.jobs.list(),
+            "StopJob": lambda r: self.jobs.stop(r["job_id"]),
             "Ping": lambda r: "pong",
         }
         self._server = RpcServer(handlers, host=host, port=port)
         self.address = self._server.address
+
+        from .jobs import JobManager
+
+        self.jobs = JobManager(self.address)
+        self.dashboard = None
+        if dashboard_port is not None:
+            from .dashboard import Dashboard
+
+            self.dashboard = Dashboard(self, host=host, port=dashboard_port)
 
         self._sched_thread = threading.Thread(
             target=self._scheduler_loop, name="head-scheduler", daemon=True
@@ -161,12 +191,16 @@ class HeadServer:
     def _h_register_node(self, info: NodeInfo) -> dict:
         with self._cond:
             self.nodes[info.node_id] = info
+            old_client = self._clients.get(info.node_id)
             self._clients[info.node_id] = RpcClient(info.address)
+            if old_client is not None and old_client.address != info.address:
+                old_client.close()
             self._last_report[info.node_id] = time.monotonic()
             self.view.add_node(info.node_id, info.resources, info.labels)
             # fresh capacity may unblock parked leases / pending PGs
             self._pending.extend(self._infeasible)
             self._infeasible.clear()
+            self._pgs_dirty = True
             self._cond.notify_all()
         logger.info("node %s registered at %s", info.node_id, info.address)
         return {"node_id": info.node_id, "head_address": self.address}
@@ -175,17 +209,17 @@ class HeadServer:
         with self._cond:
             self._last_report[report.node_id] = time.monotonic()
             node = self.nodes.get(report.node_id)
-            if node is not None and node.alive:
+            alive = node is not None and node.alive
+            if alive:
                 self.view.update_available(report.node_id, report.available)
+                self._pgs_dirty = True
         if report.seals:
             self._apply_seals(report.seals)
         if report.finished_leases:
             self._finish_leases(report.finished_leases)
-        with self._lock:
-            members = {
-                nid: n.address for nid, n in self.nodes.items() if n.alive
-            }
-        return {"nodes": members}
+        # alive=False tells an agent that was (transiently) declared dead to
+        # re-register — nodes can rejoin after a heartbeat gap.
+        return {"alive": alive}
 
     def _health_loop(self) -> None:
         while not self._shutdown:
@@ -351,6 +385,7 @@ class HeadServer:
             # completed leases freed resources somewhere: wake parked work
             self._pending.extend(self._infeasible)
             self._infeasible.clear()
+            self._pgs_dirty = True
             self._cond.notify_all()
 
     def _h_report_seals(self, req: dict) -> None:
@@ -484,7 +519,11 @@ class HeadServer:
     def _scheduler_loop(self) -> None:
         while True:
             with self._cond:
-                while not self._pending and not self._pending_pgs and not self._shutdown:
+                while (
+                    not self._pending
+                    and not (self._pending_pgs and self._pgs_dirty)
+                    and not self._shutdown
+                ):
                     self._cond.wait(timeout=0.5)
                 if self._shutdown:
                     return
@@ -650,7 +689,29 @@ class HeadServer:
                 self._in_flight.pop(spec.task_id, None)
                 self._pending.append(spec)
             return
+        if spec.kind == "actor_method":
+            # per-actor single-flight sender: preserves driver submission
+            # order end-to-end (the reference's per-actor sequence-numbered
+            # ordered queue, task_execution/ordered_actor_task_execution_queue.cc)
+            with self._lock:
+                q = self._actor_send.setdefault(spec.actor_id, deque())
+                q.append((spec, node_id, client))
+                if spec.actor_id in self._actor_sending:
+                    return
+                self._actor_sending.add(spec.actor_id)
+            self._dispatch_pool.submit(self._drain_actor_sends, spec.actor_id)
+            return
         self._dispatch_pool.submit(self._dispatch_blocking, spec, node_id, client)
+
+    def _drain_actor_sends(self, actor_id: str) -> None:
+        while True:
+            with self._lock:
+                q = self._actor_send.get(actor_id)
+                if not q:
+                    self._actor_sending.discard(actor_id)
+                    return
+                spec, node_id, client = q.popleft()
+            self._dispatch_blocking(spec, node_id, client)
 
     def _dispatch_blocking(
         self, spec: LeaseRequest, node_id: str, client: RpcClient
@@ -703,6 +764,17 @@ class HeadServer:
             info = self._actors.get(actor_id)
             if info is None:
                 return
+            if info.state == "DEAD":
+                # killed while its creation lease was still in flight: don't
+                # resurrect — tear the instance down on the hosting agent.
+                client = self._clients.get(node_id)
+                if client is not None:
+                    self._dispatch_pool.submit(
+                        lambda: _best_effort(
+                            client.call, "KillActor", {"actor_id": actor_id}
+                        )
+                    )
+                return
             info.state = "ALIVE"
             info.node_id = node_id
             info.address = address
@@ -753,12 +825,17 @@ class HeadServer:
         with self._cond:
             self._pgs[state.pg_id] = state
             self._pending_pgs.append(state)
+            self._pgs_dirty = True
             self._cond.notify_all()
         return {"pg_id": state.pg_id}
 
     def _try_schedule_pgs(self) -> None:
         with self._lock:
             pending = list(self._pending_pgs)
+            # consume the dirty bit: retry again only after the view changes
+            # (node joins, reports, freed leases) — an unschedulable PG must
+            # not busy-spin the scheduler thread.
+            self._pgs_dirty = False
         for state in pending:
             if state.removed:
                 with self._lock:
@@ -944,6 +1021,9 @@ class HeadServer:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+        self.jobs.shutdown()
+        if self.dashboard is not None:
+            self.dashboard.stop()
         with self._lock:
             clients = list(self._clients.values())
         for client in clients:
@@ -961,13 +1041,22 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
     parser = argparse.ArgumentParser(description="ray_tpu head server")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=6380)
+    parser.add_argument("--dashboard-port", type=int, default=8265)
+    parser.add_argument("--no-dashboard", action="store_true")
     parser.add_argument("--device-scheduler", action="store_true")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     head = HeadServer(
-        host=args.host, port=args.port, use_device_scheduler=args.device_scheduler
+        host=args.host,
+        port=args.port,
+        use_device_scheduler=args.device_scheduler,
+        dashboard_port=None if args.no_dashboard else args.dashboard_port,
     )
     print(f"ray_tpu head listening on {head.address}", flush=True)
+    if head.dashboard is not None:
+        print(
+            f"dashboard at http://{args.host}:{head.dashboard.port}", flush=True
+        )
     try:
         while True:
             time.sleep(3600)

@@ -80,6 +80,12 @@ class Worker:
         )
         status = reply["status"]
         if status == "local":
+            if self.store is None:
+                # our shm mapping failed but the agent's works: fetch bytes
+                data = self.agent.call(
+                    "FetchObject", {"object_id": hex_id}, timeout=120.0
+                )
+                return pickle.loads(data)
             return pickle.loads(self.store.get_bytes(hex_id))
         if status == "inline":
             return pickle.loads(reply["data"])

@@ -134,7 +134,6 @@ def kill(actor_handle, *, no_restart: bool = True) -> None:
         return
     state = actor_handle._actor_state
     state.mark_died(restart=not no_restart)
-    rt = get_runtime()
     if state._held_req is not None:
         node_id, req = state._held_req
         node = rt.nodes.get(node_id)

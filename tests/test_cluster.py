@@ -62,6 +62,10 @@ class Counter:
         self.value += by
         return self.value
 
+    def set(self, v):
+        self.value = v
+        return self.value
+
     def get(self):
         return self.value
 
@@ -144,6 +148,15 @@ def test_actor_lifecycle(client):
     time.sleep(0.3)
     with pytest.raises(Exception):
         ray_tpu.get(again.get.remote(), timeout=10)
+
+
+def test_actor_method_ordering(client):
+    """Non-commutative ops: submission order must be execution order."""
+    Actor = ray_tpu.remote(Counter)
+    c = Actor.remote(0)
+    refs = [c.set.remote(i) for i in range(1, 30)]
+    ray_tpu.get(refs, timeout=60)
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 29
 
 
 def test_placement_group_cluster(client):

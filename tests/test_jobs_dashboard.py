@@ -1,0 +1,135 @@
+"""Job submission + dashboard + runtime_env tests (reference:
+dashboard/modules/job/ + dashboard head + _private/runtime_env/)."""
+import json
+import os
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.cluster.jobs import JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(dashboard=True)
+    c.add_node({"CPU": 2.0}, num_workers=2)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def job_client(cluster):
+    return JobSubmissionClient(cluster.address)
+
+
+def _write_script(tmp_path, body) -> str:
+    path = tmp_path / "entry.py"
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def test_job_submit_and_logs(cluster, job_client, tmp_path):
+    script = _write_script(
+        tmp_path,
+        """
+        import ray_tpu
+        ray_tpu.init()  # auto-connects via RAY_TPU_HEAD_ADDRESS
+        f = ray_tpu.remote(lambda x: x + 1)
+        print("RESULT:", ray_tpu.get(f.remote(41), timeout=60))
+        """,
+    )
+    job_id = job_client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = job_client.wait_until_finished(job_id, timeout=120)
+    logs = job_client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", f"job failed; logs:\n{logs}"
+    assert "RESULT: 42" in logs
+
+
+def test_job_runtime_env_vars(cluster, job_client, tmp_path):
+    script = _write_script(
+        tmp_path,
+        """
+        import os
+        print("TOKEN=" + os.environ["MY_TOKEN"])
+        """,
+    )
+    job_id = job_client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        runtime_env={"env_vars": {"MY_TOKEN": "s3cr3t"}},
+    )
+    assert job_client.wait_until_finished(job_id, timeout=60) == "SUCCEEDED"
+    assert "TOKEN=s3cr3t" in job_client.get_job_logs(job_id)
+
+
+def test_job_stop(cluster, job_client, tmp_path):
+    script = _write_script(tmp_path, "import time; time.sleep(600)")
+    job_id = job_client.submit_job(entrypoint=f"{sys.executable} {script}")
+    deadline = time.monotonic() + 30
+    while job_client.get_job_status(job_id) == "PENDING":
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    assert job_client.stop_job(job_id)
+    assert job_client.wait_until_finished(job_id, timeout=30) == "STOPPED"
+    jobs = job_client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_task_runtime_env_vars(cluster):
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.cluster.client import RemoteRuntime
+
+    rt = RemoteRuntime(cluster.address, runtime_env={"env_vars": {"TASK_FLAG": "on"}})
+    set_runtime(rt)
+    try:
+        f = ray_tpu.remote(lambda: os.environ.get("TASK_FLAG"))
+        assert ray_tpu.get(f.remote(), timeout=60) == "on"
+    finally:
+        set_runtime(None)
+
+
+def _http_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_dashboard_endpoints(cluster):
+    port = cluster.head.dashboard.port
+    base = f"http://127.0.0.1:{port}"
+    nodes = _http_json(f"{base}/api/nodes")
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+    status = _http_json(f"{base}/api/cluster_status")
+    assert "metrics" in status
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "ray_tpu_nodes_alive 1" in text
+    assert "ray_tpu_leases_submitted" in text
+
+
+def test_dashboard_job_rest(cluster, tmp_path):
+    port = cluster.head.dashboard.port
+    base = f"http://127.0.0.1:{port}"
+    script = _write_script(tmp_path, 'print("from-rest")')
+    req = urllib.request.Request(
+        f"{base}/api/jobs",
+        data=json.dumps(
+            {"entrypoint": f"{sys.executable} {script}"}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        job_id = json.loads(r.read())["job_id"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        info = _http_json(f"{base}/api/jobs/{job_id}")
+        if info["status"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.2)
+    assert info["status"] == "SUCCEEDED"
+    with urllib.request.urlopen(f"{base}/api/jobs/{job_id}/logs", timeout=10) as r:
+        assert "from-rest" in r.read().decode()
