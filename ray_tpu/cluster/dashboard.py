@@ -24,11 +24,18 @@ class Dashboard:
         self.port: Optional[int] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
+        self.error: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._serve, name="dashboard", daemon=True
         )
         self._thread.start()
         self._started.wait(timeout=10)
+        if self.error is not None:
+            raise RuntimeError(
+                f"dashboard failed to start on {host}:{port}"
+            ) from self.error
+        if self.port is None:
+            raise RuntimeError("dashboard did not start within 10s")
 
     # ------------------------------------------------------------------
     def _routes(self) -> web.Application:
@@ -51,13 +58,18 @@ class Dashboard:
         return app
 
     def _serve(self) -> None:
-        self._loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(self._loop)
-        runner = web.AppRunner(self._routes())
-        self._loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, self.host, self._port)
-        self._loop.run_until_complete(site.start())
-        self.port = site._server.sockets[0].getsockname()[1]
+        try:
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            runner = web.AppRunner(self._routes())
+            self._loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self._port)
+            self._loop.run_until_complete(site.start())
+            self.port = site._server.sockets[0].getsockname()[1]
+        except Exception as exc:  # noqa: BLE001 - surfaced to the constructor
+            self.error = exc
+            self._started.set()
+            return
         self._started.set()
         self._loop.run_forever()
         self._loop.run_until_complete(runner.cleanup())

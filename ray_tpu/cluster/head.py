@@ -193,7 +193,9 @@ class HeadServer:
             self.nodes[info.node_id] = info
             old_client = self._clients.get(info.node_id)
             self._clients[info.node_id] = RpcClient(info.address)
-            if old_client is not None and old_client.address != info.address:
+            if old_client is not None:
+                # in-flight calls on the old channel fail with RpcError and
+                # take the normal retry paths; never leak channels on rejoin
                 old_client.close()
             self._last_report[info.node_id] = time.monotonic()
             self.view.add_node(info.node_id, info.resources, info.labels)

@@ -124,6 +124,7 @@ class JobManager:
                     # submit() raced a stop(): honor it
                     if info.status == STOPPED:
                         proc.kill()
+                        proc.wait()  # reap; no zombie for the head lifetime
                         return
                     info.status = RUNNING
                     self._procs[info.job_id] = proc

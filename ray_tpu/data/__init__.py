@@ -6,6 +6,20 @@ blocks through the operator chain as parallel tasks with bounded in-flight
 work (backpressure), blocks flowing through the object store as ObjectRefs
 (streaming_executor.py:77 shape, collapsed to a fused operator chain).
 """
-from .dataset import Dataset, from_items, from_numpy, range_  # noqa: F401
+from .dataset import (  # noqa: F401
+    Dataset,
+    GroupedData,
+    from_items,
+    from_numpy,
+    range_,
+)
+from .io import (  # noqa: F401
+    from_pandas,
+    read_csv,
+    read_parquet,
+    to_pandas,
+    write_csv,
+    write_parquet,
+)
 
 range = range_  # ray_tpu.data.range(n) parity with ray.data.range

@@ -13,8 +13,7 @@ import ray_tpu
 # -- block-level task (executed remotely) -----------------------------------
 
 
-@ray_tpu.remote
-def _apply_chain(block: List[Any], ops: List[tuple]) -> List[Any]:
+def _apply_chain_local(block: List[Any], ops: List[tuple]) -> List[Any]:
     for kind, fn, kwargs in ops:
         if kind == "map":
             block = [fn(row) for row in block]
@@ -31,6 +30,9 @@ def _apply_chain(block: List[Any], ops: List[tuple]) -> List[Any]:
                 out.extend(_batch_to_rows(result))
             block = out
     return block
+
+
+_apply_chain = ray_tpu.remote(_apply_chain_local)
 
 
 def _rows_to_batch(rows: List[Any]) -> Dict[str, np.ndarray]:
@@ -82,16 +84,184 @@ class Dataset:
         )
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self._materialize_rows()
-        return from_items(rows, override_num_blocks=num_blocks)
+        """All-to-all rebalance via the distributed shuffle (round-robin
+        random partition; reference repartition exchange ops)."""
+        from .shuffle import shuffle_blocks
+
+        refs = shuffle_blocks(
+            self._executed_blocks(), num_blocks, mode="random", seed=0
+        )
+        return Dataset(ray_tpu.get(refs), [])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        rows = self._materialize_rows()
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(len(rows))
-        return from_items(
-            [rows[i] for i in order], override_num_blocks=len(self._input_blocks)
+        """Distributed two-stage random shuffle (hash-shuffle op analog):
+        map tasks scatter rows to random partitions, reduce tasks gather —
+        rows never funnel through the driver."""
+        from .shuffle import shuffle_blocks
+
+        num = max(1, len(self._input_blocks))
+        # unseeded shuffles must differ call-to-call (epoch reshuffling)
+        eff_seed = (
+            seed
+            if seed is not None
+            else int(np.random.default_rng().integers(1 << 31))
         )
+        refs = shuffle_blocks(
+            self._executed_blocks(), num, mode="random", seed=eff_seed
+        )
+        blocks = ray_tpu.get(refs)
+        # per-partition order is arrival order; add an in-block permutation
+        rng = np.random.default_rng(eff_seed)
+        blocks = [[b[i] for i in rng.permutation(len(b))] for b in blocks]
+        return Dataset(blocks, [])
+
+    def sort(
+        self,
+        key: Optional[Any] = None,
+        descending: bool = False,
+    ) -> "Dataset":
+        """Distributed sample sort: sample range bounds, range-partition,
+        per-partition sorted reduce (sort_task_spec.py analog)."""
+        from .shuffle import _reduce_sorted, sample_bounds, shuffle_blocks
+
+        key_fn = _key_fn(key)
+        blocks = self._executed_blocks()
+        num = max(1, len(blocks))
+        bounds = sample_bounds(blocks, num, key_fn)
+        refs = shuffle_blocks(
+            blocks,
+            len(bounds) + 1,
+            mode="range",
+            key_fn=key_fn,
+            bounds=bounds,
+            reduce_fn=_reduce_sorted,
+            reduce_args=(key_fn, descending),
+        )
+        parts = ray_tpu.get(refs)
+        if descending:
+            parts = parts[::-1]
+        return Dataset(parts, [])
+
+    def groupby(self, key: Any) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def join(
+        self,
+        other: "Dataset",
+        on: str,
+        how: str = "inner",
+        num_partitions: Optional[int] = None,
+    ) -> "Dataset":
+        """Distributed hash join (hash_shuffle join op analog): both sides
+        hash-partition on the key; one join task per partition."""
+        from .shuffle import shuffle_blocks
+
+        key_fn = _key_fn(on)
+        num = num_partitions or max(
+            1, len(self._input_blocks), len(other._input_blocks)
+        )
+        left = shuffle_blocks(
+            self._executed_blocks(), num, mode="hash", key_fn=key_fn
+        )
+        right = shuffle_blocks(
+            other._executed_blocks(), num, mode="hash", key_fn=key_fn
+        )
+        refs = [
+            _join_partition.remote(on, how, lp, rp)
+            for lp, rp in zip(left, right)
+        ]
+        return Dataset(ray_tpu.get(refs), [])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        rows_a, rows_b = self._materialize_rows(), other._materialize_rows()
+        if len(rows_a) != len(rows_b):
+            raise ValueError("zip requires datasets of equal row count")
+        out = []
+        for a, b in builtins.zip(rows_a, rows_b):
+            row = dict(a) if isinstance(a, dict) else {"data": a}
+            if isinstance(b, dict):
+                for k, v in b.items():
+                    row[k if k not in row else f"{k}_1"] = v
+            else:
+                row["data_1"] = b
+            out.append(row)
+        return from_items(out, override_num_blocks=len(self._input_blocks))
+
+    def limit(self, n: int) -> "Dataset":
+        return from_items(self.take(n), override_num_blocks=1)
+
+    def unique(self, key: Optional[Any] = None) -> List[Any]:
+        key_fn = _key_fn(key)
+        seen, out = set(), []
+        for row in self.iter_rows():
+            k = key_fn(row) if key_fn else row
+            marker = repr(k)
+            if marker not in seen:
+                seen.add(marker)
+                out.append(k)
+        return out
+
+    # column ops (dict rows)
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self.map(lambda row, _n=name, _f=fn: {**row, _n: _f(row)})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map(
+            lambda row, _c=tuple(cols): {
+                k: v for k, v in row.items() if k not in _c
+            }
+        )
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map(
+            lambda row, _c=tuple(cols): {k: row[k] for k in _c}
+        )
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map(
+            lambda row, _m=dict(mapping): {_m.get(k, k): v for k, v in row.items()}
+        )
+
+    # global aggregates (distributed partials, combined on the driver)
+    def sum(self, on: Optional[str] = None):
+        parts = self._block_aggregate("sum", on)
+        return builtins.sum(p for p in parts if p is not None)
+
+    def min(self, on: Optional[str] = None):
+        parts = [p for p in self._block_aggregate("min", on) if p is not None]
+        return builtins.min(parts) if parts else None
+
+    def max(self, on: Optional[str] = None):
+        parts = [p for p in self._block_aggregate("max", on) if p is not None]
+        return builtins.max(parts) if parts else None
+
+    def mean(self, on: Optional[str] = None):
+        parts = [p for p in self._block_aggregate("moments", on) if p[0]]
+        n = builtins.sum(p[0] for p in parts)
+        return builtins.sum(p[1] for p in parts) / n if n else None
+
+    def std(self, on: Optional[str] = None, ddof: int = 1):
+        parts = [p for p in self._block_aggregate("moments", on) if p[0]]
+        n = builtins.sum(p[0] for p in parts)
+        if n <= ddof:
+            return None
+        total = builtins.sum(p[1] for p in parts)
+        sq = builtins.sum(p[2] for p in parts)
+        var = (sq - total * total / n) / (n - ddof)
+        return float(np.sqrt(builtins.max(var, 0.0)))
+
+    def _block_aggregate(self, agg: str, on: Optional[str]) -> List[Any]:
+        refs = [
+            _block_agg.remote(b, self._ops, agg, on)
+            for b in self._input_blocks
+        ]
+        return ray_tpu.get(refs)
+
+    def _executed_blocks(self) -> List[List[Any]]:
+        """Apply pending ops, returning materialized blocks (shuffle input)."""
+        if not self._ops:
+            return list(self._input_blocks)
+        return list(self.iter_blocks())
 
     def union(self, other: "Dataset") -> "Dataset":
         return from_items(
@@ -174,6 +344,141 @@ class Dataset:
             f"Dataset(num_blocks={len(self._input_blocks)}, "
             f"num_ops={len(self._ops)})"
         )
+
+
+def _key_fn(key: Any) -> Optional[Callable]:
+    """None | column-name | callable -> row-key extractor."""
+    if key is None or callable(key):
+        return key
+    return lambda row, _k=key: row[_k]
+
+
+def _scalar(row: Any, on: Optional[str]) -> Any:
+    return row[on] if on is not None else row
+
+
+@ray_tpu.remote
+def _block_agg(block: List[Any], ops: List[tuple], agg: str, on: Optional[str]):
+    block = _apply_chain_local(block, ops)
+    values = [_scalar(r, on) for r in block]
+    if agg == "sum":
+        return builtins.sum(values) if values else None
+    if agg == "min":
+        return builtins.min(values) if values else None
+    if agg == "max":
+        return builtins.max(values) if values else None
+    if agg == "moments":  # (count, sum, sum of squares)
+        arr = np.asarray(values, dtype=np.float64)
+        return (arr.size, float(arr.sum()), float((arr * arr).sum()))
+    raise ValueError(agg)
+
+
+@ray_tpu.remote
+def _join_partition(on: str, how: str, left: List[Any], right: List[Any]):
+    index: Dict[Any, List[dict]] = {}
+    for row in right:
+        index.setdefault(row[on], []).append(row)
+    out: List[dict] = []
+    matched_right = set()
+    for row in left:
+        matches = index.get(row[on], [])
+        if matches:
+            for m in matches:
+                merged = dict(row)
+                for k, v in m.items():
+                    if k != on:
+                        merged[k if k not in merged else f"{k}_right"] = v
+                out.append(merged)
+            matched_right.add(row[on])
+        elif how in ("left", "outer"):
+            out.append(dict(row))
+    if how in ("right", "outer"):
+        for key, rows in index.items():
+            if key not in matched_right:
+                out.extend(dict(r) for r in rows)
+    return out
+
+
+@ray_tpu.remote
+def _group_partition(
+    key_is_col: bool,
+    key: Any,
+    agg: str,
+    on: Optional[str],
+    fn: Optional[Callable],
+    part: List[Any],
+):
+    key_fn = _key_fn(key)
+    groups: Dict[Any, List[Any]] = {}
+    for row in part:
+        groups.setdefault(key_fn(row) if key_fn else row, []).append(row)
+    out = []
+    for gkey, rows in groups.items():
+        if agg == "map_groups":
+            out.extend(fn(rows))
+            continue
+        values = [_scalar(r, on) for r in rows]
+        if agg == "count":
+            stat = len(rows)
+        elif agg == "sum":
+            stat = builtins.sum(values)
+        elif agg == "min":
+            stat = builtins.min(values)
+        elif agg == "max":
+            stat = builtins.max(values)
+        elif agg == "mean":
+            stat = float(np.mean(np.asarray(values, dtype=np.float64)))
+        else:
+            raise ValueError(agg)
+        name = f"{agg}({on})" if on else agg
+        if key_is_col:
+            out.append({key: gkey, name: stat})
+        else:
+            out.append({"key": gkey, name: stat})
+    return out
+
+
+class GroupedData:
+    """Hash-partition by key, then per-partition group/aggregate
+    (reference: Dataset.groupby -> hash aggregate ops)."""
+
+    def __init__(self, ds: Dataset, key: Any):
+        self._ds = ds
+        self._key = key
+
+    def _run(self, agg: str, on: Optional[str] = None, fn=None) -> Dataset:
+        from .shuffle import shuffle_blocks
+
+        blocks = self._ds._executed_blocks()
+        num = max(1, len(blocks))
+        parts = shuffle_blocks(
+            blocks, num, mode="hash", key_fn=_key_fn(self._key)
+        )
+        refs = [
+            _group_partition.remote(
+                isinstance(self._key, str), self._key, agg, on, fn, p
+            )
+            for p in parts
+        ]
+        return Dataset(ray_tpu.get(refs), [])
+
+    def count(self) -> Dataset:
+        return self._run("count")
+
+    def sum(self, on: Optional[str] = None) -> Dataset:
+        return self._run("sum", on)
+
+    def min(self, on: Optional[str] = None) -> Dataset:
+        return self._run("min", on)
+
+    def max(self, on: Optional[str] = None) -> Dataset:
+        return self._run("max", on)
+
+    def mean(self, on: Optional[str] = None) -> Dataset:
+        return self._run("mean", on)
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        return self._run("map_groups", fn=fn)
 
 
 def from_items(

@@ -1,0 +1,105 @@
+"""Dataset IO: parquet / CSV / pandas interchange.
+
+The slim analog of the reference's datasource layer
+(/root/reference/python/ray/data/read_api.py + _internal/datasource/):
+file discovery on the driver, one read task per file (parallel via the
+task layer), arrow-backed parquet and csv.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .dataset import Dataset, from_items
+
+
+def _discover(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(
+                os.path.join(p, f) for f in sorted(os.listdir(p))
+                if not f.startswith(".")
+            )
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no input files under {paths}")
+    return files
+
+
+@ray_tpu.remote
+def _read_parquet_file(path: str, columns) -> list:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return table.to_pylist()
+
+
+@ray_tpu.remote
+def _read_csv_file(path: str) -> list:
+    import pyarrow.csv as pacsv
+
+    return pacsv.read_csv(path).to_pylist()
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    refs = [_read_parquet_file.remote(p, columns) for p in _discover(paths)]
+    return Dataset([ray_tpu.get(r) for r in refs], [])
+
+
+def read_csv(paths) -> Dataset:
+    refs = [_read_csv_file.remote(p) for p in _discover(paths)]
+    return Dataset([ray_tpu.get(r) for r in refs], [])
+
+
+def write_parquet(ds: Dataset, path: str) -> List[str]:
+    """One file per block (the reference writes one file per block task)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    out = []
+    for i, block in enumerate(ds.iter_blocks()):
+        if not block:
+            continue
+        rows = [r if isinstance(r, dict) else {"data": r} for r in block]
+        file_path = os.path.join(path, f"part-{i:05d}.parquet")
+        pq.write_table(pa.Table.from_pylist(rows), file_path)
+        out.append(file_path)
+    return out
+
+
+def write_csv(ds: Dataset, path: str) -> List[str]:
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
+
+    os.makedirs(path, exist_ok=True)
+    out = []
+    for i, block in enumerate(ds.iter_blocks()):
+        if not block:
+            continue
+        rows = [r if isinstance(r, dict) else {"data": r} for r in block]
+        file_path = os.path.join(path, f"part-{i:05d}.csv")
+        pacsv.write_csv(pa.Table.from_pylist(rows), file_path)
+        out.append(file_path)
+    return out
+
+
+def from_pandas(df) -> Dataset:
+    return from_items(df.to_dict("records"))
+
+
+def to_pandas(ds: Dataset):
+    import pandas as pd
+
+    rows = [
+        r if isinstance(r, dict) else {"data": r} for r in ds.iter_rows()
+    ]
+    return pd.DataFrame(rows)

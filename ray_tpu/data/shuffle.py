@@ -1,0 +1,156 @@
+"""Distributed shuffle primitives: hash/range partition + reduce.
+
+The analog of the reference's hash-shuffle operator family
+(/root/reference/python/ray/data/_internal/execution/operators/
+hash_shuffle.py and planner/exchange/): a map stage partitions every
+block by key (hash or sampled range), a reduce stage gathers one
+partition id from all map outputs — all as framework tasks over the
+object plane, so shuffles ride the same lease/object machinery as any
+other workload.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def _stable_hash(value: Any) -> int:
+    """Deterministic across worker processes (builtin hash() is salted) and
+    type-insensitive for numerics: 1, 1.0, and np.float64(1.0) must land in
+    the same partition or groupby/join silently split equal keys."""
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, (int, np.integer)):
+        return int(value)
+    if isinstance(value, (float, np.floating)):
+        f = float(value)
+        if f.is_integer():
+            return int(f)
+        data = repr(f).encode()
+    elif isinstance(value, (str, np.str_)):
+        data = str(value).encode()
+    else:
+        data = repr(value).encode()
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "little")
+
+
+@ray_tpu.remote
+def _partition_block(
+    block: List[Any],
+    num_parts: int,
+    mode: str,
+    key_fn: Optional[Callable],
+    bounds: Optional[List[Any]],
+    seed: Optional[int],
+) -> tuple:
+    """Map side: split one block into num_parts lists."""
+    parts: List[List[Any]] = [[] for _ in range(num_parts)]
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        dest = rng.integers(0, num_parts, size=len(block))
+        for row, d in zip(block, dest):
+            parts[int(d)].append(row)
+    elif mode == "hash":
+        for row in block:
+            key = key_fn(row) if key_fn else row
+            parts[_stable_hash(key) % num_parts].append(row)
+    elif mode == "range":
+        for row in block:
+            key = key_fn(row) if key_fn else row
+            lo, hi = 0, len(bounds)  # first bound > key
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if bounds[mid] <= key:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            parts[lo].append(row)
+    else:
+        raise ValueError(f"unknown partition mode {mode}")
+    if num_parts == 1:
+        return parts[0]  # num_returns=1 -> single (unwrapped) return value
+    return tuple(parts)
+
+
+@ray_tpu.remote
+def _reduce_concat(*parts: List[Any]) -> List[Any]:
+    out: List[Any] = []
+    for p in parts:
+        out.extend(p)
+    return out
+
+
+@ray_tpu.remote
+def _reduce_sorted(key_fn: Optional[Callable], descending: bool, *parts) -> List[Any]:
+    out: List[Any] = []
+    for p in parts:
+        out.extend(p)
+    out.sort(key=key_fn, reverse=descending)
+    return out
+
+
+def shuffle_blocks(
+    blocks: List[List[Any]],
+    num_parts: int,
+    *,
+    mode: str = "hash",
+    key_fn: Optional[Callable] = None,
+    bounds: Optional[List[Any]] = None,
+    seed: Optional[int] = None,
+    reduce_fn=None,
+    reduce_args: tuple = (),
+) -> List[Any]:
+    """Run the two-stage shuffle; returns one ObjectRef per output part."""
+    if reduce_fn is None:
+        reduce_fn = _reduce_concat
+    map_refs = [
+        _partition_block.options(num_returns=num_parts).remote(
+            block,
+            num_parts,
+            mode,
+            key_fn,
+            bounds,
+            None if seed is None else seed + i,
+        )
+        for i, block in enumerate(blocks)
+    ]
+    if num_parts == 1:
+        map_refs = [[r] for r in map_refs]
+    return [
+        reduce_fn.remote(*reduce_args, *[m[p] for m in map_refs])
+        for p in range(num_parts)
+    ]
+
+
+def sample_bounds(
+    blocks: List[List[Any]],
+    num_parts: int,
+    key_fn: Optional[Callable],
+    samples_per_block: int = 64,
+) -> List[Any]:
+    """Range-partition boundaries from per-block samples (the reference's
+    sort sampling stage, planner/exchange/sort_task_spec.py)."""
+
+    @ray_tpu.remote
+    def sample(block):
+        keys = [key_fn(r) if key_fn else r for r in block]
+        if len(keys) <= samples_per_block:
+            return keys
+        idx = np.random.default_rng(0).choice(
+            len(keys), samples_per_block, replace=False
+        )
+        return [keys[i] for i in idx]
+
+    all_keys = sorted(
+        k
+        for block_keys in ray_tpu.get([sample.remote(b) for b in blocks])
+        for k in block_keys
+    )
+    if not all_keys:
+        return []
+    step = max(1, len(all_keys) // num_parts)
+    return [all_keys[i] for i in range(step, len(all_keys), step)][: num_parts - 1]

@@ -1,0 +1,125 @@
+"""Distributed data ops: shuffle, sort, groupby, join, aggregates, IO
+(reference: python/ray/data/tests/ shapes)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def runtime():
+    ray_tpu.init(
+        num_nodes=2,
+        resources_per_node={"CPU": 4, "memory": 1 << 30},
+        ignore_reinit_error=True,
+    )
+    yield
+    ray_tpu.shutdown()
+
+
+def test_random_shuffle_distributed():
+    ds = rd.range(1000, override_num_blocks=8).random_shuffle(seed=7)
+    rows = ds.take_all()
+    assert sorted(rows) == list(range(1000))
+    assert rows != list(range(1000))
+    assert ds.num_blocks() == 8
+
+
+def test_repartition():
+    ds = rd.range(100, override_num_blocks=10).repartition(3)
+    assert ds.num_blocks() == 3
+    assert sorted(ds.take_all()) == list(range(100))
+
+
+def test_sort_scalars_and_records():
+    ds = rd.range(500, override_num_blocks=5).random_shuffle(seed=1)
+    assert ds.sort().take_all() == list(range(500))
+    assert ds.sort(descending=True).take(3) == [499, 498, 497]
+    recs = rd.from_items(
+        [{"k": i % 7, "v": i} for i in range(200)]
+    ).sort(key="v", descending=False)
+    vs = [r["v"] for r in recs.take_all()]
+    assert vs == sorted(vs)
+
+
+def test_groupby_aggregates():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)])
+    counts = {r["k"]: r["count"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == sum(i for i in range(30) if i % 3 == 0)
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[1] == np.mean([i for i in range(30) if i % 3 == 1])
+
+
+def test_groupby_map_groups():
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(10)])
+    out = ds.groupby("k").map_groups(
+        lambda rows: [{"k": rows[0]["k"], "n": len(rows)}]
+    )
+    assert sorted((r["k"], r["n"]) for r in out.take_all()) == [(0, 5), (1, 5)]
+
+
+def test_join_inner_left_outer():
+    left = rd.from_items([{"id": i, "a": i * 10} for i in range(6)])
+    right = rd.from_items([{"id": i, "b": i * 100} for i in range(3, 9)])
+    inner = left.join(right, on="id").take_all()
+    assert sorted(r["id"] for r in inner) == [3, 4, 5]
+    assert all(r["a"] == r["id"] * 10 and r["b"] == r["id"] * 100 for r in inner)
+    lj = left.join(right, on="id", how="left").take_all()
+    assert sorted(r["id"] for r in lj) == list(range(6))
+    outer = left.join(right, on="id", how="outer").take_all()
+    assert sorted(r["id"] for r in outer) == list(range(9))
+
+
+def test_global_aggregates():
+    ds = rd.range(100, override_num_blocks=7)
+    assert ds.sum() == 4950
+    assert ds.min() == 0 and ds.max() == 99
+    assert ds.mean() == 49.5
+    assert abs(ds.std() - np.std(np.arange(100), ddof=1)) < 1e-9
+    recs = rd.from_items([{"v": float(i)} for i in range(10)])
+    assert recs.sum("v") == 45.0
+
+
+def test_column_ops_and_unique():
+    ds = rd.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    wide = ds.add_column("c", lambda r: r["a"] + r["b"])
+    assert wide.take(1)[0]["c"] == 0
+    assert set(wide.select_columns(["a", "c"]).take(1)[0].keys()) == {"a", "c"}
+    assert set(wide.drop_columns(["b"]).take(1)[0].keys()) == {"a", "c"}
+    renamed = ds.rename_columns({"a": "x"})
+    assert "x" in renamed.take(1)[0]
+    assert sorted(rd.from_items([1, 2, 2, 3, 3, 3]).unique()) == [1, 2, 3]
+
+
+def test_zip_and_limit():
+    a = rd.from_items([{"x": i} for i in range(5)])
+    b = rd.from_items([{"y": i * 2} for i in range(5)])
+    z = a.zip(b).take_all()
+    assert all(r["y"] == r["x"] * 2 for r in z)
+    assert rd.range(100).limit(5).take_all() == [0, 1, 2, 3, 4]
+
+
+def test_parquet_csv_roundtrip(tmp_path):
+    ds = rd.from_items([{"id": i, "val": float(i) / 3} for i in range(50)])
+    pq_dir = str(tmp_path / "pq")
+    files = rd.write_parquet(ds, pq_dir)
+    assert files
+    back = rd.read_parquet(pq_dir).sort(key="id").take_all()
+    assert [r["id"] for r in back] == list(range(50))
+    csv_dir = str(tmp_path / "csv")
+    rd.write_csv(ds, csv_dir)
+    back2 = rd.read_csv(csv_dir).sort(key="id").take_all()
+    assert [r["id"] for r in back2] == list(range(50))
+
+
+def test_pandas_interchange():
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rd.from_pandas(df)
+    assert ds.count() == 3
+    df2 = rd.to_pandas(ds.map(lambda r: {**r, "a": r["a"] * 10}))
+    assert list(df2["a"]) == [10, 20, 30]

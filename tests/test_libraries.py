@@ -245,7 +245,9 @@ def test_serve_autoscaling_up(rt):
     handle = serve.run(Slow.bind())
     assert handle.num_replicas == 1
     refs = [handle.remote(i) for i in range(12)]
-    time.sleep(1.2)
+    deadline = time.monotonic() + 8.0
+    while handle.num_replicas <= 1 and time.monotonic() < deadline:
+        time.sleep(0.1)
     assert handle.num_replicas > 1  # scaled up under load
     assert sorted(ray_tpu.get(refs)) == list(range(12))
 
