@@ -22,7 +22,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.scheduler import NodeResourceLedger, ResourceRequest, ResourceVocab
+from ray_tpu.scheduler import ResourceRequest, ResourceVocab
+from ray_tpu.scheduler.resources import make_ledger
 
 from .common import (
     REPORT_PERIOD_S,
@@ -91,7 +92,7 @@ class NodeAgent:
         self.head_address = head_address
         self.head = RpcClient(head_address)
         self.vocab = ResourceVocab()
-        self.ledger = NodeResourceLedger(self.vocab, resources)
+        self.ledger = make_ledger(self.vocab, resources)
         self.resources = dict(resources)
         self.labels = dict(labels or {})
         self._lock = threading.RLock()

@@ -35,6 +35,8 @@ _groups_lock = threading.Lock()
 # (max_concurrency > 1) and restarts; plain threads fall back to thread id.
 _ranks: Dict[tuple, int] = {}
 _ranks_lock = threading.Lock()
+# distributed (cross-process) groups, keyed like _ranks
+_dist_groups: Dict[tuple, Any] = {}
 
 
 def _caller_key() -> Any:
@@ -49,13 +51,34 @@ def _caller_key() -> Any:
     return ("thread", threading.get_ident())
 
 
+def _runtime_is_remote() -> bool:
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        return bool(getattr(get_runtime(), "is_remote", False))
+    except Exception:  # noqa: BLE001 - runtime not initialized
+        return False
+
+
 def init_collective_group(
     world_size: int,
     rank: int,
     backend: str = "host",
     group_name: str = "default",
 ) -> None:
-    """Per-rank group registration (collective.py:146 parity)."""
+    """Per-rank group registration (collective.py:146 parity).
+
+    backend="host" rendezvouses in-process (single-process runtime);
+    backend="distributed" — or any backend when running against a live
+    multi-process cluster — rendezvouses through a named actor reachable
+    over DCN (collective/distributed.py)."""
+    if backend == "distributed" or _runtime_is_remote():
+        from .distributed import create_distributed_group
+
+        group = create_distributed_group(world_size, rank, group_name)
+        with _ranks_lock:
+            _dist_groups[(group_name, _caller_key())] = group
+        return
     with _groups_lock:
         if group_name not in _groups:
             _groups[group_name] = _Group(
@@ -93,6 +116,11 @@ def create_collective_group(
     ray_tpu.get(refs)
 
 
+def _dist_group(group_name: str):
+    with _ranks_lock:
+        return _dist_groups.get((group_name, _caller_key()))
+
+
 def _group_and_rank(group_name: str):
     g = _groups.get(group_name)
     if g is None:
@@ -108,14 +136,23 @@ def _group_and_rank(group_name: str):
 
 
 def get_rank(group_name: str = "default") -> int:
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.rank
     return _group_and_rank(group_name)[1]
 
 
 def get_collective_group_size(group_name: str = "default") -> int:
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.world
     return _group_and_rank(group_name)[0].world_size
 
 
 def barrier(group_name: str = "default") -> None:
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.barrier()
     g, _ = _group_and_rank(group_name)
     g.barrier.wait()
 
@@ -137,17 +174,26 @@ def _all_to_driver(g: _Group, rank: int, value: Any) -> List[Any]:
 
 
 def allreduce(tensor, op: str = "sum", group_name: str = "default"):
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.allreduce(tensor, op)
     g, rank = _group_and_rank(group_name)
     gathered = _all_to_driver(g, rank, np.asarray(tensor))
     return _REDUCE_OPS[op](np.stack(gathered))
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.allgather(tensor)
     g, rank = _group_and_rank(group_name)
     return [np.asarray(x) for x in _all_to_driver(g, rank, np.asarray(tensor))]
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.broadcast(tensor, src_rank)
     g, rank = _group_and_rank(group_name)
     gathered = _all_to_driver(g, rank, np.asarray(tensor))
     return gathered[src_rank]
@@ -155,6 +201,9 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
 
 def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
     """Each rank gets its 1/world_size shard of the reduction."""
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.reducescatter(tensor, op)
     g, rank = _group_and_rank(group_name)
     gathered = _all_to_driver(g, rank, np.asarray(tensor))
     reduced = _REDUCE_OPS[op](np.stack(gathered))
@@ -162,6 +211,9 @@ def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.send(tensor, dst_rank)
     g, rank = _group_and_rank(group_name)
     with g.p2p_cv:
         g.p2p.setdefault((rank, dst_rank), []).append(np.asarray(tensor))
@@ -170,6 +222,9 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
 
 def recv(src_rank: int, group_name: str = "default", timeout: float = 30.0):
     """Messages are delivered in send order (FIFO per (src, dst) pair)."""
+    dg = _dist_group(group_name)
+    if dg is not None:
+        return dg.recv(src_rank, timeout)
     g, rank = _group_and_rank(group_name)
     key = (src_rank, rank)
     with g.p2p_cv:
@@ -186,6 +241,14 @@ def recv(src_rank: int, group_name: str = "default", timeout: float = 30.0):
 def destroy_collective_group(group_name: str = "default") -> None:
     with _groups_lock:
         _groups.pop(group_name, None)
+    doomed = []
+    with _ranks_lock:
+        for key in [k for k in _dist_groups if k[0] == group_name]:
+            doomed.append(_dist_groups.pop(key))
+    if doomed:
+        from .distributed import destroy_distributed_group
+
+        destroy_distributed_group(doomed[0])
 
 
 def collective_actor_mixin(cls):

@@ -1,0 +1,181 @@
+"""Cross-process collective groups: the DCN host-collective backend.
+
+The reference's host collectives are NCCL/Gloo process groups
+(/root/reference/python/ray/util/collective/collective_group/). On TPU the
+*data-plane* collectives are XLA-on-ICI inside jit; what remains is a
+host-level rendezvous across worker processes/hosts — here built on a named
+rendezvous actor reachable from every process in the cluster (DCN traffic
+rides the same gRPC object plane as everything else).
+
+Actor methods run serially, so the protocol is non-blocking
+contribute/poll: every rank posts its contribution, then polls until the
+group is complete. Op ids come from per-op monotonic counters, which are
+consistent across ranks because collective calls are SPMD-ordered (the
+same assumption NCCL makes).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "product": lambda xs: np.prod(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+}
+
+_POLL_S = 0.01
+
+
+class CollectiveGroupActor:
+    """Rendezvous state for one group (runs as a named actor)."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self.slots: Dict[str, Dict[int, Any]] = {}
+        self.fetched: Dict[str, set] = {}
+        self.mailbox: Dict[tuple, Any] = {}
+
+    def world_size(self) -> int:
+        return self.world
+
+    def contribute(self, op_id: str, rank: int, value: Any) -> None:
+        self.slots.setdefault(op_id, {})[rank] = value
+
+    def poll(self, op_id: str, rank: int) -> Optional[List[Any]]:
+        s = self.slots.get(op_id)
+        if s is None or len(s) < self.world:
+            return None
+        out = [s[r] for r in range(self.world)]
+        done = self.fetched.setdefault(op_id, set())
+        done.add(rank)
+        if len(done) == self.world:
+            del self.slots[op_id]
+            del self.fetched[op_id]
+        return out
+
+    # point-to-point
+    def put(self, key: tuple, value: Any) -> None:
+        self.mailbox[key] = value
+
+    def take(self, key: tuple) -> tuple:
+        if key in self.mailbox:
+            return (True, self.mailbox.pop(key))
+        return (False, None)
+
+
+class DistributedGroup:
+    """Per-process view of one collective group."""
+
+    def __init__(self, handle, world_size: int, rank: int, name: str):
+        self.handle = handle
+        self.world = world_size
+        self.rank = rank
+        self.name = name
+        self._counters: Dict[str, int] = {}
+
+    def _op_id(self, op: str) -> str:
+        n = self._counters.get(op, 0)
+        self._counters[op] = n + 1
+        return f"{op}:{n}"
+
+    def _rendezvous(self, op: str, value: Any, timeout: float = 120.0) -> List[Any]:
+        op_id = self._op_id(op)
+        ray_tpu.get(
+            self.handle.contribute.remote(op_id, self.rank, value), timeout=60
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            out = ray_tpu.get(
+                self.handle.poll.remote(op_id, self.rank), timeout=60
+            )
+            if out is not None:
+                return out
+            time.sleep(_POLL_S)
+        raise TimeoutError(
+            f"collective {op_id} in group {self.name!r} timed out "
+            f"({self.world} ranks expected)"
+        )
+
+    # ------------------------------------------------------------------
+    def allreduce(self, tensor, op: str = "sum"):
+        values = self._rendezvous("allreduce", np.asarray(tensor))
+        return _REDUCE_OPS[op](values)
+
+    def allgather(self, tensor) -> List[np.ndarray]:
+        return [np.asarray(v) for v in self._rendezvous("allgather", np.asarray(tensor))]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        values = self._rendezvous("broadcast", np.asarray(tensor))
+        return np.asarray(values[src_rank])
+
+    def reducescatter(self, tensor, op: str = "sum"):
+        values = self._rendezvous("reducescatter", np.asarray(tensor))
+        reduced = _REDUCE_OPS[op](values)
+        return np.array_split(reduced, self.world)[self.rank]
+
+    def barrier(self) -> None:
+        self._rendezvous("barrier", None)
+
+    def send(self, tensor, dst_rank: int) -> None:
+        n = self._counters.get(f"p2p:{self.rank}->{dst_rank}", 0)
+        self._counters[f"p2p:{self.rank}->{dst_rank}"] = n + 1
+        ray_tpu.get(
+            self.handle.put.remote(
+                (self.rank, dst_rank, n), np.asarray(tensor)
+            ),
+            timeout=30,
+        )
+
+    def recv(self, src_rank: int, timeout: float = 30.0):
+        counter_key = f"p2p:{src_rank}->{self.rank}"
+        key_n = self._counters.get(counter_key, 0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ok, value = ray_tpu.get(
+                self.handle.take.remote((src_rank, self.rank, key_n)),
+                timeout=30,
+            )
+            if ok:
+                # advance only on success so a timed-out recv can be retried
+                # without skipping the in-flight message
+                self._counters[counter_key] = key_n + 1
+                return value
+            time.sleep(_POLL_S)
+        raise TimeoutError(f"recv from rank {src_rank} timed out")
+
+
+def create_distributed_group(
+    world_size: int, rank: int, group_name: str
+) -> DistributedGroup:
+    """Join (creating if first) the named rendezvous actor for this group."""
+    actor_name = f"_collective:{group_name}"
+    Actor = ray_tpu.remote(CollectiveGroupActor)
+    try:
+        handle = ray_tpu.get_actor(actor_name)
+    except ValueError:
+        try:
+            handle = Actor.options(name=actor_name).remote(world_size)
+        except ValueError:  # lost the creation race
+            handle = ray_tpu.get_actor(actor_name)
+    existing = ray_tpu.get(handle.world_size.remote(), timeout=60)
+    if existing != world_size:
+        raise ValueError(
+            f"collective group {group_name!r} already exists with "
+            f"world_size={existing} (requested {world_size}); destroy it "
+            "first or use a distinct group_name per job"
+        )
+    return DistributedGroup(handle, world_size, rank, group_name)
+
+
+def destroy_distributed_group(group: DistributedGroup) -> None:
+    """Tear down the rendezvous actor so the name can be reused."""
+    try:
+        ray_tpu.kill(group.handle)
+    except Exception:  # noqa: BLE001 - already gone
+        pass

@@ -91,7 +91,7 @@ class Dataset:
         refs = shuffle_blocks(
             self._executed_blocks(), num_blocks, mode="random", seed=0
         )
-        return Dataset(ray_tpu.get(refs), [])
+        return Dataset(refs, [])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Distributed two-stage random shuffle (hash-shuffle op analog):
@@ -106,14 +106,17 @@ class Dataset:
             if seed is not None
             else int(np.random.default_rng().integers(1 << 31))
         )
+        from .shuffle import _reduce_shuffled
+
         refs = shuffle_blocks(
-            self._executed_blocks(), num, mode="random", seed=eff_seed
+            self._executed_blocks(),
+            num,
+            mode="random",
+            seed=eff_seed,
+            reduce_fn=_reduce_shuffled,
+            reduce_args=(eff_seed,),
         )
-        blocks = ray_tpu.get(refs)
-        # per-partition order is arrival order; add an in-block permutation
-        rng = np.random.default_rng(eff_seed)
-        blocks = [[b[i] for i in rng.permutation(len(b))] for b in blocks]
-        return Dataset(blocks, [])
+        return Dataset(refs, [])
 
     def sort(
         self,
@@ -137,10 +140,9 @@ class Dataset:
             reduce_fn=_reduce_sorted,
             reduce_args=(key_fn, descending),
         )
-        parts = ray_tpu.get(refs)
         if descending:
-            parts = parts[::-1]
-        return Dataset(parts, [])
+            refs = refs[::-1]
+        return Dataset(refs, [])
 
     def groupby(self, key: Any) -> "GroupedData":
         return GroupedData(self, key)
@@ -170,7 +172,7 @@ class Dataset:
             _join_partition.remote(on, how, lp, rp)
             for lp, rp in zip(left, right)
         ]
-        return Dataset(ray_tpu.get(refs), [])
+        return Dataset(refs, [])
 
     def zip(self, other: "Dataset") -> "Dataset":
         rows_a, rows_b = self._materialize_rows(), other._materialize_rows()
@@ -281,9 +283,12 @@ class Dataset:
     # execution (streaming)
     def iter_blocks(self) -> Iterator[List[Any]]:
         """Streaming executor: bounded in-flight block tasks (backpressure,
-        resource_manager.py semantics collapsed to a window)."""
+        resource_manager.py semantics collapsed to a window). Blocks may be
+        host lists or ObjectRefs (shuffle outputs stay in the object store
+        until consumed — no driver funnel)."""
         if not self._ops:
-            yield from self._input_blocks
+            for b in self._input_blocks:
+                yield ray_tpu.get(b) if isinstance(b, ray_tpu.ObjectRef) else b
             return
         max_in_flight = max(
             2, int(ray_tpu.cluster_resources().get("CPU", 4))
@@ -460,7 +465,7 @@ class GroupedData:
             )
             for p in parts
         ]
-        return Dataset(ray_tpu.get(refs), [])
+        return Dataset(refs, [])
 
     def count(self) -> Dataset:
         return self._run("count")

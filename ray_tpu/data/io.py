@@ -17,20 +17,25 @@ import ray_tpu
 from .dataset import Dataset, from_items
 
 
-def _discover(paths) -> List[str]:
+def _discover(paths, suffixes: tuple) -> List[str]:
+    """Regular files with a matching extension only — foreign entries
+    (_SUCCESS markers, subdirs, mixed formats) must not fail the read."""
     if isinstance(paths, str):
         paths = [paths]
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
             files.extend(
-                os.path.join(p, f) for f in sorted(os.listdir(p))
+                fp
+                for f in sorted(os.listdir(p))
                 if not f.startswith(".")
+                and f.lower().endswith(suffixes)
+                and os.path.isfile(fp := os.path.join(p, f))
             )
         else:
             files.append(p)
     if not files:
-        raise FileNotFoundError(f"no input files under {paths}")
+        raise FileNotFoundError(f"no {suffixes} files under {paths}")
     return files
 
 
@@ -50,13 +55,16 @@ def _read_csv_file(path: str) -> list:
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    refs = [_read_parquet_file.remote(p, columns) for p in _discover(paths)]
-    return Dataset([ray_tpu.get(r) for r in refs], [])
+    refs = [
+        _read_parquet_file.remote(p, columns)
+        for p in _discover(paths, (".parquet", ".pq"))
+    ]
+    return Dataset(refs, [])
 
 
 def read_csv(paths) -> Dataset:
-    refs = [_read_csv_file.remote(p) for p in _discover(paths)]
-    return Dataset([ray_tpu.get(r) for r in refs], [])
+    refs = [_read_csv_file.remote(p) for p in _discover(paths, (".csv",))]
+    return Dataset(refs, [])
 
 
 def write_parquet(ds: Dataset, path: str) -> List[str]:

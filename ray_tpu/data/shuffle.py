@@ -85,6 +85,15 @@ def _reduce_concat(*parts: List[Any]) -> List[Any]:
 
 
 @ray_tpu.remote
+def _reduce_shuffled(seed: int, *parts: List[Any]) -> List[Any]:
+    out: List[Any] = []
+    for p in parts:
+        out.extend(p)
+    rng = np.random.default_rng(seed)
+    return [out[i] for i in rng.permutation(len(out))]
+
+
+@ray_tpu.remote
 def _reduce_sorted(key_fn: Optional[Callable], descending: bool, *parts) -> List[Any]:
     out: List[Any] = []
     for p in parts:

@@ -49,6 +49,10 @@ class ModelConfig:
     n_experts: int = 0          # 0 = dense MLP; >0 = Switch-MoE every layer
     expert_capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    # sequence-parallel attention flavor: "ring" (ppermute KV rotation,
+    # ops/ring_attention.py) or "ulysses" (all-to-all head/sequence swap,
+    # ops/ulysses.py) — both net-new vs the reference (SURVEY §2.3).
+    sp_attention: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -143,7 +147,12 @@ def _block(cfg: ModelConfig, p: Dict[str, jax.Array], h: jax.Array,
     q = apply_rope(q, angles)
     k = apply_rope(k, angles)
     if sp_manual:
-        attn = ring_attention(q, k, v, "sp", causal=True)
+        if cfg.sp_attention == "ulysses":
+            from ray_tpu.ops.ulysses import ulysses_attention
+
+            attn = ulysses_attention(q, k, v, "sp", causal=True)
+        else:
+            attn = ring_attention(q, k, v, "sp", causal=True)
     elif jax.default_backend() not in ("cpu",):
         # TPU: pallas flash kernel (falls back internally on ragged shapes)
         from ray_tpu.ops.flash_attention import flash_attention

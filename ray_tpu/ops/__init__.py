@@ -7,4 +7,5 @@ from .layers import (  # noqa: F401
     swiglu,
 )
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401

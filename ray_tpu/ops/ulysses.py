@@ -1,0 +1,80 @@
+"""Ulysses attention: all-to-all sequence parallelism over the ``sp`` axis.
+
+Net-new relative to the reference (SURVEY §2.3 / §5: no SP/CP exists
+there — sequence length is delegated to the wrapped engines). This is the
+DeepSpeed-Ulysses scheme re-expressed as XLA collectives: with sequences
+sharded over ``sp``, an ``all_to_all`` swaps the shard dimension from
+sequence to heads, every device computes *full-sequence* attention for its
+head slice (MXU-friendly single big matmul — no per-block online softmax),
+and a second ``all_to_all`` swaps back. Two collectives per layer versus
+ring attention's sp ppermutes; the better choice when heads ≥ sp and the
+sequence fits per-device HBM once.
+
+Use inside shard_map with sequence sharded over ``axis_name``:
+    q: [B, T_local, H, D], k/v: [B, T_local, Hkv, D] per device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T_local, H, D] -> [B, T_full, H/sp, D] via tiled all-to-all."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T_full, H/sp, D] -> [B, T_local, H, D] (inverse swap)."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    sp = jax.lax.axis_size(axis_name)
+    b, t, h, d = q.shape
+    hkv = k.shape[2]
+    if h % sp != 0:
+        raise ValueError(f"n_heads={h} must divide by sp={sp} for Ulysses")
+    # GQA with fewer KV heads than sp: replicate KV heads up to sp so the
+    # head all-to-all has something to split (grouping is preserved below).
+    if hkv % sp != 0:
+        import math
+
+        reps = math.lcm(hkv, sp) // hkv  # smallest expansion divisible by sp
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+        hkv = hkv * reps
+    qg = _heads_to_seq(q, axis_name)  # [B, T, h/sp, D]
+    kg = _heads_to_seq(k, axis_name)  # [B, T, hkv/sp, D]
+    vg = _heads_to_seq(v, axis_name)
+    groups = qg.shape[2] // kg.shape[2]
+    t_full = qg.shape[1]
+    qh = qg.reshape(b, t_full, kg.shape[2], groups, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    s = (
+        jnp.einsum(
+            "bthgd,bshd->bhgts",
+            qh.astype(jnp.float32),
+            kg.astype(jnp.float32),
+        )
+        * scale
+    )
+    if causal:
+        pos = jnp.arange(t_full)
+        mask = pos[None, :] <= pos[:, None]  # [t, s]
+        s = jnp.where(mask[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgts,bshd->bthgd", p, vg.astype(jnp.float32))
+    o = o.reshape(b, t_full, qg.shape[2], d).astype(q.dtype)
+    return _seq_to_heads(o, axis_name)  # back to [B, T_local, H, D]

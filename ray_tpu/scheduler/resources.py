@@ -199,6 +199,23 @@ class NodeResourceLedger:
             }
 
 
+def make_ledger(vocab: ResourceVocab, total: Mapping[str, float]):
+    """Prefer the native C++ ledger (ray_tpu/native/ledger.cc — the
+    LocalResourceManager-analog admission hot path); fall back to the pure
+    Python implementation when the toolchain is unavailable.
+    Disable with RAY_TPU_NATIVE_LEDGER=0."""
+    import os
+
+    if os.environ.get("RAY_TPU_NATIVE_LEDGER", "1") != "0":
+        try:
+            from ray_tpu.native.native_ledger import NativeNodeResourceLedger
+
+            return NativeNodeResourceLedger(vocab, total)
+        except Exception:  # noqa: BLE001 - no compiler / build failure
+            pass
+    return NodeResourceLedger(vocab, total)
+
+
 class ClusterView:
     """Dense cluster resource view: the scheduler dataplane.
 

@@ -58,6 +58,50 @@ def test_sp_ring_attention_matches_dense(setup):
     )
 
 
+def test_sp_ulysses_attention_matches_dense(setup):
+    params, tokens, _ = setup
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, sp_attention="ulysses")
+    toks = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0, CFG.vocab_size)
+    dense = tfm.forward(params, toks, cfg)
+    mesh = build_mesh(MeshConfig(sp=4), jax.devices()[:4])
+    out = tfm.forward(params, toks, cfg, mesh)
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(out), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_ulysses_raw_matches_reference(devices8):
+    """ulysses_attention under shard_map vs dense reference attention,
+    incl. the GQA head-replication path (hkv < sp)."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from ray_tpu.ops.ulysses import ulysses_attention
+    from ray_tpu.models.transformer import attention_reference
+
+    b, t, h, hkv, d = 2, 32, 8, 2, 16
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, hkv, d), jnp.float32)
+    mesh = Mesh(np.array(devices8[:4]), ("sp",))
+    fn = shard_map(
+        partial(ulysses_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )
+    out = jax.jit(fn)(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+
+
 def test_pp_pipeline_matches_dense(setup):
     params, tokens, _ = setup
     toks = jax.random.randint(jax.random.PRNGKey(3), (8, 12), 0, CFG.vocab_size)
