@@ -161,12 +161,7 @@ class NodeAgent:
 
         reply = self.head.call(
             "RegisterNode",
-            NodeInfo(
-                node_id=self.node_id,
-                address=self.address,
-                resources=dict(resources),
-                labels=self.labels,
-            ),
+            self._node_info(),
             retries=30,
             retry_interval=0.2,
         )
@@ -581,6 +576,17 @@ class NodeAgent:
             return {"status": "local"}
         return {"status": "inline", "data": self.store.get_bytes(oid)}
 
+    def _node_info(self) -> NodeInfo:
+        with self._lock:
+            hosted = list(self._actor_workers.keys())
+        return NodeInfo(
+            node_id=self.node_id,
+            address=self.address,
+            resources=dict(self.resources),
+            labels=self.labels,
+            hosted_actors=hosted,
+        )
+
     def _peer(self, node_id: str, address: str) -> RpcClient:
         with self._lock:
             client = self._peer_clients.get(node_id)
@@ -624,20 +630,10 @@ class NodeAgent:
                     timeout=5.0,
                 )
                 if not reply.get("alive", True):
-                    # a transient heartbeat gap got us declared dead —
-                    # rejoin (the reference node would restart its raylet;
-                    # we can simply re-register the same node id).
+                    # a transient heartbeat gap (or a head restart) got us
+                    # declared dead/unknown — rejoin with our live actors.
                     logger.warning("head declared us dead; re-registering")
-                    self.head.call(
-                        "RegisterNode",
-                        NodeInfo(
-                            node_id=self.node_id,
-                            address=self.address,
-                            resources=dict(self.resources),
-                            labels=self.labels,
-                        ),
-                        timeout=5.0,
-                    )
+                    self.head.call("RegisterNode", self._node_info(), timeout=5.0)
             except RpcError:
                 continue
 

@@ -375,6 +375,16 @@ class RemoteRuntime:
     def query_state(self, kind: str = "summary") -> Any:
         return self.head.call("QueryState", {"kind": kind})
 
+    def timeline(self, filename: Optional[str] = None) -> List[dict]:
+        """Chrome-trace of head-observed lease lifecycle events."""
+        spans = self.head.call("Timeline", timeout=60.0)
+        if filename:
+            import json
+
+            with open(filename, "w") as f:
+                json.dump(spans, f)
+        return spans
+
     def shutdown(self) -> None:
         self.head.close()
         with self._lock:

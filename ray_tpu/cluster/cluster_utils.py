@@ -22,14 +22,38 @@ from .rpc import RpcClient, RpcError
 
 
 class Cluster:
-    def __init__(self, use_device_scheduler: bool = False, dashboard: bool = False):
+    def __init__(
+        self,
+        use_device_scheduler: bool = False,
+        dashboard: bool = False,
+        persist_path: Optional[str] = None,
+    ):
+        self._dashboard = dashboard
+        self._persist_path = persist_path
+        self._use_device_scheduler = use_device_scheduler
         self.head = HeadServer(
             use_device_scheduler=use_device_scheduler,
             dashboard_port=0 if dashboard else None,
+            persist_path=persist_path,
         )
         self.address = self.head.address
         self._agents: Dict[str, subprocess.Popen] = {}
         self._counter = 0
+
+    def restart_head(self) -> None:
+        """Kill and restart only the head on the same port (GCS fault
+        tolerance: agents and their actors keep running, re-register, and
+        persisted state reloads)."""
+        port = int(self.address.rsplit(":", 1)[1])
+        self.head.shutdown(stop_agents=False)
+        time.sleep(0.3)
+        self.head = HeadServer(
+            port=port,
+            use_device_scheduler=self._use_device_scheduler,
+            dashboard_port=0 if self._dashboard else None,
+            persist_path=self._persist_path,
+        )
+        assert self.head.address == self.address
 
     def add_node(
         self,

@@ -31,6 +31,9 @@ class NodeInfo:
     resources: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
+    # actors whose workers this agent still hosts — lets a restarted head
+    # re-attach live actors (GCS FT resubscribe analog, gcs_init_data.cc)
+    hosted_actors: List[str] = field(default_factory=list)
 
 
 @dataclass

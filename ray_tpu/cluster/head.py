@@ -15,6 +15,7 @@ view degrades into spillback-and-retry exactly like the reference
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import threading
 import time
@@ -90,6 +91,7 @@ class HeadServer:
         port: int = 0,
         use_device_scheduler: bool = False,
         dashboard_port: Optional[int] = None,
+        persist_path: Optional[str] = None,
     ):
         self.vocab = ResourceVocab()
         self.view = ClusterView(self.vocab)
@@ -119,6 +121,13 @@ class HeadServer:
         self._kv: Dict[str, bytes] = {}
         self._jobs: Dict[str, dict] = {}
         self._shutdown = False
+        self._persist_path = persist_path
+        self._persist_dirty = False
+        from ray_tpu.core.events import TaskEventBuffer
+
+        self.events = TaskEventBuffer()
+        if persist_path:
+            self._load_persisted()
         self.metrics: Dict[str, int] = {
             "leases_submitted": 0,
             "leases_finished": 0,
@@ -144,14 +153,15 @@ class HeadServer:
             "CreatePlacementGroup": self._h_create_pg,
             "WaitPlacementGroup": self._h_wait_pg,
             "RemovePlacementGroup": self._h_remove_pg,
-            "KvPut": lambda r: self._kv.__setitem__(r["key"], r["value"]),
+            "KvPut": self._h_kv_put,
             "KvGet": lambda r: self._kv.get(r["key"]),
-            "KvDel": lambda r: self._kv.pop(r["key"], None) and None,
+            "KvDel": self._h_kv_del,
             "KvKeys": lambda r: [
                 k for k in self._kv if k.startswith(r.get("prefix", ""))
             ],
             "ClusterInfo": self._h_cluster_info,
             "QueryState": self._h_query_state,
+            "Timeline": lambda r: self.events.dump_timeline(None),
             "SubmitJob": lambda r: self.jobs.submit(
                 entrypoint=r["entrypoint"],
                 runtime_env=r.get("runtime_env"),
@@ -169,7 +179,9 @@ class HeadServer:
 
         from .jobs import JobManager
 
-        self.jobs = JobManager(self.address)
+        self.jobs = JobManager(self.address, on_change=self.mark_dirty)
+        for job in getattr(self, "_recovered_jobs", []):
+            self.jobs.restore(job)
         self.dashboard = None
         if dashboard_port is not None:
             from .dashboard import Dashboard
@@ -184,10 +196,95 @@ class HeadServer:
         )
         self._sched_thread.start()
         self._health_thread.start()
+        if persist_path:
+            threading.Thread(
+                target=self._persist_loop, name="head-persist", daemon=True
+            ).start()
+
+    # ------------------------------------------------------------------
+    # state persistence (GCS fault tolerance analog: the reference persists
+    # its tables to Redis, store_client/redis_store_client.cc; here a
+    # debounced pickle snapshot of the durable tables — KV, jobs, and the
+    # actor directory; live actors re-attach when agents re-register)
+    # ------------------------------------------------------------------
+    def _snapshot_state(self) -> dict:
+        with self._lock:
+            return {
+                "kv": dict(self._kv),
+                "named_actors": dict(self._named_actors),
+                "actors": {
+                    a.actor_id: dict(vars(a)) for a in self._actors.values()
+                },
+                "actor_specs": dict(self._actor_specs),
+                "jobs": self.jobs.snapshot() if hasattr(self, "jobs") else [],
+            }
+
+    def _load_persisted(self) -> None:
+        import pickle as _pickle
+
+        try:
+            with open(self._persist_path, "rb") as f:
+                snap = _pickle.load(f)
+        except FileNotFoundError:
+            return
+        except Exception:  # noqa: BLE001 - corrupt snapshot: start fresh
+            logger.exception("could not load persisted head state; starting fresh")
+            return
+        self._kv = dict(snap.get("kv", {}))
+        self._named_actors = dict(snap.get("named_actors", {}))
+        self._actor_specs = dict(snap.get("actor_specs", {}))
+        for actor_id, fields in snap.get("actors", {}).items():
+            info = ActorInfo(**fields)
+            # hosting agents re-register and re-attach; until then, unknown
+            if info.state != "DEAD":
+                info.state = "RESTARTING"
+                info.node_id = None
+                info.address = None
+            self._actors[actor_id] = info
+        self._recovered_jobs = snap.get("jobs", [])
+        logger.info(
+            "recovered head state: %d kv keys, %d actors, %d jobs",
+            len(self._kv),
+            len(self._actors),
+            len(self._recovered_jobs),
+        )
+
+    def mark_dirty(self) -> None:
+        self._persist_dirty = True
+
+    def _persist_now(self) -> None:
+        import pickle as _pickle
+
+        try:
+            tmp = f"{self._persist_path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                _pickle.dump(self._snapshot_state(), f)
+            os.replace(tmp, self._persist_path)
+        except Exception:  # noqa: BLE001
+            self._persist_dirty = True  # don't lose the write; retry later
+            logger.exception("head state persistence failed")
+
+    def _persist_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(1.0)
+            if not self._persist_dirty:
+                continue
+            self._persist_dirty = False
+            self._persist_now()
 
     # ------------------------------------------------------------------
     # membership + health (GcsNodeManager / GcsHealthCheckManager analog)
     # ------------------------------------------------------------------
+    def _h_kv_put(self, r: dict) -> None:
+        with self._lock:
+            self._kv[r["key"]] = r["value"]
+        self.mark_dirty()
+
+    def _h_kv_del(self, r: dict) -> None:
+        with self._lock:
+            self._kv.pop(r["key"], None)
+        self.mark_dirty()
+
     def _h_register_node(self, info: NodeInfo) -> dict:
         with self._cond:
             self.nodes[info.node_id] = info
@@ -204,6 +301,22 @@ class HeadServer:
             self._infeasible.clear()
             self._pgs_dirty = True
             self._cond.notify_all()
+        # re-attach actors this agent still hosts (head-restart recovery:
+        # the actor instances kept running in the agent's workers)
+        for actor_id in info.hosted_actors:
+            with self._lock:
+                existing = self._actors.get(actor_id)
+                if existing is None:
+                    self._actors[actor_id] = ActorInfo(
+                        actor_id=actor_id,
+                        name=None,
+                        node_id=info.node_id,
+                        address=info.address,
+                        state="ALIVE",
+                    )
+                    continue
+            if existing.state != "DEAD":
+                self._mark_actor_alive(actor_id, info.node_id, info.address)
         logger.info("node %s registered at %s", info.node_id, info.address)
         return {"node_id": info.node_id, "head_address": self.address}
 
@@ -339,6 +452,7 @@ class HeadServer:
                 # release the name so a replacement can rebind it
                 if info.name and self._named_actors.get(info.name) == info.actor_id:
                     del self._named_actors[info.name]
+        self.mark_dirty()
         if restart:
             clone = LeaseRequest(
                 task_id=new_id(),
@@ -384,6 +498,9 @@ class HeadServer:
             for lid in lease_ids:
                 self._in_flight.pop(lid, None)
                 self.metrics["leases_finished"] += 1
+                spec = self._leases.get(lid)
+                if spec is not None:
+                    self.events.record(lid, spec.name, "FINISHED")
             # completed leases freed resources somewhere: wake parked work
             self._pending.extend(self._infeasible)
             self._infeasible.clear()
@@ -516,6 +633,7 @@ class HeadServer:
             self.metrics["leases_submitted"] += 1
             self._pending.append(spec)
             self._cond.notify_all()
+        self.events.record(spec.task_id, spec.name, "SUBMITTED")
         return {"queued": True}
 
     def _scheduler_loop(self) -> None:
@@ -725,6 +843,8 @@ class HeadServer:
                 self._in_flight.pop(spec.task_id, None)
             self._retry_or_fail(spec, f"agent {node_id} unreachable")
             return
+        if reply.get("status") == "granted":
+            self.events.record(spec.task_id, spec.name, "RUNNING", node_id)
         if reply.get("status") == "reject":
             # stale view: grant-or-reject → spill back to the queue
             with self._cond:
@@ -759,6 +879,7 @@ class HeadServer:
             self._leases[spec.task_id] = spec
             self._pending.append(spec)
             self._cond.notify_all()
+        self.mark_dirty()
         return {"actor_id": spec.actor_id}
 
     def _mark_actor_alive(self, actor_id: str, node_id: str, address: str) -> None:
@@ -784,6 +905,7 @@ class HeadServer:
             self._pending.extend(self._infeasible)
             self._infeasible.clear()
             self._cond.notify_all()
+        self.mark_dirty()
 
     def _h_get_actor(self, req: dict) -> ActorInfo:
         actor_id = req.get("actor_id")
@@ -1019,20 +1141,26 @@ class HeadServer:
                 "num_objects": len(self._objects),
             }
 
-    def shutdown(self) -> None:
+    def shutdown(self, stop_agents: bool = True) -> None:
+        """Stop the head. With ``stop_agents=False`` the agents (and their
+        actors) keep running — the head-restart recovery path."""
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+        if self._persist_path and self._persist_dirty:
+            self._persist_dirty = False
+            self._persist_now()  # flush the last debounce window
         self.jobs.shutdown()
         if self.dashboard is not None:
             self.dashboard.stop()
-        with self._lock:
-            clients = list(self._clients.values())
-        for client in clients:
-            try:
-                client.call("Shutdown", timeout=1.0)
-            except RpcError:
-                pass
+        if stop_agents:
+            with self._lock:
+                clients = list(self._clients.values())
+            for client in clients:
+                try:
+                    client.call("Shutdown", timeout=1.0)
+                except RpcError:
+                    pass
         self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
         self._server.stop()
 

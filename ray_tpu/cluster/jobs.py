@@ -59,7 +59,12 @@ class JobManager:
     """Head-side job lifecycle (JobSupervisor analog, but a plain
     subprocess on the head host rather than an actor)."""
 
-    def __init__(self, head_address: str, log_dir: Optional[str] = None):
+    def __init__(
+        self,
+        head_address: str,
+        log_dir: Optional[str] = None,
+        on_change=None,
+    ):
         self.head_address = head_address
         self.log_dir = log_dir or os.path.join(
             tempfile.gettempdir(), "ray_tpu_job_logs"
@@ -68,6 +73,34 @@ class JobManager:
         self._jobs: Dict[str, JobInfo] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
+        self._on_change = on_change or (lambda: None)
+
+    def snapshot(self) -> List[dict]:
+        """Durable job table rows (head persistence)."""
+        with self._lock:
+            return [
+                {**i.to_dict(), "log_path": i.log_path}
+                for i in self._jobs.values()
+            ]
+
+    def restore(self, row: dict) -> None:
+        """Re-load a persisted job row after a head restart. Jobs that were
+        live have lost their subprocess — mark them failed."""
+        info = JobInfo(
+            job_id=row["job_id"],
+            entrypoint=row["entrypoint"],
+            status=row["status"],
+            start_time=row.get("start_time", 0.0),
+            end_time=row.get("end_time", 0.0),
+            return_code=row.get("return_code"),
+            log_path=row.get("log_path", ""),
+            metadata=dict(row.get("metadata", {})),
+        )
+        if info.status in (PENDING, RUNNING):
+            info.status = FAILED
+            info.end_time = time.time()
+        with self._lock:
+            self._jobs[info.job_id] = info
 
     def submit(
         self,
@@ -88,6 +121,7 @@ class JobManager:
                 log_path=os.path.join(self.log_dir, f"{job_id}.log"),
             )
             self._jobs[job_id] = info
+        self._on_change()
         threading.Thread(
             target=self._run, args=(info,), name=f"job-{job_id}", daemon=True
         ).start()
@@ -134,6 +168,7 @@ class JobManager:
                 info.end_time = time.time()
                 if info.status != STOPPED:
                     info.status = SUCCEEDED if rc == 0 else FAILED
+            self._on_change()
         except Exception as exc:  # noqa: BLE001 - entrypoint must not kill head
             with self._lock:
                 info.status = FAILED
@@ -157,6 +192,7 @@ class JobManager:
                 return False
             info.status = STOPPED
             info.end_time = time.time()
+        self._on_change()
         if proc is not None:
             try:
                 proc.terminate()

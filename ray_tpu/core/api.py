@@ -170,7 +170,7 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
     reference _private/state.py:1010)."""
     rt = get_runtime()
     if getattr(rt, "is_remote", False):
-        return []  # driver-side timeline only exists for the local runtime
+        return rt.timeline(filename)
     return rt.events.dump_timeline(filename)
 
 

@@ -24,7 +24,9 @@ def build_native(name: str = "objstore") -> str:
         ):
             return out
         os.makedirs(_BUILD_DIR, exist_ok=True)
-        tmp = out + ".tmp"
+        # per-pid tmp: concurrent agent processes may compile simultaneously;
+        # os.replace keeps the publish atomic either way
+        tmp = f"{out}.{os.getpid()}.tmp"
         subprocess.run(
             [
                 "g++",
