@@ -89,19 +89,48 @@ def main():
         res.node.block_until_ready()
         times.append(time.perf_counter() - t0)
 
+    # the tunneled-TPU environment imposes a fixed relay RTT on ANY
+    # device->host fetch (a scalar pays the same as 400KB); measure it so
+    # the e2e numbers can be decomposed into kernel + environment floor.
+    scalar = jnp.zeros(())
+    scalar.block_until_ready()
+    rtt_samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(scalar + 0)
+        rtt_samples.append(time.perf_counter() - t0)
+    rtt_floor = float(np.median(rtt_samples[1:]))
+
     e2e_times = []  # including device→host readback of all assignments
     for i in range(3):
         av = jnp.asarray(avail_h)
         av.block_until_ready()
         t0 = time.perf_counter()
         res = place_all(av, np.uint32(7000 + i))
-        nodes_h = np.asarray(res.node)
+        # int16 packs 100k assignments into 200KB (node ids < 1024)
+        nodes_h = np.asarray(res.node.astype(jnp.int16))
         e2e_times.append(time.perf_counter() - t0)
-    placed = int((nodes_h >= 0).sum())
+
+    # sustained e2e: pipeline the readbacks (copy_to_host_async) so the
+    # relay latency overlaps the next batch's compute — the steady-state
+    # mode of a resident scheduler streaming decisions back to the head.
+    t0 = time.perf_counter()
+    pending = []
+    for i in range(TRIALS):
+        res = place_all(avs[i % len(avs)], np.uint32(9000 + i))
+        packed = res.node.astype(jnp.int16)
+        packed.copy_to_host_async()
+        pending.append(packed)
+    pipelined = [np.asarray(p) for p in pending]
+    e2e_pipelined_s = time.perf_counter() - t0
+    e2e_placements_per_s = NUM_TASKS * TRIALS / e2e_pipelined_s
+
+    placed = int((pipelined[-1] >= 0).sum())
     p50 = float(np.percentile(times, 50))
     # sustained throughput over TRIALS consecutive 100k-task batches
     placements_per_s = NUM_TASKS * TRIALS / sum(times)
     baseline = 594.04  # tasks/s, reference many_tasks end-to-end
+    e2e_p50 = float(np.percentile(e2e_times, 50))
     print(
         json.dumps(
             {
@@ -110,12 +139,15 @@ def main():
                 "unit": "placements/s",
                 "vs_baseline": round(placements_per_s / baseline, 2),
                 "p50_ms_100k_tasks_1k_nodes": round(p50 * 1e3, 3),
-                # any device->host fetch pays a fixed ~100ms relay RTT in
-                # this tunneled environment (even a scalar); reported
-                # separately so the kernel number reflects the hardware.
-                "p50_ms_incl_host_readback": round(
-                    float(np.percentile(e2e_times, 50)) * 1e3, 2
+                "p50_ms_incl_host_readback": round(e2e_p50 * 1e3, 2),
+                # fixed per-fetch relay RTT of this tunneled environment
+                # (what a co-located host would not pay):
+                "env_readback_floor_ms": round(rtt_floor * 1e3, 2),
+                "p50_ms_e2e_minus_env_floor": round(
+                    max(e2e_p50 - rtt_floor, 0.0) * 1e3, 2
                 ),
+                # steady-state e2e with readback pipelined over compute
+                "e2e_pipelined_placements_per_s": round(e2e_placements_per_s, 1),
                 "placed_fraction": round(placed / NUM_TASKS, 4),
                 "device": str(jax.devices()[0]),
                 "north_star_p50_ms": 50.0,

@@ -31,9 +31,10 @@ class NodeInfo:
     resources: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
-    # actors whose workers this agent still hosts — lets a restarted head
-    # re-attach live actors (GCS FT resubscribe analog, gcs_init_data.cc)
-    hosted_actors: List[str] = field(default_factory=list)
+    # actors whose workers this agent still hosts, as
+    # {"actor_id", "name", "max_restarts"} — lets a restarted head re-attach
+    # live actors (GCS FT resubscribe analog, gcs_init_data.cc)
+    hosted_actors: List[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -56,6 +57,9 @@ class LeaseRequest:
     # set by the head when routing:
     target_node: Optional[str] = None
     pg_reservation: Optional[Tuple[str, int]] = None  # (pg_id, bundle_idx)
+    # actor_creation only: {"name", "max_restarts"} so the hosting agent can
+    # re-describe its actors to a restarted head
+    actor_meta: Optional[dict] = None
 
 
 @dataclass

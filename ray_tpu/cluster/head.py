@@ -62,6 +62,14 @@ def _best_effort(fn, *args, **kwargs):
         pass
 
 
+# One writer per persist path per process: restart_head() keeps the old and
+# new HeadServer in the same process for a moment; the old instance must not
+# overwrite the new instance's snapshots with stale state.
+_PERSIST_LOCKS: Dict[str, threading.Lock] = {}
+_PERSIST_OWNER: Dict[str, int] = {}
+_PERSIST_REG_LOCK = threading.Lock()
+
+
 @dataclass
 class _ObjEntry:
     """Object-directory row (ownership_object_directory analog)."""
@@ -123,6 +131,10 @@ class HeadServer:
         self._shutdown = False
         self._persist_path = persist_path
         self._persist_dirty = False
+        if persist_path:
+            with _PERSIST_REG_LOCK:
+                _PERSIST_LOCKS.setdefault(persist_path, threading.Lock())
+                _PERSIST_OWNER[persist_path] = id(self)
         from ray_tpu.core.events import TaskEventBuffer
 
         self.events = TaskEventBuffer()
@@ -220,11 +232,9 @@ class HeadServer:
             }
 
     def _load_persisted(self) -> None:
-        import pickle as _pickle
-
         try:
             with open(self._persist_path, "rb") as f:
-                snap = _pickle.load(f)
+                snap = pickle.load(f)
         except FileNotFoundError:
             return
         except Exception:  # noqa: BLE001 - corrupt snapshot: start fresh
@@ -253,20 +263,27 @@ class HeadServer:
         self._persist_dirty = True
 
     def _persist_now(self) -> None:
-        import pickle as _pickle
-
-        try:
-            tmp = f"{self._persist_path}.{os.getpid()}.tmp"
-            with open(tmp, "wb") as f:
-                _pickle.dump(self._snapshot_state(), f)
-            os.replace(tmp, self._persist_path)
-        except Exception:  # noqa: BLE001
-            self._persist_dirty = True  # don't lose the write; retry later
-            logger.exception("head state persistence failed")
+        lock = _PERSIST_LOCKS[self._persist_path]
+        with lock:
+            if _PERSIST_OWNER.get(self._persist_path) != id(self):
+                return  # a newer head owns this file now; never write stale
+            try:
+                tmp = (
+                    f"{self._persist_path}.{os.getpid()}"
+                    f".{threading.get_ident()}.tmp"
+                )
+                with open(tmp, "wb") as f:
+                    pickle.dump(self._snapshot_state(), f)
+                os.replace(tmp, self._persist_path)
+            except Exception:  # noqa: BLE001
+                self._persist_dirty = True  # don't lose the write; retry
+                logger.exception("head state persistence failed")
 
     def _persist_loop(self) -> None:
-        while not self._shutdown:
+        while True:
             time.sleep(1.0)
+            if self._shutdown:
+                return  # shutdown() does the final flush itself
             if not self._persist_dirty:
                 continue
             self._persist_dirty = False
@@ -303,20 +320,26 @@ class HeadServer:
             self._cond.notify_all()
         # re-attach actors this agent still hosts (head-restart recovery:
         # the actor instances kept running in the agent's workers)
-        for actor_id in info.hosted_actors:
+        for meta in info.hosted_actors:
+            actor_id = meta["actor_id"]
             with self._lock:
                 existing = self._actors.get(actor_id)
                 if existing is None:
+                    name = meta.get("name")
                     self._actors[actor_id] = ActorInfo(
                         actor_id=actor_id,
-                        name=None,
+                        name=name,
                         node_id=info.node_id,
                         address=info.address,
                         state="ALIVE",
+                        max_restarts=meta.get("max_restarts", 0),
                     )
+                    if name and name not in self._named_actors:
+                        self._named_actors[name] = actor_id
                     continue
-            if existing.state != "DEAD":
-                self._mark_actor_alive(actor_id, info.node_id, info.address)
+            # _mark_actor_alive handles the DEAD case by tearing the
+            # zombie instance down on the agent
+            self._mark_actor_alive(actor_id, info.node_id, info.address)
         logger.info("node %s registered at %s", info.node_id, info.address)
         return {"node_id": info.node_id, "head_address": self.address}
 
@@ -869,6 +892,10 @@ class HeadServer:
             class_name=req.get("class_name", ""),
             max_restarts=req.get("max_restarts", 0),
         )
+        spec.actor_meta = {
+            "name": name,
+            "max_restarts": info.max_restarts,
+        }
         with self._cond:
             if name:
                 if name in self._named_actors:
