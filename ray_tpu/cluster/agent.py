@@ -145,6 +145,7 @@ class NodeAgent:
         self._idle: List[str] = []
         self._idle_cv = threading.Condition(self._lock)
         self._actor_workers: Dict[str, str] = {}  # actor_id -> worker_id
+        self._actor_meta: Dict[str, dict] = {}  # actor_id -> {name, max_restarts}
         self._actor_allocs: Dict[str, Any] = {}  # actor_id -> held lease alloc
         self._actor_fifo: Dict[str, list] = {}  # actor_id -> ordered methods
         self._actor_draining: set = set()
@@ -233,8 +234,7 @@ class NodeAgent:
                 self._idle.remove(handle.worker_id)
             actor_id = handle.actor_id
             if actor_id:
-                self._actor_workers.pop(actor_id, None)
-                self._release(self._actor_allocs.pop(actor_id, None))
+                self._drop_actor_state(actor_id)
         try:
             handle.proc.kill()
         except OSError:
@@ -338,6 +338,9 @@ class NodeAgent:
             with self._lock:
                 handle.actor_id = spec.actor_id
                 self._actor_workers[spec.actor_id] = handle.worker_id
+                # kept for head-restart re-registration (_node_info):
+                # the head rebuilds ActorInfo/name bindings from this
+                self._actor_meta[spec.actor_id] = dict(spec.actor_meta or {})
             # an actor pins its worker for life; backfill the pool
             if len(self._workers) <= self._num_workers:
                 self._spawn_worker()
@@ -578,7 +581,10 @@ class NodeAgent:
 
     def _node_info(self) -> NodeInfo:
         with self._lock:
-            hosted = list(self._actor_workers.keys())
+            hosted = [
+                {"actor_id": aid, **self._actor_meta.get(aid, {})}
+                for aid in self._actor_workers
+            ]
         return NodeInfo(
             node_id=self.node_id,
             address=self.address,
@@ -636,15 +642,27 @@ class NodeAgent:
                     self.head.call("RegisterNode", self._node_info(), timeout=5.0)
             except RpcError:
                 continue
+            except Exception:  # noqa: BLE001
+                # One bad reply (e.g. a head-side handler bug re-raised over
+                # RPC) must never kill the heartbeat thread permanently —
+                # that would get this node declared dead with no rejoin.
+                logger.exception("node report failed; retrying next tick")
+                continue
 
     # ------------------------------------------------------------------
     # actor + lifecycle control
     # ------------------------------------------------------------------
+    def _drop_actor_state(self, actor_id: str) -> None:
+        """Forget all per-actor state. Caller holds self._lock."""
+        self._actor_workers.pop(actor_id, None)
+        self._actor_meta.pop(actor_id, None)
+        self._release(self._actor_allocs.pop(actor_id, None))
+
     def _h_kill_actor(self, req: dict) -> None:
         with self._lock:
-            worker_id = self._actor_workers.pop(req["actor_id"], None)
+            worker_id = self._actor_workers.get(req["actor_id"])
             handle = self._workers.pop(worker_id, None) if worker_id else None
-            self._release(self._actor_allocs.pop(req["actor_id"], None))
+            self._drop_actor_state(req["actor_id"])
         if handle is not None:
             try:
                 handle.proc.kill()

@@ -186,12 +186,16 @@ class HeadServer:
             "StopJob": lambda r: self.jobs.stop(r["job_id"]),
             "Ping": lambda r: "pong",
         }
-        self._server = RpcServer(handlers, host=host, port=port)
-        self.address = self._server.address
-
+        # jobs must exist before the RPC server accepts its first request:
+        # a SubmitJob/ListJobs arriving in the gap would hit AttributeError.
+        # JobManager needs the head address, which is only known after bind,
+        # so construct it lazily-addressed and fill in below.
         from .jobs import JobManager
 
-        self.jobs = JobManager(self.address, on_change=self.mark_dirty)
+        self.jobs = JobManager(None, on_change=self.mark_dirty)
+        self._server = RpcServer(handlers, host=host, port=port)
+        self.address = self._server.address
+        self.jobs.head_address = self.address
         for job in getattr(self, "_recovered_jobs", []):
             self.jobs.restore(job)
         self.dashboard = None

@@ -61,7 +61,7 @@ class JobManager:
 
     def __init__(
         self,
-        head_address: str,
+        head_address: Optional[str],
         log_dir: Optional[str] = None,
         on_change=None,
     ):
@@ -109,6 +109,11 @@ class JobManager:
         submission_id: Optional[str] = None,
         metadata: Optional[Dict[str, str]] = None,
     ) -> str:
+        if self.head_address is None:
+            # head is mid-bootstrap (RPC server bound, address not yet
+            # published to us) — a clean retryable error beats spawning a
+            # job with RAY_TPU_HEAD_ADDRESS unset.
+            raise RuntimeError("head is not ready to accept jobs yet")
         job_id = submission_id or f"raytpu-job-{new_id()}"
         with self._lock:
             if job_id in self._jobs:
