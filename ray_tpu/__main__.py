@@ -170,7 +170,13 @@ def main() -> int:
     st.add_argument("--port", type=int, default=6380)
     st.add_argument("--dashboard-port", type=int, default=8265)
     st.add_argument("--no-dashboard", action="store_true")
-    st.add_argument("--device-scheduler", action="store_true")
+    st.add_argument(
+        "--device-scheduler",
+        default=None,
+        action=argparse.BooleanOptionalAction,
+        help="XLA kernel scheduler (default on; --no-device-scheduler for "
+        "the NumPy golden model)",
+    )
     st.add_argument("--num-workers", type=int, default=None)
     st.add_argument("--resources", default='{"CPU": 8}')
 

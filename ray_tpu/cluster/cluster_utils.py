@@ -24,7 +24,7 @@ from .rpc import RpcClient, RpcError
 class Cluster:
     def __init__(
         self,
-        use_device_scheduler: bool = False,
+        use_device_scheduler: Optional[bool] = None,
         dashboard: bool = False,
         persist_path: Optional[str] = None,
     ):

@@ -34,7 +34,10 @@ from ray_tpu.scheduler import (
     hybrid_schedule_reference,
     schedule_bundles,
 )
-from ray_tpu.scheduler import hybrid as hybrid_mod
+from ray_tpu.scheduler.device import (
+    DeviceSchedulerState,
+    device_scheduler_default,
+)
 
 from .common import (
     HEALTH_TIMEOUT_S,
@@ -52,7 +55,6 @@ logger = logging.getLogger("ray_tpu.cluster.head")
 
 SCHED_TICK_S = 0.002
 MAX_BATCH = 4096
-DEVICE_KERNEL_MIN_BATCH = 64
 
 
 def _best_effort(fn, *args, **kwargs):
@@ -97,14 +99,18 @@ class HeadServer:
         self,
         host: str = "127.0.0.1",
         port: int = 0,
-        use_device_scheduler: bool = False,
+        use_device_scheduler: Optional[bool] = None,
         dashboard_port: Optional[int] = None,
         persist_path: Optional[str] = None,
     ):
         self.vocab = ResourceVocab()
         self.view = ClusterView(self.vocab)
         self.hybrid_config = HybridConfig()
+        if use_device_scheduler is None:
+            use_device_scheduler = device_scheduler_default()
         self.use_device_scheduler = use_device_scheduler
+        self._device_state = None  # lazy: first scheduling round inits XLA
+        self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
         self._seed = 0
 
@@ -663,6 +669,14 @@ class HeadServer:
         self.events.record(spec.task_id, spec.name, "SUBMITTED")
         return {"queued": True}
 
+    @property
+    def device_state(self):
+        """Lazy DeviceSchedulerState: JAX backend init happens on the first
+        scheduling round, not at head construction."""
+        if self._device_state is None and self.use_device_scheduler:
+            self._device_state = DeviceSchedulerState()
+        return self._device_state
+
     def _scheduler_loop(self) -> None:
         while True:
             with self._cond:
@@ -672,6 +686,19 @@ class HeadServer:
                     and not self._shutdown
                 ):
                     self._cond.wait(timeout=0.5)
+                    # Lost-wakeup backstop: a spec parked after the
+                    # release/report that would have drained it sleeps until
+                    # the next cluster event. Retry parked work only when the
+                    # view actually moved, so truly-infeasible specs don't
+                    # spin the kernel at 2 Hz.
+                    if (
+                        self._infeasible
+                        and not self._pending
+                        and self.view.change_counter != self._parked_at_change
+                    ):
+                        self._parked_at_change = self.view.change_counter
+                        self._pending.extend(self._infeasible)
+                        self._infeasible.clear()
                 if self._shutdown:
                     return
                 batch = []
@@ -696,48 +723,51 @@ class HeadServer:
                 kernel_batch.append(spec)
         if not kernel_batch:
             return
+        totals = avail = alive = None
         with self._lock:
-            # snapshot copies: RPC threads mutate the view concurrently
-            # (node add/remove, resource reports); rows never shift, so
-            # row->node_id stays valid after release.
-            t0, a0, al0 = self.view.active_arrays()
-            totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
             n = self.view.num_nodes
-        if n == 0 or not alive.any():
+            r = self.view.totals.shape[1]
+            any_alive = bool(self.view.alive.any())
+            if self.device_state is not None and n > 0:
+                self.device_state.sync(self.view)
+            else:
+                # snapshot copies for the host reference scheduler: RPC
+                # threads mutate the view concurrently (node add/remove,
+                # resource reports); rows never shift, so row->node_id stays
+                # valid after release.
+                t0, a0, al0 = self.view.active_arrays()
+                totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
+        if n == 0 or not any_alive:
             with self._cond:
                 self._infeasible.extend(kernel_batch)
             return
-        demands = np.stack(
-            [
-                ResourceRequest.from_map(self.vocab, s.resources).dense(
-                    totals.shape[1]
-                )
-                for s in kernel_batch
-            ]
-        )
-        prefer = np.zeros(len(kernel_batch), dtype=np.int32)
-        force_spill = np.zeros(len(kernel_batch), dtype=bool)
-        if (
-            self.use_device_scheduler
-            and len(kernel_batch) >= DEVICE_KERNEL_MIN_BATCH
-        ):
-            import jax.numpy as jnp
-
-            self._seed += 1
-            res = hybrid_mod.hybrid_schedule_batch(
-                jnp.asarray(totals),
-                jnp.asarray(avail),
-                jnp.asarray(alive),
-                jnp.asarray(demands),
-                jnp.asarray(prefer),
-                jnp.asarray(force_spill),
-                np.uint32(self._seed),
-                config=self.hybrid_config,
+        reqs = [
+            ResourceRequest.from_map(self.vocab, s.resources)
+            for s in kernel_batch
+        ]
+        # a demand column past the view's resource axis names a resource no
+        # node has ever reported — unplaceable until the cluster changes
+        sched: List[Tuple[LeaseRequest, np.ndarray]] = []
+        with self._cond:
+            for spec, req in zip(kernel_batch, reqs):
+                if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                    self._infeasible.append(spec)
+                else:
+                    sched.append((spec, req.dense(r)))
+        if not sched:
+            return
+        demands = np.stack([d for _, d in sched])
+        if self.device_state is not None:
+            # the default path: shape-grouped waterfall kernel over the
+            # device-resident view (device.py module docstring)
+            rows = self.device_state.schedule(
+                demands, spread_threshold=self.hybrid_config.spread_threshold
             )
-            rows = np.asarray(res.node)
-            granted = np.asarray(res.available)
+            granted = rows >= 0
         else:
-            rows, granted, avail_after = hybrid_schedule_reference(
+            prefer = np.zeros(len(sched), dtype=np.int32)
+            force_spill = np.zeros(len(sched), dtype=bool)
+            rows, granted, _ = hybrid_schedule_reference(
                 totals,
                 avail,
                 alive,
@@ -747,7 +777,7 @@ class HeadServer:
                 config=self.hybrid_config,
                 rng=self._rng,
             )
-        for spec, row, ok, demand in zip(kernel_batch, rows, granted, demands):
+        for (spec, demand), row, ok in zip(sched, rows, granted):
             if row < 0 or not ok:
                 with self._cond:
                     self._infeasible.append(spec)
@@ -1204,7 +1234,13 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
     parser.add_argument("--port", type=int, default=6380)
     parser.add_argument("--dashboard-port", type=int, default=8265)
     parser.add_argument("--no-dashboard", action="store_true")
-    parser.add_argument("--device-scheduler", action="store_true")
+    parser.add_argument(
+        "--device-scheduler",
+        default=None,
+        action=argparse.BooleanOptionalAction,
+        help="XLA kernel scheduler (default on; --no-device-scheduler for "
+        "the NumPy golden model)",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     head = HeadServer(

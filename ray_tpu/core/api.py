@@ -36,7 +36,7 @@ def init(
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
     resources: Optional[Dict[str, float]] = None,
-    use_device_scheduler: bool = False,
+    use_device_scheduler: Optional[bool] = None,
     ignore_reinit_error: bool = False,
 ):
     """Start the in-process cluster runtime, or connect to a live cluster.
@@ -47,9 +47,10 @@ def init(
     With ``address="host:port"``: connect this driver to a running
     multi-process cluster's head (the distributed runtime in
     ray_tpu.cluster; the reference's ray.init(address=...) +
-    Ray-Client mode). With ``use_device_scheduler=True``, large
-    scheduling batches run the batched JAX kernel on the default device
-    (TPU when present).
+    Ray-Client mode). The scheduler runs the batched XLA kernels on the
+    device selected by ``RAY_TPU_SCHED_PLATFORM`` (default host XLA; set
+    "tpu" to pin the chip) — ``use_device_scheduler=False`` or
+    ``RAY_TPU_DEVICE_SCHEDULER=0`` selects the NumPy golden model instead.
     """
     if runtime_initialized():
         if ignore_reinit_error:

@@ -45,7 +45,6 @@ from ray_tpu.scheduler import (
     ResourceVocab,
     hybrid_schedule_reference,
 )
-from ray_tpu.scheduler import hybrid as hybrid_mod
 from .object_store import ObjectRef, ObjectStore, TaskError
 
 logger = logging.getLogger("ray_tpu")
@@ -53,7 +52,6 @@ logger = logging.getLogger("ray_tpu")
 # Leases per scheduling round (the batching that makes the TPU kernel pay).
 MAX_SCHEDULE_BATCH = 1024
 # Below this batch size the host (numpy) path beats a device dispatch.
-DEVICE_KERNEL_MIN_BATCH = 64
 
 
 class ActorDiedError(Exception):
@@ -117,7 +115,7 @@ class Runtime:
         self,
         num_nodes: int = 1,
         resources_per_node: Optional[Dict[str, float]] = None,
-        use_device_scheduler: bool = False,
+        use_device_scheduler: Optional[bool] = None,
         hybrid_config: HybridConfig = HybridConfig(),
     ):
         self.vocab = ResourceVocab()
@@ -138,7 +136,13 @@ class Runtime:
         self.store = ObjectStore(native)
         self.nodes: Dict[str, Node] = {}
         self.hybrid_config = hybrid_config
+        if use_device_scheduler is None:
+            from ray_tpu.scheduler.device import device_scheduler_default
+
+            use_device_scheduler = device_scheduler_default()
         self.use_device_scheduler = use_device_scheduler
+        self._device_state = None  # built lazily: keeps init() off the XLA path
+        self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
         self._seed_counter = itertools.count(1)
         self._lock = threading.RLock()
@@ -303,6 +307,16 @@ class Runtime:
     # ------------------------------------------------------------------
     # the batched scheduler (ScheduleAndGrantLeases analog)
     # ------------------------------------------------------------------
+    @property
+    def device_state(self):
+        """Lazy DeviceSchedulerState: JAX backend init happens on the first
+        scheduling round, not in ray_tpu.init()."""
+        if self._device_state is None and self.use_device_scheduler:
+            from ray_tpu.scheduler.device import DeviceSchedulerState
+
+            self._device_state = DeviceSchedulerState()
+        return self._device_state
+
     def _scheduler_loop(self) -> None:
         while True:
             with self._cond:
@@ -310,6 +324,19 @@ class Runtime:
                     not self._pending and not self._dirty and not self._shutdown
                 ):
                     self._cond.wait(timeout=0.5)
+                    # Lost-wakeup backstop: a spec parked *after* the release
+                    # event that would have drained it would otherwise sleep
+                    # until the next cluster change. Retry parked work only
+                    # when the view actually moved since the last drain, so
+                    # truly-infeasible specs don't spin the kernel at 2 Hz.
+                    if (
+                        self._infeasible
+                        and not self._pending
+                        and self.view.change_counter != self._parked_at_change
+                    ):
+                        self._parked_at_change = self.view.change_counter
+                        self._pending.extend(self._infeasible)
+                        self._infeasible.clear()
                 if self._shutdown:
                     return
                 self._dirty = False
@@ -387,38 +414,39 @@ class Runtime:
         if not hybrid_batch:
             return
 
-        totals, avail, alive = self.view.active_arrays()
-        n = self.view.num_nodes
+        totals = avail = alive = None
+        with self._lock:
+            n = self.view.num_nodes
+            r = self.view.totals.shape[1]
+            if self.device_state is not None and n > 0:
+                self.device_state.sync(self.view)
+            else:
+                totals, avail, alive = self.view.active_arrays()
         if n == 0:
             for spec in hybrid_batch:
                 self._park_infeasible(spec)
             return
-        demands = np.stack(
-            [
-                ResourceRequest.from_map(self.vocab, s.resources).dense(
-                    totals.shape[1]
-                )
-                for s in hybrid_batch
-            ]
-        )
-        prefer = np.zeros(len(hybrid_batch), dtype=np.int32)
-        force_spill = np.zeros(len(hybrid_batch), dtype=bool)
-        if self.use_device_scheduler and len(hybrid_batch) >= DEVICE_KERNEL_MIN_BATCH:
-            import jax.numpy as jnp
-
-            res = hybrid_mod.hybrid_schedule_batch(
-                jnp.asarray(totals),
-                jnp.asarray(avail),
-                jnp.asarray(alive),
-                jnp.asarray(demands),
-                jnp.asarray(prefer),
-                jnp.asarray(force_spill),
-                np.uint32(next(self._seed_counter)),
-                config=self.hybrid_config,
+        sched: List[TaskSpec] = []
+        dense_rows: List[np.ndarray] = []
+        for spec in hybrid_batch:
+            req = ResourceRequest.from_map(self.vocab, spec.resources)
+            if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                # demands a resource no node carries — unplaceable for now
+                self._park_infeasible(spec)
+            else:
+                sched.append(spec)
+                dense_rows.append(req.dense(r))
+        if not sched:
+            return
+        demands = np.stack(dense_rows)
+        if self.device_state is not None:
+            nodes_idx = self.device_state.schedule(
+                demands, spread_threshold=self.hybrid_config.spread_threshold
             )
-            nodes_idx = np.asarray(res.node)
-            granted = np.asarray(res.available)
+            granted = nodes_idx >= 0
         else:
+            prefer = np.zeros(len(sched), dtype=np.int32)
+            force_spill = np.zeros(len(sched), dtype=bool)
             nodes_idx, granted, _ = hybrid_schedule_reference(
                 totals,
                 avail,
@@ -429,13 +457,13 @@ class Runtime:
                 config=self.hybrid_config,
                 rng=self._rng,
             )
-        for spec, row, ok in zip(hybrid_batch, nodes_idx, granted):
-            if row < 0:
-                self._park_infeasible(spec)
-            elif not ok:
-                # Feasible but no node has the resources free right now:
-                # park until a release/new node notifies (the reference
-                # queues at the target raylet, local_lease_manager.h:39).
+        for spec, row, ok in zip(sched, nodes_idx, granted):
+            if row < 0 or not ok:
+                # Infeasible anywhere, or feasible but no node has the
+                # resources free right now: park until a release/new node
+                # notifies (the reference queues at the target raylet,
+                # local_lease_manager.h:39). The ledger's grant-or-reject in
+                # _grant_or_requeue corrects any stale-view optimism.
                 self._park_infeasible(spec)
             else:
                 self._grant_or_requeue(spec, self.view.node_id(int(row)))

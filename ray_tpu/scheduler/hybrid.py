@@ -291,8 +291,7 @@ def hybrid_schedule_rounds_chunked(
     return RoundsResult(nodes.reshape(-1), avail_out)
 
 
-@functools.partial(jax.jit, static_argnames=("spread_threshold",))
-def hybrid_schedule_shapes(
+def hybrid_schedule_shapes_impl(
     totals: jax.Array,        # f32[N,R]
     avail: jax.Array,         # f32[N,R]
     alive: jax.Array,         # bool[N]
@@ -382,6 +381,13 @@ def hybrid_schedule_shapes(
         nodes_sorted.astype(jnp.int32)
     )
     return RoundsResult(nodes, avail_out)
+
+
+# Public jitted entry point; DeviceSchedulerState re-jits the impl with a
+# donated avail buffer to keep scheduler state resident across rounds.
+hybrid_schedule_shapes = functools.partial(
+    jax.jit, static_argnames=("spread_threshold",)
+)(hybrid_schedule_shapes_impl)
 
 
 def dedupe_shapes(demands: np.ndarray):

@@ -234,6 +234,15 @@ class ClusterView:
         self.avail = np.zeros((capacity_nodes, vocab.capacity), dtype=np.float32)
         self.alive = np.zeros(capacity_nodes, dtype=bool)
         self.labels: List[Dict[str, str]] = [dict() for _ in range(capacity_nodes)]
+        # Device-mirror bookkeeping (DeviceSchedulerState): topo_version bumps
+        # on any change that needs a full re-upload (membership, array
+        # reshapes, totals edits); dirty_rows are availability rows whose
+        # host value changed since the last device sync.
+        self.topo_version = 0
+        self.dirty_rows: set = set()
+        # Monotone counter over ALL mutations — schedulers use it to retry
+        # parked-infeasible work only when the cluster actually changed.
+        self.change_counter = 0
 
     @property
     def num_nodes(self) -> int:
@@ -277,6 +286,8 @@ class ClusterView:
         self.avail[row, : len(row_total)] = row_total
         self.alive[row] = True
         self.labels[row] = dict(labels or {})
+        self.topo_version += 1
+        self.change_counter += 1
         return row
 
     def remove_node(self, node_id: str) -> None:
@@ -285,6 +296,8 @@ class ClusterView:
             self.alive[row] = False
             self.totals[row] = 0
             self.avail[row] = 0
+            self.topo_version += 1
+            self.change_counter += 1
 
     def row_of(self, node_id: str) -> int:
         return self._id_to_row[node_id]
@@ -296,13 +309,22 @@ class ClusterView:
         """Apply a gossip snapshot (RaySyncer RESOURCE_VIEW analog)."""
         row = self._id_to_row[node_id]
         packed = self.vocab.pack(avail)
+        if packed.shape[0] > self.avail.shape[1]:
+            self._grow(self.num_nodes, packed.shape[0])
+            self.topo_version += 1
         self.avail[row, : len(packed)] = packed
+        self.dirty_rows.add(row)
+        self.change_counter += 1
 
     def subtract(self, row: int, demand: np.ndarray) -> None:
         self.avail[row, : len(demand)] -= demand
+        self.dirty_rows.add(row)
+        self.change_counter += 1
 
     def add(self, row: int, demand: np.ndarray) -> None:
         self.avail[row, : len(demand)] += demand
+        self.dirty_rows.add(row)
+        self.change_counter += 1
 
     def active_arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(totals, avail, alive) trimmed to the populated node rows."""
