@@ -127,6 +127,7 @@ class NodeAgent:
             "WorkerPut": self._h_worker_put,
             "WorkerSealed": self._h_worker_sealed,
             "RegisterWorker": self._h_register_worker,
+            "TaskDone": self._h_task_done,
             "PrepareBundles": self._h_prepare_bundles,
             "CommitBundles": self._h_commit_bundles,
             "RollbackBundles": self._h_rollback_bundles,
@@ -149,6 +150,10 @@ class NodeAgent:
         self._actor_allocs: Dict[str, Any] = {}  # actor_id -> held lease alloc
         self._actor_fifo: Dict[str, list] = {}  # actor_id -> ordered methods
         self._actor_draining: set = set()
+        self._async_actors: set = set()  # actor_ids multiplexing on a loop
+        # async-actor methods accepted by a worker, completion pending
+        # (worker reports via TaskDone): task_id -> (spec, worker handle)
+        self._async_pending: Dict[str, tuple] = {}
         self._num_workers = num_workers
         for _ in range(num_workers):
             self._spawn_worker()
@@ -156,8 +161,12 @@ class NodeAgent:
         # remote-fetch client cache (peer addresses come from head lookups)
         self._peer_clients: Dict[str, RpcClient] = {}
 
+        # IO-bound pool: threads mostly park on worker RPCs. Sized well past
+        # the worker count so async-actor methods (which each hold a thread
+        # while multiplexing on the worker's event loop) can overlap deeply.
         self._exec_pool = ThreadPoolExecutor(
-            max_workers=num_workers + 4, thread_name_prefix=f"agent-{self.node_id[:6]}"
+            max_workers=num_workers + 32,
+            thread_name_prefix=f"agent-{self.node_id[:6]}",
         )
 
         reply = self.head.call(
@@ -228,10 +237,16 @@ class NodeAgent:
 
     def _on_worker_death(self, handle: _WorkerHandle, running: List[LeaseRequest]) -> None:
         """A worker process died (socket/process detection in worker_pool.cc)."""
+        running = list(running)
         with self._idle_cv:
             self._workers.pop(handle.worker_id, None)
             if handle.worker_id in self._idle:
                 self._idle.remove(handle.worker_id)
+            # async methods awaiting a TaskDone from this worker die with it
+            for tid in [
+                t for t, (_, h) in self._async_pending.items() if h is handle
+            ]:
+                running.append(self._async_pending.pop(tid)[0])
             actor_id = handle.actor_id
             if actor_id:
                 self._drop_actor_state(actor_id)
@@ -271,6 +286,13 @@ class NodeAgent:
                         "status": "reject",
                         "available": self.ledger.avail_map(),
                     }
+                if spec.actor_id in self._async_actors:
+                    # asyncio actor: methods multiplex on the worker's event
+                    # loop — no FIFO, no per-worker serialization
+                    self._exec_pool.submit(
+                        self._run_on_worker, spec, handle, None, False
+                    )
+                    return {"status": "granted"}
                 # per-actor FIFO: the pool must not reorder method calls
                 fifo = self._actor_fifo.setdefault(spec.actor_id, [])
                 fifo.append(spec)
@@ -347,10 +369,15 @@ class NodeAgent:
         self._run_on_worker(spec, handle, alloc)
 
     def _run_on_worker(
-        self, spec: LeaseRequest, handle: _WorkerHandle, alloc
+        self, spec: LeaseRequest, handle: _WorkerHandle, alloc, serialize: bool = True
     ) -> None:
+        import contextlib
+
+        # async-actor methods skip the per-worker lock: the worker's event
+        # loop multiplexes them (serialize=False from _h_execute_lease)
+        guard = handle.lock if serialize else contextlib.nullcontext()
         try:
-            with handle.lock:  # per-worker ordering (actor sequential exec)
+            with guard:  # per-worker ordering (actor sequential exec)
                 reply = handle.client.call(
                     "PushTask",
                     {
@@ -361,6 +388,7 @@ class NodeAgent:
                         "return_ids": spec.return_ids,
                         "name": spec.name,
                         "runtime_env": spec.runtime_env,
+                        "actor_meta": spec.actor_meta,
                         "retry_exceptions": (
                             spec.retry_exceptions
                             and spec.attempt < spec.max_retries
@@ -373,12 +401,34 @@ class NodeAgent:
             if not self._shutdown:
                 self._on_worker_death(handle, [spec])
             return
+        if reply.get("status") == "async_pending":
+            # the worker accepted the method onto its event loop and will
+            # deliver the outcome via TaskDone — free this thread now
+            with self._lock:
+                self._async_pending[spec.task_id] = (spec, handle)
+            return
+        self._finish_worker_reply(spec, handle, alloc, reply)
+
+    def _h_task_done(self, req: dict) -> None:
+        """Completion callback for async-actor methods (worker → agent)."""
+        with self._lock:
+            entry = self._async_pending.pop(req["task_id"], None)
+        if entry is None:
+            return  # already failed via worker death
+        spec, handle = entry
+        self._finish_worker_reply(spec, handle, None, req["reply"])
+
+    def _finish_worker_reply(
+        self, spec: LeaseRequest, handle: _WorkerHandle, alloc, reply: dict
+    ) -> None:
         status = reply.get("status")
         if spec.kind == "actor_creation" and status == "ok":
             # a live actor holds its lease resources for its lifetime
             # (GcsActorScheduler lease semantics); released on death/kill.
             with self._lock:
                 self._actor_allocs[spec.actor_id] = alloc
+                if reply.get("async_actor"):
+                    self._async_actors.add(spec.actor_id)
         else:
             self._release(alloc)
         report: Dict[str, Any] = {
@@ -656,6 +706,7 @@ class NodeAgent:
         """Forget all per-actor state. Caller holds self._lock."""
         self._actor_workers.pop(actor_id, None)
         self._actor_meta.pop(actor_id, None)
+        self._async_actors.discard(actor_id)
         self._release(self._actor_allocs.pop(actor_id, None))
 
     def _h_kill_actor(self, req: dict) -> None:

@@ -217,6 +217,8 @@ class RemoteRuntime:
         resources: Dict[str, float],
         name: Optional[str] = None,
         max_restarts: int = 0,
+        max_concurrency: Optional[int] = None,
+        concurrency_groups: Optional[Dict[str, int]] = None,
         scheduling_strategy: Any = None,
         **_ignored,
     ) -> RemoteActorHandle:
@@ -241,6 +243,8 @@ class RemoteRuntime:
                 "name": name,
                 "class_name": cls.__name__,
                 "max_restarts": max_restarts,
+                "max_concurrency": max_concurrency,
+                "concurrency_groups": dict(concurrency_groups or {}),
             },
         )
         return RemoteActorHandle(self, actor_id, cls)

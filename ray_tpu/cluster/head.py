@@ -929,6 +929,8 @@ class HeadServer:
         spec.actor_meta = {
             "name": name,
             "max_restarts": info.max_restarts,
+            "max_concurrency": req.get("max_concurrency"),
+            "concurrency_groups": req.get("concurrency_groups", {}),
         }
         with self._cond:
             if name:

@@ -33,6 +33,24 @@ from .rpc import RpcClient, RpcError, RpcServer
 logger = logging.getLogger("ray_tpu.cluster.worker")
 
 
+async def _invoke_maybe_async(instance, method: str, args, kwargs, sems):
+    """Run one actor method on the actor's event loop; awaits coroutine
+    methods, runs sync methods inline (briefly blocking the loop — the
+    reference's asyncio-actor semantics for def methods). ``sems`` maps
+    concurrency-group name -> asyncio.Semaphore bounding in-flight starts."""
+    import inspect
+
+    fn = getattr(instance, method)
+    opts = getattr(fn, "_ray_tpu_method_options", None) or {}
+    group = opts.get("concurrency_group", "_default")
+    sem = sems.get(group) or sems["_default"]
+    async with sem:
+        out = fn(*args, **kwargs)
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
+
 class Worker:
     def __init__(self, agent_address: str, worker_id: str, store_path: str):
         self.worker_id = worker_id
@@ -47,7 +65,15 @@ class Worker:
             except Exception:  # noqa: BLE001
                 logger.warning("worker could not open shm store %s", store_path)
         self._actors: Dict[str, Any] = {}
+        self._actor_loops: Dict[str, Any] = {}  # actor_id -> (loop, sems)
         self._env_applied: set = set()
+        from concurrent.futures import ThreadPoolExecutor
+
+        # seals + TaskDone callbacks for finished async-actor methods run
+        # here, off the event loop (put_value can RPC to the agent)
+        self._done_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="task-done"
+        )
         self._server = RpcServer(
             {
                 "PushTask": self._h_push_task,
@@ -55,7 +81,7 @@ class Worker:
                 "Ping": lambda r: "pong",
             },
             port=0,
-            max_workers=4,
+            max_workers=8,
         )
         self.agent.call(
             "RegisterWorker",
@@ -154,12 +180,53 @@ class Worker:
             if kind == "actor_creation":
                 cls, args, kwargs = cloudpickle.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
-                self._actors[req["actor_id"]] = cls(*args, **kwargs)
+                from ray_tpu.core.actor import _coroutine_method_names
+
+                aid = req["actor_id"]
+                if _coroutine_method_names(cls):
+                    # asyncio actor: one event loop owns all its methods
+                    from ray_tpu.core.actor import (
+                        DEFAULT_MAX_CONCURRENCY_ASYNC,
+                    )
+
+                    meta = req.get("actor_meta") or {}
+                    mc = meta.get("max_concurrency")
+                    # unset → asyncio default 1000; an explicit 1 serializes
+                    mc = (
+                        DEFAULT_MAX_CONCURRENCY_ASYNC
+                        if mc is None
+                        else max(1, int(mc))
+                    )
+                    groups = {"_default": mc}
+                    groups.update(meta.get("concurrency_groups") or {})
+                    self._actor_loops[aid] = self._start_actor_loop(aid, groups)
+                self._actors[aid] = cls(*args, **kwargs)
                 result_values: List[Any] = []
             elif kind == "actor_method":
                 method, args, kwargs = cloudpickle.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
-                instance = self._actors[req["actor_id"]]
+                aid = req["actor_id"]
+                instance = self._actors[aid]
+                entry = self._actor_loops.get(aid)
+                if entry is not None:
+                    # asyncio actor: schedule on the actor's loop and reply
+                    # "async_pending" NOW — the outcome goes back to the
+                    # agent via TaskDone when the coroutine finishes. No
+                    # thread is held per in-flight method, so thousands can
+                    # park on awaits (reference asyncio-actor semantics).
+                    import asyncio
+
+                    loop, sems = entry
+                    fut = asyncio.run_coroutine_threadsafe(
+                        _invoke_maybe_async(instance, method, args, kwargs, sems),
+                        loop,
+                    )
+                    fut.add_done_callback(
+                        lambda f, r=req: self._done_pool.submit(
+                            self._finish_async_task, r, f
+                        )
+                    )
+                    return {"status": "async_pending"}
                 out = getattr(instance, method)(*args, **kwargs)
                 result_values = self._split(out, req["return_ids"])
             else:
@@ -168,38 +235,90 @@ class Worker:
                 out = fn(*args, **kwargs)
                 result_values = self._split(out, req["return_ids"])
         except BaseException as exc:  # noqa: BLE001 - errors are values
-            if req.get("retry_exceptions"):
-                return {"status": "retry", "error_repr": repr(exc)}
-            tb = traceback.format_exc()
-            logger.debug("task %s failed:\n%s", req["name"], tb)
-            from ray_tpu.core.object_store import TaskError
-
-            err = TaskError(exc, req["name"])
-            err.__cause__ = exc
-            blob = None
-            try:
-                blob = cloudpickle.dumps(err)
-            except Exception:  # noqa: BLE001 - unpicklable exception
-                blob = cloudpickle.dumps(
-                    TaskError(RuntimeError(f"{exc!r}\n{tb}"), req["name"])
-                )
-            seals = [
-                SealInfo(
-                    object_id=oid,
-                    node_id=self.node_id,
-                    is_error=True,
-                    error=blob,
-                )
-                for oid in req["return_ids"]
-            ]
-            return {"status": "error", "error_repr": repr(exc), "seals": seals}
+            return self._error_reply(req, exc)
         finally:
             self._clear_context()
         seals = [
             self.put_value(oid, v)
             for oid, v in zip(req["return_ids"], result_values)
         ]
-        return {"status": "ok", "seals": seals}
+        reply = {"status": "ok", "seals": seals}
+        if kind == "actor_creation" and req["actor_id"] in self._actor_loops:
+            # tells the agent to skip per-actor FIFO serialization
+            reply["async_actor"] = True
+        return reply
+
+    def _start_actor_loop(self, actor_id: str, groups: Dict[str, int]):
+        """Returns (loop, {group: semaphore}); semaphores bind to the loop."""
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        ready = threading.Event()
+        sems: Dict[str, Any] = {}
+
+        def run() -> None:
+            asyncio.set_event_loop(loop)
+            for g, limit in groups.items():
+                sems[g] = asyncio.Semaphore(max(1, int(limit)))
+            ready.set()
+            loop.run_forever()
+
+        threading.Thread(
+            target=run, name=f"actor-loop-{actor_id[:6]}", daemon=True
+        ).start()
+        ready.wait()
+        return loop, sems
+
+    def _error_reply(self, req: dict, exc: BaseException) -> dict:
+        """Build the failure reply: errors are values (sealed TaskError)."""
+        if req.get("retry_exceptions"):
+            return {"status": "retry", "error_repr": repr(exc)}
+        tb = traceback.format_exc()
+        logger.debug("task %s failed:\n%s", req["name"], tb)
+        from ray_tpu.core.object_store import TaskError
+
+        err = TaskError(exc, req["name"])
+        err.__cause__ = exc
+        try:
+            blob = cloudpickle.dumps(err)
+        except Exception:  # noqa: BLE001 - unpicklable exception
+            blob = cloudpickle.dumps(
+                TaskError(RuntimeError(f"{exc!r}\n{tb}"), req["name"])
+            )
+        seals = [
+            SealInfo(
+                object_id=oid,
+                node_id=self.node_id,
+                is_error=True,
+                error=blob,
+            )
+            for oid in req["return_ids"]
+        ]
+        return {"status": "error", "error_repr": repr(exc), "seals": seals}
+
+    def _finish_async_task(self, req: dict, fut) -> None:
+        """Runs in the done-pool when an async method's coroutine settles:
+        seal results, then hand the outcome to the agent (TaskDone)."""
+        try:
+            try:
+                out = fut.result()
+                result_values = self._split(out, req["return_ids"])
+                seals = [
+                    self.put_value(oid, v)
+                    for oid, v in zip(req["return_ids"], result_values)
+                ]
+                reply = {"status": "ok", "seals": seals}
+            except BaseException as exc:  # noqa: BLE001 - errors are values
+                reply = self._error_reply(req, exc)
+            self.agent.call(
+                "TaskDone",
+                {"task_id": req["task_id"], "reply": reply},
+                timeout=60.0,
+            )
+        except RpcError:
+            logger.warning("agent unreachable; dropping TaskDone")
+        except Exception:  # noqa: BLE001
+            logger.exception("async task completion failed")
 
     def _split(self, out: Any, return_ids: List[str]) -> List[Any]:
         if len(return_ids) <= 1:
@@ -235,6 +354,31 @@ class Worker:
 
     def _h_kill_actor(self, req: dict) -> None:
         self._actors.pop(req["actor_id"], None)
+        entry = self._actor_loops.pop(req["actor_id"], None)
+        if entry is not None:
+            loop, _ = entry
+
+            def begin_shutdown() -> None:
+                import asyncio
+
+                async def drain_and_stop() -> None:
+                    # cancel in-flight methods and WAIT for the cancellations
+                    # to land: their futures resolve with CancelledError →
+                    # TaskDone(error) → callers unblock, instead of freezing
+                    # forever on a stopped loop
+                    me = asyncio.current_task()
+                    tasks = [t for t in asyncio.all_tasks() if t is not me]
+                    for t in tasks:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    loop.stop()
+
+                loop.create_task(drain_and_stop())
+
+            try:
+                loop.call_soon_threadsafe(begin_shutdown)
+            except RuntimeError:
+                pass
 
     def serve_forever(self) -> None:
         while True:

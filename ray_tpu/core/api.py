@@ -217,7 +217,8 @@ _OPTION_DEFAULTS = dict(
     lifetime=None,
     max_restarts=0,
     max_task_retries=0,
-    max_concurrency=1,
+    max_concurrency=None,
+    concurrency_groups=None,
 )
 
 
@@ -292,17 +293,16 @@ class ActorClass:
         rt = get_runtime()
         opts = self._options
         if getattr(rt, "is_remote", False):
-            for unsupported in ("max_task_retries", "max_concurrency"):
-                v = opts.get(unsupported)
-                if v not in (None, 0, 1):
-                    import warnings
+            v = opts.get("max_task_retries")
+            if v not in (None, 0):
+                import warnings
 
-                    warnings.warn(
-                        f"{unsupported}={v} is not yet supported by the "
-                        "distributed cluster backend; actor methods run "
-                        "serially with no automatic method retries",
-                        stacklevel=2,
-                    )
+                warnings.warn(
+                    f"max_task_retries={v} is not yet supported by the "
+                    "distributed cluster backend; actor methods are not "
+                    "automatically retried",
+                    stacklevel=2,
+                )
             return rt.create_actor(
                 self._cls,
                 args,
@@ -310,6 +310,8 @@ class ActorClass:
                 resources=_resource_map(opts, is_actor=True),
                 name=opts.get("name"),
                 max_restarts=opts.get("max_restarts", 0),
+                max_concurrency=opts.get("max_concurrency"),
+                concurrency_groups=opts.get("concurrency_groups"),
                 scheduling_strategy=opts.get("scheduling_strategy"),
             )
         return actor_mod.create_actor(
@@ -322,7 +324,8 @@ class ActorClass:
             lifetime=opts.get("lifetime"),
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),
-            max_concurrency=opts.get("max_concurrency", 1),
+            max_concurrency=opts.get("max_concurrency"),
+            concurrency_groups=opts.get("concurrency_groups"),
             scheduling_strategy=opts.get("scheduling_strategy"),
         )
 

@@ -51,7 +51,6 @@ logger = logging.getLogger("ray_tpu")
 
 # Leases per scheduling round (the batching that makes the TPU kernel pay).
 MAX_SCHEDULE_BATCH = 1024
-# Below this batch size the host (numpy) path beats a device dispatch.
 
 
 class ActorDiedError(Exception):
