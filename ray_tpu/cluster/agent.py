@@ -128,6 +128,7 @@ class NodeAgent:
             "WorkerSealed": self._h_worker_sealed,
             "RegisterWorker": self._h_register_worker,
             "TaskDone": self._h_task_done,
+            "RefUpdate": self._h_ref_update,
             "PrepareBundles": self._h_prepare_bundles,
             "CommitBundles": self._h_commit_bundles,
             "RollbackBundles": self._h_rollback_bundles,
@@ -255,6 +256,8 @@ class NodeAgent:
         except OSError:
             pass
         report: Dict[str, Any] = {"node_id": self.node_id}
+        # the dead process's holder counts die with it
+        report["holders_gone"] = [handle.worker_id]
         if actor_id:
             report["actors_dead"] = [
                 {"actor_id": actor_id, "reason": "worker process died"}
@@ -386,6 +389,7 @@ class NodeAgent:
                         "actor_id": spec.actor_id,
                         "payload": spec.payload,
                         "return_ids": spec.return_ids,
+                        "arg_ids": spec.arg_ids,
                         "name": spec.name,
                         "runtime_env": spec.runtime_env,
                         "actor_meta": spec.actor_meta,
@@ -436,6 +440,10 @@ class NodeAgent:
             "available": self.ledger.avail_map(),
             "finished": [spec.task_id],
         }
+        if reply.get("borrows"):
+            report["borrows"] = [
+                {"holder": handle.worker_id, "object_ids": reply["borrows"]}
+            ]
         if status == "retry":
             report.pop("finished")
             report["failed"] = [
@@ -561,6 +569,11 @@ class NodeAgent:
     def _h_worker_put(self, req: dict) -> None:
         """Worker fallback put when the shm arena is unavailable/full."""
         self.store.put_bytes(req["object_id"], req["data"])
+
+    def _h_ref_update(self, req: dict) -> None:
+        """Worker → head refcount relay (workers only talk to their agent;
+        the head is the refcount authority)."""
+        self.head.call("RefUpdate", req, timeout=10.0)
 
     def _h_worker_sealed(self, req: dict) -> None:
         """Out-of-band seal from a worker (ray_tpu.put inside a task)."""

@@ -167,16 +167,52 @@ class RemoteRuntime:
         self._lock = threading.Lock()
         self.store = _RemoteStore(self)
         self.metrics: Dict[str, int] = {}
+        # distributed refcounting: this process's holder identity + release
+        # reporter. Inside a cluster worker the worker's flusher (which
+        # routes via its agent) is already installed and is reused.
+        from ray_tpu.core import refcount
+
+        self.client_id = refcount.get_holder_id()
+        incumbent = refcount.current_consumer()
+        if isinstance(incumbent, refcount.RefFlusher):
+            self._flusher = incumbent
+            self._owns_flusher = False
+        else:
+            # dedicated channel: flusher sends during a head outage must not
+            # push the main channel into gRPC reconnect backoff
+            self._ref_chan = RpcClient(address)
+            self._flusher = refcount.RefFlusher(
+                lambda inc, dec: self._ref_chan.call(
+                    "RefUpdate",
+                    {"holder": self.client_id, "increfs": inc, "decrefs": dec},
+                    timeout=10.0,
+                ),
+                holder=self.client_id,
+            )
+            refcount.install_consumer(self._flusher)
+            self._owns_flusher = True
+
+    def _read(self, method: str, payload: Any = None, timeout: float = 30.0):
+        """Idempotent head reads retry through transport blips — a client
+        rides through a head restart the way the reference's GCS client
+        does (gcs_rpc_client.h retry budgets)."""
+        return self.head.call(
+            method, payload, timeout=timeout, retries=8, retry_interval=0.25
+        )
 
     # ------------------------------------------------------------------
     # tasks
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        from ray_tpu.core.refcount import collect_serialized
+
         _ship_module_by_value(spec.func)
+        with collect_serialized() as arg_ids:
+            payload = cloudpickle.dumps((spec.func, spec.args, spec.kwargs))
         lease = LeaseRequest(
             task_id=spec.task_id,
             name=spec.name,
-            payload=cloudpickle.dumps((spec.func, spec.args, spec.kwargs)),
+            payload=payload,
             return_ids=[r.hex for r in spec.returns],
             resources=spec.resources,
             kind="task",
@@ -184,25 +220,35 @@ class RemoteRuntime:
             retry_exceptions=spec.retry_exceptions,
             strategy=spec.strategy,
             runtime_env=self.runtime_env,
+            arg_ids=sorted(arg_ids),
+            client_id=self.client_id,
         )
         self.head.call("SubmitLease", lease)
+        self._flusher.note_registered(lease.return_ids)
         return spec.returns
 
     def submit_actor_method(
         self, actor_id: str, method: str, args: tuple, kwargs: dict
     ) -> ObjectRef:
+        from ray_tpu.core.refcount import collect_serialized
+
         ref = ObjectRef.new(owner=actor_id)
+        with collect_serialized() as arg_ids:
+            payload = cloudpickle.dumps((method, args, kwargs))
         lease = LeaseRequest(
             task_id=new_id(),
             name=f"{actor_id[:8]}.{method}",
-            payload=cloudpickle.dumps((method, args, kwargs)),
+            payload=payload,
             return_ids=[ref.hex],
             resources={},
             kind="actor_method",
             actor_id=actor_id,
             max_retries=0,
+            arg_ids=sorted(arg_ids),
+            client_id=self.client_id,
         )
         self.head.call("SubmitLease", lease)
+        self._flusher.note_registered(lease.return_ids)
         return ref
 
     # ------------------------------------------------------------------
@@ -222,12 +268,16 @@ class RemoteRuntime:
         scheduling_strategy: Any = None,
         **_ignored,
     ) -> RemoteActorHandle:
+        from ray_tpu.core.refcount import collect_serialized
+
         _ship_module_by_value(cls)
         actor_id = new_id()
+        with collect_serialized() as arg_ids:
+            payload = cloudpickle.dumps((cls, args, kwargs))
         lease = LeaseRequest(
             task_id=new_id(),
             name=f"{cls.__name__}.__init__",
-            payload=cloudpickle.dumps((cls, args, kwargs)),
+            payload=payload,
             return_ids=[],
             resources=resources,
             kind="actor_creation",
@@ -235,6 +285,8 @@ class RemoteRuntime:
             max_retries=0,
             strategy=scheduling_strategy,
             runtime_env=self.runtime_env,
+            arg_ids=sorted(arg_ids),
+            client_id=self.client_id,
         )
         self.head.call(
             "CreateActor",
@@ -250,7 +302,7 @@ class RemoteRuntime:
         return RemoteActorHandle(self, actor_id, cls)
 
     def get_actor(self, name: str) -> RemoteActorHandle:
-        info = self.head.call("GetActor", {"name": name})
+        info = self._read("GetActor", {"name": name})
         return RemoteActorHandle(self, info.actor_id, object)
 
     def kill_actor(self, handle: RemoteActorHandle, no_restart: bool = True) -> None:
@@ -261,7 +313,7 @@ class RemoteRuntime:
     def wait_actor_alive(self, handle: RemoteActorHandle, timeout: float = 30.0):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            info = self.head.call("GetActor", {"actor_id": handle._actor_id})
+            info = self._read("GetActor", {"actor_id": handle._actor_id})
             if info.state == "ALIVE":
                 return info
             if info.state == "DEAD":
@@ -273,10 +325,27 @@ class RemoteRuntime:
     # objects
     # ------------------------------------------------------------------
     def put_object(self, value: Any) -> ObjectRef:
+        from ray_tpu.core.refcount import collect_serialized
+
         ref = ObjectRef.new(owner="driver")
-        data = cloudpickle.dumps(value)
-        self.head.call("PutObject", {"object_id": ref.hex, "data": data})
+        with collect_serialized() as contained:
+            data = cloudpickle.dumps(value)
+        self.head.call(
+            "PutObject",
+            {
+                "object_id": ref.hex,
+                "data": data,
+                "holder": self.client_id,
+                "contained_ids": sorted(contained),
+            },
+        )
+        self._flusher.note_registered([ref.hex])
         return ref
+
+    def _loads_tracking(self, data: bytes) -> Any:
+        from ray_tpu.core.refcount import loads_tracking
+
+        return loads_tracking(self._flusher, data)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -284,12 +353,12 @@ class RemoteRuntime:
             poll = 2.0
             if deadline is not None:
                 poll = min(poll, max(0.0, deadline - time.monotonic()))
-            reply = self.head.call(
-                "WaitObject", {"object_id": ref.hex, "timeout": poll}, timeout=30.0
+            reply = self._read(
+                "WaitObject", {"object_id": ref.hex, "timeout": poll}
             )
             status = reply["status"]
             if status == "inline":
-                return pickle.loads(reply["data"])
+                return self._loads_tracking(reply["data"])
             if status == "error":
                 raise pickle.loads(reply["error"])
             if status == "located":
@@ -298,7 +367,7 @@ class RemoteRuntime:
                         data = self._agent(nid, addr).call(
                             "FetchObject", {"object_id": ref.hex}, timeout=120.0
                         )
-                        return pickle.loads(data)
+                        return self._loads_tracking(data)
                     except (RpcError, KeyError):
                         continue
             if deadline is not None and time.monotonic() >= deadline:
@@ -329,7 +398,7 @@ class RemoteRuntime:
     def wait_placement_group(self, pg_id: str, timeout: float = 30.0) -> List[str]:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            reply = self.head.call(
+            reply = self._read(
                 "WaitPlacementGroup", {"pg_id": pg_id, "timeout": 2.0}
             )
             if reply["ready"]:
@@ -347,16 +416,16 @@ class RemoteRuntime:
         self.head.call("KvPut", {"key": key, "value": value})
 
     def kv_get(self, key: str) -> Optional[bytes]:
-        return self.head.call("KvGet", {"key": key})
+        return self._read("KvGet", {"key": key})
 
     def kv_del(self, key: str) -> None:
         self.head.call("KvDel", {"key": key})
 
     def kv_keys(self, prefix: str = "") -> List[str]:
-        return self.head.call("KvKeys", {"prefix": prefix})
+        return self._read("KvKeys", {"prefix": prefix})
 
     def nodes_info(self) -> List[Dict[str, Any]]:
-        return self.head.call("ClusterInfo")["nodes"]
+        return self._read("ClusterInfo")["nodes"]
 
     def cluster_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -377,11 +446,11 @@ class RemoteRuntime:
         return out
 
     def query_state(self, kind: str = "summary") -> Any:
-        return self.head.call("QueryState", {"kind": kind})
+        return self._read("QueryState", {"kind": kind})
 
     def timeline(self, filename: Optional[str] = None) -> List[dict]:
         """Chrome-trace of head-observed lease lifecycle events."""
-        spans = self.head.call("Timeline", timeout=60.0)
+        spans = self._read("Timeline", timeout=60.0)
         if filename:
             import json
 
@@ -390,6 +459,14 @@ class RemoteRuntime:
         return spans
 
     def shutdown(self) -> None:
+        from ray_tpu.core import refcount
+
+        if self._owns_flusher:
+            # release every id this driver still counts so the cluster can
+            # free driver-owned objects (job-exit cleanup analog)
+            self._flusher.stop(release_all=True)
+            refcount.clear_consumer(self._flusher)
+            self._ref_chan.close()
         self.head.close()
         with self._lock:
             for client in self._agents.values():

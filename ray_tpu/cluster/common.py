@@ -60,6 +60,12 @@ class LeaseRequest:
     # actor_creation only: {"name", "max_restarts"} so the hosting agent can
     # re-describe its actors to a restarted head
     actor_meta: Optional[dict] = None
+    # --- distributed refcounting (reference_counter.h analog) ---
+    # every ObjectRef serialized into the payload: the head pins these for
+    # the lease's lifetime (args must outlive dispatch + execution)
+    arg_ids: List[str] = field(default_factory=list)
+    # submitting process's holder id: the initial owner of the return ids
+    client_id: str = ""
 
 
 @dataclass
@@ -72,6 +78,10 @@ class SealInfo:
     inline_value: Optional[bytes] = None  # pickled value if small
     is_error: bool = False
     error: Optional[bytes] = None  # pickled exception
+    # ObjectRefs serialized inside the sealed value: the head pins them
+    # while this object is alive (nested-ref ownership,
+    # reference_counter.h AddNestedObjectIds)
+    contained_ids: List[str] = field(default_factory=list)
 
 
 @dataclass

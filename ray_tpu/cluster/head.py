@@ -74,7 +74,9 @@ _PERSIST_REG_LOCK = threading.Lock()
 
 @dataclass
 class _ObjEntry:
-    """Object-directory row (ownership_object_directory analog)."""
+    """Object-directory row (ownership_object_directory analog) — also the
+    cluster-wide refcount row (reference_counter.h:44 analog): the head is
+    the single ownership authority in this centralized design."""
 
     event: threading.Event = field(default_factory=threading.Event)
     inline: Optional[bytes] = None
@@ -82,6 +84,18 @@ class _ObjEntry:
     locations: set = field(default_factory=set)
     size: int = 0
     creating_lease: Optional[str] = None
+    # holder process id -> count (negative transients tolerate a release
+    # overtaking its matching borrow report on the wire)
+    holders: Dict[str, int] = field(default_factory=dict)
+    # in-flight lease arg pins + containing-object pins
+    pins: int = 0
+    # ids of ObjectRefs serialized inside this object's sealed value
+    contained: List[str] = field(default_factory=list)
+    # a holder/pin was registered at least once. Entries that were never
+    # tracked (e.g. seals reported to a freshly-restarted head, whose
+    # refcount tables died with the old head) are exempt from GC — they
+    # leak-until-shutdown instead of being wrongly freed.
+    tracked: bool = False
 
 
 @dataclass
@@ -121,6 +135,13 @@ class HeadServer:
         self._last_report: Dict[str, float] = {}
         self._objects: Dict[str, _ObjEntry] = {}
         self._leases: Dict[str, LeaseRequest] = {}  # lineage: lease_id -> spec
+        # --- distributed refcounting state ---
+        from ray_tpu.core.refcount import FreedLRU
+
+        self._freed = FreedLRU()
+        self._holder_hexes: Dict[str, set] = {}  # holder -> ids it counts
+        self._lease_arg_pins: Dict[str, List[str]] = {}  # lease -> pinned args
+        self._lease_live_returns: Dict[str, int] = {}  # lease -> unfreed outs
         self._pending: deque = deque()
         self._infeasible: List[LeaseRequest] = []
         self._in_flight: Dict[str, Tuple[LeaseRequest, str]] = {}
@@ -165,6 +186,7 @@ class HeadServer:
             "PutObject": self._h_put_object,
             "WaitObject": self._h_wait_object,
             "FreeObjects": self._h_free_objects,
+            "RefUpdate": self._h_ref_update,
             "CreateActor": self._h_create_actor,
             "GetActor": self._h_get_actor,
             "KillActor": self._h_kill_actor,
@@ -422,6 +444,7 @@ class HeadServer:
     def _retry_or_fail(self, spec: LeaseRequest, reason: str) -> None:
         if spec.kind == "actor_method":
             self._seal_error_ids(spec.return_ids, RuntimeError(reason))
+            self._release_lease_pins(spec.task_id)
             return
         if spec.attempt < spec.max_retries:
             spec.attempt += 1
@@ -432,6 +455,7 @@ class HeadServer:
                 self._cond.notify_all()
         else:
             self._seal_error_ids(spec.return_ids, RuntimeError(reason))
+            self._release_lease_pins(spec.task_id)
 
     def _recover_object(
         self, object_id: str, dead_node: str, requeued: set
@@ -486,6 +510,10 @@ class HeadServer:
                 if info.name and self._named_actors.get(info.name) == info.actor_id:
                     del self._named_actors[info.name]
         self.mark_dirty()
+        if not restart and spec is not None:
+            # the actor is gone for good: its ctor args no longer need to
+            # outlive it (the lifetime pin from _h_create_actor)
+            self._release_lease_pins(spec.task_id)
         if restart:
             clone = LeaseRequest(
                 task_id=new_id(),
@@ -513,8 +541,11 @@ class HeadServer:
             return self._objects.setdefault(object_id, _ObjEntry())
 
     def _apply_seals(self, seals: List[SealInfo]) -> None:
+        check: List[str] = []
         with self._cond:
             for s in seals:
+                if s.object_id in self._freed:
+                    continue  # every handle died before the seal landed
                 e = self._objects.setdefault(s.object_id, _ObjEntry())
                 if s.is_error:
                     e.error = s.error
@@ -523,10 +554,20 @@ class HeadServer:
                         e.inline = s.inline_value
                     e.locations.add(s.node_id)
                     e.size = s.size
+                    if s.contained_ids and not e.contained:
+                        # nested-ref pinning: only the original seal carries
+                        # contained ids (peer-fetch re-advertisements don't)
+                        e.contained = list(s.contained_ids)
+                        for inner in e.contained:
+                            self._pin(inner)
                 e.event.set()
+                check.append(s.object_id)
             self._cond.notify_all()
+        # a seal may land after the last holder left: free immediately
+        self._maybe_free_many(check)
 
     def _finish_leases(self, lease_ids: List[str]) -> None:
+        unpin: List[str] = []
         with self._cond:
             for lid in lease_ids:
                 self._in_flight.pop(lid, None)
@@ -534,11 +575,17 @@ class HeadServer:
                 spec = self._leases.get(lid)
                 if spec is not None:
                     self.events.record(lid, spec.name, "FINISHED")
+                # a restartable actor's ctor args stay pinned for the actor's
+                # lifetime (lineage for restarts); released when it dies
+                if spec is None or spec.kind != "actor_creation":
+                    unpin.append(lid)
             # completed leases freed resources somewhere: wake parked work
             self._pending.extend(self._infeasible)
             self._infeasible.clear()
             self._pgs_dirty = True
             self._cond.notify_all()
+        for lid in unpin:
+            self._release_lease_pins(lid)
 
     def _h_report_seals(self, req: dict) -> None:
         node_id = req.get("node_id")
@@ -547,9 +594,15 @@ class HeadServer:
                 node = self.nodes.get(node_id)
                 if node is not None and node.alive:
                     self.view.update_available(node_id, req["available"])
+        # borrows must land before the finished-lease unpin below: the pin is
+        # what keeps a borrowed arg alive until its borrow is on the books
+        if req.get("borrows"):
+            self._apply_borrows(req["borrows"])
         self._apply_seals(req.get("seals", []))
         if req.get("finished"):
             self._finish_leases(req["finished"])
+        for holder in req.get("holders_gone", []):
+            self._drop_holder(holder)
         for fail in req.get("failed", []):
             with self._cond:
                 item = self._in_flight.pop(fail["task_id"], None)
@@ -574,16 +627,27 @@ class HeadServer:
         blob = pickle.dumps(exc)
         with self._cond:
             for oid in object_ids:
+                if oid in self._freed:
+                    continue
                 e = self._objects.setdefault(oid, _ObjEntry())
                 e.error = blob
                 e.event.set()
             self._cond.notify_all()
+        self._maybe_free_many(object_ids)
 
     def _h_put_object(self, req: dict) -> dict:
         """Driver put: small values inline at the head; large ones are
         forwarded into a node's shared-memory store."""
         object_id, data = req["object_id"], req["data"]
         e = self._entry(object_id)
+        holder = req.get("holder")
+        with self._lock:
+            if holder:
+                self._add_holder(object_id, holder)
+            for inner in req.get("contained_ids", ()):
+                if inner not in e.contained:
+                    e.contained.append(inner)
+                    self._pin(inner)
         if len(data) <= INLINE_OBJECT_MAX:
             e.inline = data
             e.size = len(data)
@@ -615,6 +679,18 @@ class HeadServer:
     def _h_wait_object(self, req: dict) -> dict:
         """Long-poll for availability (pubsub long-poll analog,
         src/ray/pubsub/)."""
+        if req["object_id"] in self._freed:
+            from ray_tpu.core.object_store import ObjectLostError
+
+            return {
+                "status": "error",
+                "error": pickle.dumps(
+                    ObjectLostError(
+                        f"object {req['object_id']} was freed (all references "
+                        "dropped or explicitly freed)"
+                    )
+                ),
+            }
         e = self._entry(req["object_id"])
         t = req.get("timeout")
         timeout = min(2.0 if t is None else t, 10.0)
@@ -635,32 +711,177 @@ class HeadServer:
         return {"status": "located", "locations": locs}
 
     def _h_free_objects(self, req: dict) -> None:
+        """Manual force-free (internal_api.free analog): zero the holder
+        counts and let the normal free path cascade (contained pins,
+        lineage release, per-node deletes)."""
         ids = req["object_ids"]
         with self._lock:
-            by_node: Dict[str, List[str]] = {}
             for oid in ids:
-                e = self._objects.pop(oid, None)
+                e = self._objects.get(oid)
                 if e is None:
                     continue
+                for holder in list(e.holders):
+                    hx = self._holder_hexes.get(holder)
+                    if hx is not None:
+                        hx.discard(oid)
+                e.holders.clear()
+                e.pins = 0
+        self._maybe_free_many(ids)
+
+    # ------------------------------------------------------------------
+    # distributed refcounting (reference_counter.h:44 analog; centralized
+    # at the head instead of the reference's per-owner borrow protocol)
+    # ------------------------------------------------------------------
+    def _add_holder(self, oid: str, holder: str) -> None:
+        """Count one hold of ``oid`` by process ``holder``. Caller holds
+        self._lock."""
+        e = self._objects.setdefault(oid, _ObjEntry())
+        e.holders[holder] = e.holders.get(holder, 0) + 1
+        e.tracked = True
+        self._holder_hexes.setdefault(holder, set()).add(oid)
+
+    def _pin(self, oid: str) -> None:
+        """Pin ``oid`` (lease arg / containing object). Caller holds
+        self._lock."""
+        e = self._objects.setdefault(oid, _ObjEntry())
+        e.pins += 1
+        e.tracked = True
+
+    def _h_ref_update(self, req: dict) -> None:
+        """Client/worker holder-count deltas: ``increfs`` are synchronous
+        borrow registrations (sent while the borrowed id is still pinned by
+        its outer object or lease), ``decrefs`` are 1→0 instance-count
+        releases from a process."""
+        holder = req["holder"]
+        to_check: List[str] = []
+        with self._lock:
+            for oid in req.get("increfs", ()):
+                if oid in self._freed:
+                    continue
+                self._add_holder(oid, holder)
+            for oid in req.get("decrefs", ()):
+                e = self._objects.get(oid)
+                if e is None:
+                    continue
+                c = e.holders.get(holder, 0) - 1
+                if c == 0:
+                    e.holders.pop(holder, None)
+                else:
+                    e.holders[holder] = c
+                hx = self._holder_hexes.get(holder)
+                if hx is not None:
+                    hx.discard(oid)
+                to_check.append(oid)
+        self._maybe_free_many(to_check)
+
+    def _register_return_holder(self, spec: LeaseRequest) -> None:
+        holder = spec.client_id
+        with self._lock:
+            for oid in spec.return_ids:
+                e = self._objects.setdefault(oid, _ObjEntry())
+                e.creating_lease = spec.task_id
+                e.tracked = True
+                if holder:
+                    self._add_holder(oid, holder)
+            if spec.return_ids:
+                self._lease_live_returns[spec.task_id] = len(spec.return_ids)
+            if spec.arg_ids:
+                self._lease_arg_pins[spec.task_id] = list(spec.arg_ids)
+                for oid in spec.arg_ids:
+                    self._pin(oid)
+
+    def _release_lease_pins(self, task_id: str) -> None:
+        """The lease finished (or failed for good): its args no longer need
+        to outlive it (LeaseDependencyManager unpin analog)."""
+        with self._lock:
+            args = self._lease_arg_pins.pop(task_id, None)
+            if not args:
+                return
+            for oid in args:
+                e = self._objects.get(oid)
+                if e is not None:
+                    e.pins -= 1
+        self._maybe_free_many(args)
+
+    def _apply_borrows(self, borrows: List[dict]) -> None:
+        """A worker finished a task still holding some of its args (stored
+        them in actor state): transfer the lease pin into a holder count
+        before the pin is released."""
+        with self._lock:
+            for b in borrows:
+                holder = b["holder"]
+                for oid in b.get("object_ids", ()):
+                    if oid in self._freed:
+                        continue
+                    self._add_holder(oid, holder)
+
+    def _drop_holder(self, holder: str) -> None:
+        """A process died: forget every count it held."""
+        with self._lock:
+            hexes = list(self._holder_hexes.pop(holder, ()))
+            for oid in hexes:
+                e = self._objects.get(oid)
+                if e is not None:
+                    e.holders.pop(holder, None)
+        self._maybe_free_many(hexes)
+
+    def _maybe_free_many(self, oids) -> None:
+        """Free every listed object whose counts/pins are exhausted, then
+        cascade through contained refs and lineage releases."""
+        work = list(oids or ())
+        deletes: Dict[str, List[str]] = {}  # node -> object ids
+        freed_leases: List[str] = []
+        with self._lock:
+            while work:
+                oid = work.pop()
+                e = self._objects.get(oid)
+                if (
+                    e is None
+                    or not e.tracked
+                    or not e.event.is_set()
+                    or e.pins > 0
+                    or any(c > 0 for c in e.holders.values())
+                ):
+                    continue
+                del self._objects[oid]
+                self._freed.add(oid)
                 for nid in e.locations:
-                    by_node.setdefault(nid, []).append(oid)
-            clients = {nid: self._clients[nid] for nid in by_node if nid in self._clients}
-        for nid, oids in by_node.items():
+                    deletes.setdefault(nid, []).append(oid)
+                for inner in e.contained:
+                    ie = self._objects.get(inner)
+                    if ie is not None:
+                        ie.pins -= 1
+                        work.append(inner)
+                lid = e.creating_lease
+                if lid is not None and lid in self._lease_live_returns:
+                    self._lease_live_returns[lid] -= 1
+                    if self._lease_live_returns[lid] <= 0:
+                        del self._lease_live_returns[lid]
+                        freed_leases.append(lid)
+            # lineage release: all outputs of these leases are gone — the
+            # spec (and the arg refs its payload pins) can go too
+            for lid in freed_leases:
+                self._leases.pop(lid, None)
+            clients = {
+                nid: self._clients.get(nid)
+                for nid in deletes
+                if self.nodes.get(nid) is not None
+            }
+        for nid, ids in deletes.items():
             client = clients.get(nid)
-            if client is None:
-                continue
-            try:
-                client.call("DeleteObjects", {"object_ids": oids})
-            except RpcError:
-                pass
+            if client is not None:
+                self._dispatch_pool.submit(
+                    _best_effort,
+                    client.call,
+                    "DeleteObjects",
+                    {"object_ids": ids},
+                )
 
     # ------------------------------------------------------------------
     # lease intake + the batched scheduler
     # ------------------------------------------------------------------
     def _h_submit_lease(self, spec: LeaseRequest) -> dict:
-        for oid in spec.return_ids:
-            e = self._entry(oid)
-            e.creating_lease = spec.task_id
+        self._register_return_holder(spec)
         with self._cond:
             self._leases[spec.task_id] = spec
             self.metrics["leases_submitted"] += 1
@@ -932,6 +1153,9 @@ class HeadServer:
             "max_concurrency": req.get("max_concurrency"),
             "concurrency_groups": req.get("concurrency_groups", {}),
         }
+        # ctor args stay pinned for the actor's whole life (restarts replay
+        # the creation payload); released when the actor is finally DEAD
+        self._register_return_holder(spec)
         with self._cond:
             if name:
                 if name in self._named_actors:

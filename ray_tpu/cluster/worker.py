@@ -56,6 +56,21 @@ class Worker:
         self.worker_id = worker_id
         self.agent = RpcClient(agent_address)
         self.node_id = os.environ.get("RAY_TPU_NODE_ID", "")
+        # distributed refcounting: this process reports releases through its
+        # agent (which forwards to the head); the worker id is the holder id,
+        # shared with any nested client runtime user code creates.
+        from ray_tpu.core import refcount
+
+        refcount.set_holder_id(worker_id)
+        self._flusher = refcount.RefFlusher(
+            lambda inc, dec: self.agent.call(
+                "RefUpdate",
+                {"holder": worker_id, "increfs": inc, "decrefs": dec},
+                timeout=10.0,
+            ),
+            holder=worker_id,
+        )
+        refcount.install_consumer(self._flusher)
         self.store = None
         if store_path:
             try:
@@ -93,10 +108,15 @@ class Worker:
     # ------------------------------------------------------------------
     # object plane helpers
     # ------------------------------------------------------------------
+    def _loads_tracking(self, data: bytes) -> Any:
+        from ray_tpu.core.refcount import loads_tracking
+
+        return loads_tracking(self._flusher, data)
+
     def get_object(self, hex_id: str, timeout: Optional[float] = None) -> Any:
         if self.store is not None:
             try:
-                return pickle.loads(self.store.get_bytes(hex_id))
+                return self._loads_tracking(self.store.get_bytes(hex_id))
             except (KeyError, BlockingIOError):
                 pass
         reply = self.agent.call(
@@ -111,22 +131,27 @@ class Worker:
                 data = self.agent.call(
                     "FetchObject", {"object_id": hex_id}, timeout=120.0
                 )
-                return pickle.loads(data)
-            return pickle.loads(self.store.get_bytes(hex_id))
+                return self._loads_tracking(data)
+            return self._loads_tracking(self.store.get_bytes(hex_id))
         if status == "inline":
-            return pickle.loads(reply["data"])
+            return self._loads_tracking(reply["data"])
         if status == "error":
             raise pickle.loads(reply["error"])
         raise TimeoutError(f"timed out fetching object {hex_id}")
 
     def put_value(self, object_id: str, value: Any) -> SealInfo:
-        data = cloudpickle.dumps(value)
+        from ray_tpu.core.refcount import collect_serialized
+
+        with collect_serialized() as contained:
+            data = cloudpickle.dumps(value)
+        contained_ids = sorted(contained)
         if len(data) <= INLINE_OBJECT_MAX:
             return SealInfo(
                 object_id=object_id,
                 node_id=self.node_id,
                 size=len(data),
                 inline_value=data,
+                contained_ids=contained_ids,
             )
         stored = False
         if self.store is not None:
@@ -140,7 +165,10 @@ class Worker:
                 "WorkerPut", {"object_id": object_id, "data": data}, timeout=60.0
             )
         return SealInfo(
-            object_id=object_id, node_id=self.node_id, size=len(data)
+            object_id=object_id,
+            node_id=self.node_id,
+            size=len(data),
+            contained_ids=contained_ids,
         )
 
     # ------------------------------------------------------------------
@@ -243,10 +271,29 @@ class Worker:
             for oid, v in zip(req["return_ids"], result_values)
         ]
         reply = {"status": "ok", "seals": seals}
+        borrows = self._compute_borrows(req.get("arg_ids"))
+        if borrows:
+            reply["borrows"] = borrows
         if kind == "actor_creation" and req["actor_id"] in self._actor_loops:
             # tells the agent to skip per-actor FIFO serialization
             reply["async_actor"] = True
         return reply
+
+    def _compute_borrows(self, arg_ids) -> List[str]:
+        """Arg refs this process still holds at task completion (stored in
+        actor state or a live closure): reported in the completion reply so
+        the head converts the lease's arg pin into a holder count before
+        releasing it (borrower registration, reference_counter.h borrows)."""
+        from ray_tpu.core.refcount import TRACKER
+
+        borrowed = [
+            h
+            for h in arg_ids or ()
+            if TRACKER.count(h) > 0 and not self._flusher.is_registered(h)
+        ]
+        if borrowed:
+            self._flusher.note_registered(borrowed)
+        return borrowed
 
     def _start_actor_loop(self, actor_id: str, groups: Dict[str, int]):
         """Returns (loop, {group: semaphore}); semaphores bind to the loop."""
@@ -308,6 +355,9 @@ class Worker:
                     for oid, v in zip(req["return_ids"], result_values)
                 ]
                 reply = {"status": "ok", "seals": seals}
+                borrows = self._compute_borrows(req.get("arg_ids"))
+                if borrows:
+                    reply["borrows"] = borrows
             except BaseException as exc:  # noqa: BLE001 - errors are values
                 reply = self._error_reply(req, exc)
             self.agent.call(

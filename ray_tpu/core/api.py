@@ -155,11 +155,11 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
     with rt._cond:
         for q in (rt._pending, rt._infeasible):
             for spec in list(q):
-                if any(r.hex == ref.hex for r in spec.returns):
+                if ref.hex in spec.return_ids:
                     q.remove(spec)
                     err = TaskError(RuntimeError("task cancelled"), spec.name)
-                    for r in spec.returns:  # seal every sibling return
-                        rt.store.seal(r, err, True)
+                    for rid in spec.return_ids:  # seal every sibling return
+                        rt._seal_id(None, rid, err, True)
 
 
 def nodes() -> List[Dict[str, Any]]:

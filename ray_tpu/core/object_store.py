@@ -14,6 +14,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from . import refcount
+
 
 class TaskError(Exception):
     """Wraps an exception raised in a remote task (RayTaskError analog)."""
@@ -36,15 +38,46 @@ class GetTimeoutError(TimeoutError):
 class ObjectRef:
     """A future-like handle to a task output or put object.
 
-    28-hex ids like the reference's ObjectID (src/ray/common/id.h).
+    28-hex ids like the reference's ObjectID (src/ray/common/id.h). Every
+    instance participates in distributed reference counting: construction
+    (including unpickling) increfs the process tracker, ``__del__`` decrefs
+    — the CPython-side hook the reference uses for RemoveLocalReference
+    (python/ray/includes/object_ref.pxi). Internal bookkeeping that must
+    not pin an object uses ``ObjectRef.weak``.
     """
 
     hex: str
     owner: str = ""  # owning "worker"/task id — lineage anchor
 
+    def __post_init__(self) -> None:
+        refcount.TRACKER.incref(self.hex)
+        object.__setattr__(self, "_counted", True)
+        refcount.note_deserialized(self.hex)
+
+    def __del__(self) -> None:
+        if getattr(self, "_counted", False):
+            try:
+                refcount.TRACKER.decref(self.hex)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+
+    def __reduce__(self):
+        refcount.note_serialized(self.hex)
+        return (ObjectRef, (self.hex, self.owner))
+
     @staticmethod
     def new(owner: str = "") -> "ObjectRef":
         return ObjectRef(uuid.uuid4().hex[:28], owner)
+
+    @staticmethod
+    def weak(hex_id: str, owner: str = "") -> "ObjectRef":
+        """An uncounted handle for runtime-internal plumbing (lineage
+        clones, seal paths) that must not keep the object alive."""
+        self = object.__new__(ObjectRef)
+        object.__setattr__(self, "hex", hex_id)
+        object.__setattr__(self, "owner", owner)
+        object.__setattr__(self, "_counted", False)
+        return self
 
     def __repr__(self) -> str:
         return f"ObjectRef({self.hex})"
@@ -58,8 +91,10 @@ class _Entry:
     event: threading.Event = field(default_factory=threading.Event)
     value: Any = None
     is_error: bool = False
-    local_refs: int = 1
     creating_task: Optional[str] = None  # lineage: task id that creates this
+    # user dropped every handle before the creating task sealed: free the
+    # value the moment the seal lands instead of storing it
+    unreferenced: bool = False
 
 
 NATIVE_THRESHOLD_BYTES = 64 * 1024
@@ -86,7 +121,7 @@ class ObjectStore:
         self._objects: Dict[str, _Entry] = {}
         self._native = native
 
-    def _maybe_nativize(self, ref: "ObjectRef", value: Any):
+    def _maybe_nativize(self, hex_id: str, value: Any):
         import numpy as np
 
         if (
@@ -95,8 +130,8 @@ class ObjectStore:
             and value.nbytes >= NATIVE_THRESHOLD_BYTES
         ):
             try:
-                self._native.put_numpy(ref.hex, value)
-                return _NativeHandle(ref.hex)
+                self._native.put_numpy(hex_id, value)
+                return _NativeHandle(hex_id)
             except (MemoryError, KeyError, OSError):
                 return value
         return value
@@ -107,18 +142,27 @@ class ObjectStore:
         return value
 
     def create(self, ref: ObjectRef, creating_task: Optional[str] = None) -> None:
-        with self._lock:
-            if ref.hex not in self._objects:
-                self._objects[ref.hex] = _Entry(creating_task=creating_task)
+        self.create_id(ref.hex, creating_task)
 
-    def seal(self, ref: ObjectRef, value: Any, is_error: bool = False) -> None:
-        if not is_error:
-            value = self._maybe_nativize(ref, value)
+    def create_id(self, hex_id: str, creating_task: Optional[str] = None) -> None:
         with self._lock:
-            entry = self._objects.setdefault(ref.hex, _Entry())
+            if hex_id not in self._objects:
+                self._objects[hex_id] = _Entry(creating_task=creating_task)
+
+    def seal(self, ref: ObjectRef, value: Any, is_error: bool = False) -> bool:
+        return self.seal_id(ref.hex, value, is_error)
+
+    def seal_id(self, hex_id: str, value: Any, is_error: bool = False) -> bool:
+        """Seal and return True if every handle was already dropped (the
+        caller should free the object + its lineage immediately)."""
+        if not is_error:
+            value = self._maybe_nativize(hex_id, value)
+        with self._lock:
+            entry = self._objects.setdefault(hex_id, _Entry())
             entry.value = value
             entry.is_error = is_error
             entry.event.set()
+            return entry.unreferenced
 
     def contains(self, ref: ObjectRef) -> bool:
         with self._lock:
@@ -185,29 +229,27 @@ class ObjectStore:
             waited += step
             step = min(step * 2, 0.1)
 
-    def add_ref(self, ref: ObjectRef) -> None:
-        with self._lock:
-            e = self._objects.get(ref.hex)
-            if e:
-                e.local_refs += 1
-
-    def remove_ref(self, ref: ObjectRef) -> None:
-        with self._lock:
-            e = self._objects.get(ref.hex)
-            if e:
-                e.local_refs -= 1
-                if e.local_refs <= 0 and e.event.is_set():
-                    del self._objects[ref.hex]
-
     def free(self, refs: List[ObjectRef]) -> None:
+        for r in refs:
+            self.free_id(r.hex)
+
+    def free_id(self, hex_id: str) -> bool:
+        """Drop a sealed entry (idempotent). An unsealed entry is flagged so
+        the eventual seal frees it. Returns True if an entry was removed."""
         with self._lock:
-            entries = [self._objects.pop(r.hex, None) for r in refs]
-        for e in entries:
-            if e is not None and isinstance(e.value, _NativeHandle):
-                try:
-                    self._native.delete(e.value.hex)
-                except Exception:  # noqa: BLE001
-                    pass
+            e = self._objects.get(hex_id)
+            if e is None:
+                return False
+            if not e.event.is_set():
+                e.unreferenced = True
+                return False
+            del self._objects[hex_id]
+        if isinstance(e.value, _NativeHandle):
+            try:
+                self._native.delete(e.value.hex)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -70,7 +70,9 @@ class TaskSpec:
     func: Callable
     args: tuple
     kwargs: dict
-    returns: List[ObjectRef]
+    returns: List[ObjectRef]  # transient: emptied by submit() so queued
+    # specs pin their *args* (live ObjectRef instances) but never their own
+    # outputs — lineage release is what frees args when outputs die
     resources: Dict[str, float]
     name: str = ""
     kind: str = "task"  # task | actor_creation | actor_method
@@ -79,6 +81,8 @@ class TaskSpec:
     max_retries: int = 3
     retry_exceptions: bool = False
     attempt: int = 0
+    # return object ids; a slot is None once that output has been freed
+    return_ids: List[Optional[str]] = field(default_factory=list)
 
 
 @dataclass
@@ -92,6 +96,16 @@ class Node:
     alive: bool = True
     running_tasks: Dict[str, TaskSpec] = field(default_factory=dict)
     objects: set = field(default_factory=set)  # hex ids sealed on this node
+
+
+class _GcConsumer:
+    """Tracker-consumer token for the in-process runtime's GC thread."""
+
+    def __init__(self, stop_event: threading.Event):
+        self._stop_event = stop_event
+
+    def stop(self) -> None:
+        self._stop_event.set()
 
 
 class WorkerContext(threading.local):
@@ -158,6 +172,16 @@ class Runtime:
         self._sched_thread = threading.Thread(
             target=self._scheduler_loop, name="ray_tpu-scheduler", daemon=True
         )
+        # automatic object GC (ReferenceCounter analog): drains instance-count
+        # zeros from the process tracker and frees store entries + lineage
+        from .refcount import FreedLRU, install_consumer
+
+        self._freed = FreedLRU()
+        self._gc_stop = threading.Event()
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, name="ray_tpu-gc", daemon=True
+        )
+        install_consumer(_GcConsumer(self._gc_stop))
         self.metrics: Dict[str, int] = {
             "tasks_submitted": 0,
             "tasks_finished": 0,
@@ -173,6 +197,52 @@ class Runtime:
         for i in range(num_nodes):
             self.add_node(resources_per_node)
         self._sched_thread.start()
+        self._gc_thread.start()
+
+    # ------------------------------------------------------------------
+    # automatic object GC (reference_counter.h:44 analog)
+    # ------------------------------------------------------------------
+    def _gc_loop(self) -> None:
+        from .refcount import TRACKER
+
+        while not self._gc_stop.is_set():
+            TRACKER.zero_event.wait(timeout=1.0)
+            if self._gc_stop.is_set():
+                return
+            for hex_id in TRACKER.drain_zeros():
+                try:
+                    self._free_local(hex_id)
+                except Exception:  # noqa: BLE001 - GC must survive
+                    logger.exception("object GC failed for %s", hex_id)
+
+    def _free_local(self, hex_id: str) -> None:
+        """No live handle remains for this object: drop the sealed value
+        (or flag an unsealed entry to be dropped at seal) and release its
+        lineage — which releases the creating task's argument refs, so
+        frees cascade exactly like the reference's lineage release
+        (reference_counter.h ReleaseLineageReferences)."""
+        removed = self.store.free_id(hex_id)
+        spec = self._lineage.pop(hex_id, None)
+        if spec is not None and removed:
+            # tombstone the slot: a lineage re-execution of a sibling output
+            # must not resurrect this one
+            for i, rid in enumerate(spec.return_ids):
+                if rid == hex_id:
+                    spec.return_ids[i] = None
+        if removed:
+            self._freed.add(hex_id)
+            for node in self.nodes.values():
+                node.objects.discard(hex_id)
+
+    def _seal_id(self, node: Optional[Node], hex_id: Optional[str], value, is_error=False) -> None:
+        """Seal one output by id, honoring freed tombstones and
+        dropped-before-sealed outputs."""
+        if hex_id is None or hex_id in self._freed:
+            return
+        if node is not None:
+            node.objects.add(hex_id)
+        if self.store.seal_id(hex_id, value, is_error):
+            self._free_local(hex_id)
 
     # ------------------------------------------------------------------
     # membership (GcsNodeManager analog)
@@ -231,27 +301,28 @@ class Runtime:
                 self.metrics["leases_spilled_back"] += 1
                 self._enqueue(spec)
             else:
-                for ref in spec.returns:
-                    self.store.seal(
-                        ref,
-                        NodeDiedError(f"node {node_id} died running {spec.name}"),
-                        is_error=True,
-                    )
+                err = NodeDiedError(f"node {node_id} died running {spec.name}")
+                for rid in spec.return_ids:
+                    self._seal_id(None, rid, err, is_error=True)
 
     def _invalidate_object(self, hex_id: str) -> None:
+        if hex_id in self._freed:
+            return  # nobody holds it anymore; no point reconstructing
         spec = self._lineage.get(hex_id)
         if spec is not None and (
             spec.kind != "task" or spec.attempt >= spec.max_retries
         ):
             # Lineage exhausted (or not a re-executable plain task): the
             # object is permanently lost — fail pending gets.
-            ref = next((r for r in spec.returns if r.hex == hex_id), None)
-            if ref is not None and self.store.contains(ref):
+            if hex_id in spec.return_ids and self.store.contains(
+                ObjectRef.weak(hex_id)
+            ):
                 return  # already sealed elsewhere (e.g. resubmitted copy won)
             from .object_store import ObjectLostError
 
-            self.store.seal(
-                ObjectRef(hex_id),
+            self._seal_id(
+                None,
+                hex_id,
                 ObjectLostError(
                     f"object {hex_id} lost with its node; lineage retries "
                     f"exhausted ({spec.attempt}/{spec.max_retries})"
@@ -270,7 +341,8 @@ class Runtime:
                 func=spec.func,
                 args=spec.args,
                 kwargs=spec.kwargs,
-                returns=spec.returns,
+                returns=[],
+                return_ids=list(spec.return_ids),
                 resources=spec.resources,
                 name=spec.name,
                 kind=spec.kind,
@@ -280,21 +352,27 @@ class Runtime:
                 retry_exceptions=spec.retry_exceptions,
                 attempt=spec.attempt + 1,
             )
-            for r in clone.returns:
-                self._lineage[r.hex] = clone  # retry budget advances
+            for rid in clone.return_ids:
+                if rid is not None:
+                    self._lineage[rid] = clone  # retry budget advances
             self._enqueue(clone)
 
     # ------------------------------------------------------------------
     # submission (NormalTaskSubmitter analog)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
-        for ref in spec.returns:
+        refs = spec.returns
+        spec.return_ids = [r.hex for r in refs]
+        # the queued/lineage spec keeps only ids: the user's handles are the
+        # sole owners of the outputs (dropping them all → automatic GC)
+        spec.returns = []
+        for ref in refs:
             self.store.create(ref, creating_task=spec.task_id)
             self._lineage[ref.hex] = spec
         self.metrics["tasks_submitted"] += 1
         self.events.record(spec.task_id, spec.name, "SUBMITTED")
         self._enqueue(spec)
-        return spec.returns
+        return refs
 
     def _enqueue(self, spec: TaskSpec) -> None:
         with self._cond:
@@ -403,8 +481,8 @@ class Runtime:
                     ),
                     spec.name,
                 )
-                for ref in spec.returns:
-                    self.store.seal(ref, err, is_error=True)
+                for rid in spec.return_ids:
+                    self._seal_id(None, rid, err, is_error=True)
             elif target is None:
                 self._park_infeasible(spec)
             else:
@@ -574,8 +652,8 @@ class Runtime:
                 )
                 err = TaskError(exc, spec.name or spec.task_id)
                 err.__cause__ = exc
-                for ref in spec.returns:
-                    self.store.seal(ref, err, is_error=True)
+                for rid in spec.return_ids:
+                    self._seal_id(None, rid, err, is_error=True)
                 if spec.kind == "actor_creation":
                     state = self._actors.get(spec.actor_id)
                     if state is not None:
@@ -644,19 +722,18 @@ class Runtime:
         return res_args, res_kwargs
 
     def _seal_results(self, spec: TaskSpec, node: Node, result: Any) -> None:
-        refs = spec.returns
-        if len(refs) == 1:
+        rids = spec.return_ids
+        if len(rids) == 1:
             values: Sequence[Any] = [result]
         else:
             values = tuple(result)
-            if len(values) != len(refs):
+            if len(values) != len(rids):
                 raise ValueError(
                     f"task {spec.name} returned {len(values)} values, "
-                    f"expected {len(refs)}"
+                    f"expected {len(rids)}"
                 )
-        for ref, value in zip(refs, values):
-            node.objects.add(ref.hex)
-            self.store.seal(ref, value)
+        for rid, value in zip(rids, values):
+            self._seal_id(node, rid, value)
 
     # ------------------------------------------------------------------
     # objects
@@ -676,10 +753,21 @@ class Runtime:
         # blocks until the re-execution seals — or sealed with ObjectLostError.
         return self.store.get(ref, timeout)
 
+    def free_objects(self, refs: List[ObjectRef]) -> None:
+        """Manual force-free (ray._private.internal_api.free analog); the
+        automatic GC normally makes this unnecessary."""
+        for r in refs:
+            self._free_local(r.hex)
+
     # ------------------------------------------------------------------
     # shutdown
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
+        from .refcount import TRACKER, clear_consumer
+
+        self._gc_stop.set()
+        TRACKER.zero_event.set()
+        clear_consumer()
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()

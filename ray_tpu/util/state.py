@@ -57,6 +57,12 @@ def list_actors(
     return out
 
 
+def _ref_count(hex_id: str) -> int:
+    from ray_tpu.core.refcount import TRACKER
+
+    return TRACKER.count(hex_id)
+
+
 def list_objects(
     *, filters: Optional[List[tuple]] = None, limit: int = 1000
 ) -> List[Dict[str, Any]]:
@@ -69,7 +75,7 @@ def list_objects(
             "object_id": hex_id,
             "sealed": entry.event.is_set(),
             "is_error": entry.is_error,
-            "reference_count": entry.local_refs,
+            "reference_count": _ref_count(hex_id),
         }
         if _match(row, filters):
             out.append(row)

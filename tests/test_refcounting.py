@@ -1,0 +1,304 @@
+"""Distributed reference counting + automatic object GC.
+
+The test strategy mirrors the reference's reference-counting tier
+(/root/reference/python/ray/tests/test_reference_counting.py): objects are
+freed when the last handle dies, borrowers keep objects alive, nested refs
+pin their contents, and a bounded store survives a workload far larger than
+its capacity with no manual frees.
+"""
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.object_store import ObjectLostError
+from ray_tpu.core.refcount import TRACKER
+
+
+def _wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# in-process runtime
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def rt():
+    os.environ["RAY_TPU_STORE_BYTES"] = str(32 << 20)  # 32 MiB arena
+    runtime = ray_tpu.init(num_nodes=2, resources_per_node={"CPU": 4})
+    yield runtime
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_STORE_BYTES", None)
+
+
+def test_put_drop_frees_entry(rt):
+    ref = ray_tpu.put(np.arange(1000))
+    hex_id = ref.hex
+    assert TRACKER.count(hex_id) >= 1
+    del ref
+    gc.collect()
+    _wait_for(
+        lambda: hex_id not in rt.store._objects, msg="store entry freed"
+    )
+    assert TRACKER.count(hex_id) == 0
+
+
+def test_task_output_freed_and_lineage_released(rt):
+    @ray_tpu.remote
+    def produce():
+        return np.ones(100)
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref)[0] == 1.0
+    hex_id = ref.hex
+    assert hex_id in rt._lineage
+    del ref
+    gc.collect()
+    _wait_for(lambda: hex_id not in rt.store._objects, msg="output freed")
+    _wait_for(lambda: hex_id not in rt._lineage, msg="lineage released")
+
+
+def test_arg_refs_freed_by_lineage_release(rt):
+    """While `b` lives, its lineage pins arg `a` (reconstruction needs it);
+    dropping `b` releases the lineage, which cascades the free to `a`."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    a = ray_tpu.put(1)
+    b = inc.remote(a)
+    a_hex, b_hex = a.hex, b.hex
+    del a  # lineage of b keeps the value alive
+    assert ray_tpu.get(b) == 2
+    gc.collect()
+    time.sleep(0.2)
+    assert a_hex in rt.store._objects, "lineage should pin the arg"
+    del b
+    gc.collect()
+    _wait_for(lambda: b_hex not in rt.store._objects, msg="output freed")
+    _wait_for(lambda: a_hex not in rt.store._objects, msg="arg freed")
+
+
+def test_unreferenced_before_seal_freed_at_seal(rt):
+    import threading
+
+    gate = threading.Event()
+
+    @ray_tpu.remote
+    def slow():
+        gate.wait(5.0)
+        return np.zeros(64)
+
+    ref = slow.remote()
+    hex_id = ref.hex
+    del ref
+    gc.collect()
+    _wait_for(lambda: TRACKER.count(hex_id) == 0, msg="handle dropped")
+    gate.set()
+    # the seal must observe the drop and free instead of storing
+    _wait_for(
+        lambda: hex_id not in rt.store._objects
+        or rt.store._objects[hex_id].unreferenced,
+        msg="freed at seal",
+    )
+    _wait_for(lambda: hex_id not in rt.store._objects, msg="entry gone")
+
+
+def test_bounded_store_survives_many_large_puts(rt):
+    """10k-object style loop: total bytes written far exceed the arena, no
+    manual frees anywhere (the round-3 'done' criterion)."""
+    chunk = np.zeros(128 * 1024 // 8)  # 128 KiB each
+    for i in range(500):  # ~64 MiB total through a 32 MiB arena
+        ref = ray_tpu.put(chunk + i)
+        if i % 97 == 0:
+            assert ray_tpu.get(ref)[0] == i
+        del ref
+    gc.collect()
+    _wait_for(
+        lambda: rt.store.stats()["num_objects"] < 50, msg="store drained"
+    )
+    if rt.native_store is not None:
+        # the shm arena itself must have been released, not just the table
+        _wait_for(
+            lambda: rt.native_store.stats()["used"] < (8 << 20),
+            msg="arena reclaimed",
+        )
+
+
+def test_manual_free_objects_still_works(rt):
+    ref = ray_tpu.put(np.arange(10))
+    rt.free_objects([ref])
+    assert ref.hex not in rt.store._objects
+
+
+# ---------------------------------------------------------------------------
+# multi-process cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def client(cluster):
+    from ray_tpu.core.runtime import set_runtime
+
+    rt = cluster.client()
+    set_runtime(rt)
+    yield rt
+    rt.shutdown()
+    set_runtime(None)
+
+
+def _directory_has(head, hex_id):
+    return hex_id in head._objects
+
+
+def test_cluster_put_drop_frees_directory_and_store(cluster, client):
+    ref = client.put_object(np.arange(100_000, dtype=np.float32))
+    hex_id = ref.hex
+    assert _directory_has(cluster.head, hex_id)
+    del ref
+    gc.collect()
+    _wait_for(
+        lambda: not _directory_has(cluster.head, hex_id),
+        msg="head directory entry freed",
+    )
+
+
+def test_cluster_task_output_freed(cluster, client):
+    @ray_tpu.remote
+    def produce():
+        return np.ones(50_000, dtype=np.float32)  # big → shm store
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref)[0] == 1.0
+    hex_id = ref.hex
+    del ref
+    gc.collect()
+    _wait_for(
+        lambda: not _directory_has(cluster.head, hex_id),
+        msg="output entry freed",
+    )
+    # lease lineage released too
+    _wait_for(
+        lambda: all(
+            hex_id not in (s.return_ids or []) for s in cluster.head._leases.values()
+        ),
+        msg="lease record dropped",
+    )
+
+
+def test_cluster_get_freed_object_raises(cluster, client):
+    ref = client.put_object(b"x" * 10)
+    hex_id = ref.hex
+    del ref
+    gc.collect()
+    _wait_for(
+        lambda: not _directory_has(cluster.head, hex_id), msg="freed"
+    )
+    from ray_tpu.core.object_store import ObjectRef
+
+    stale = ObjectRef(hex_id)
+    with pytest.raises(ObjectLostError):
+        client.get_object(stale, timeout=5.0)
+
+
+def test_cluster_borrower_keeps_object_alive(cluster, client):
+    """An actor that stores an arg ref becomes a registered borrower: the
+    driver dropping its handle must NOT free the object."""
+
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.ref = None
+
+        def keep(self, box):
+            self.ref = box[0]  # nested ref arrives unresolved
+            return "kept"
+
+        def read(self):
+            return ray_tpu.get(self.ref)[0]
+
+        def drop(self):
+            self.ref = None
+            return "dropped"
+
+    keeper = Keeper.remote()
+    ref = client.put_object(np.full(50_000, 7.0, dtype=np.float32))
+    hex_id = ref.hex
+    assert ray_tpu.get(keeper.keep.remote([ref])) == "kept"
+    del ref
+    gc.collect()
+    time.sleep(0.5)  # give a (wrong) free every chance to happen
+    assert _directory_has(cluster.head, hex_id), "borrowed object was freed"
+    assert ray_tpu.get(keeper.read.remote()) == 7.0
+    # once the borrower drops it, it must be collected
+    assert ray_tpu.get(keeper.drop.remote()) == "dropped"
+    _wait_for(
+        lambda: not _directory_has(cluster.head, hex_id),
+        msg="freed after borrower dropped",
+        timeout=15.0,
+    )
+
+
+def test_cluster_actor_ctor_arg_pinned_for_actor_lifetime(cluster, client):
+    """A restartable actor's ctor args must outlive the creation lease (a
+    restart replays the payload); they free when the actor is DEAD."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, data):
+            self.n = float(np.sum(data))
+
+        def total(self):
+            return self.n
+
+    ref = client.put_object(np.ones(60_000, dtype=np.float32))
+    hex_id = ref.hex
+    h = Holder.options(max_restarts=1).remote(ref)
+    assert ray_tpu.get(h.total.remote()) == 60_000.0
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+    assert _directory_has(cluster.head, hex_id), "ctor arg freed too early"
+    client.kill_actor(h, no_restart=True)
+    _wait_for(
+        lambda: not _directory_has(cluster.head, hex_id),
+        msg="ctor arg freed after actor death",
+        timeout=15.0,
+    )
+
+
+def test_cluster_many_puts_bounded_directory(cluster, client):
+    """Loop of large puts with dropped handles keeps the directory (and the
+    node stores) bounded — no manual frees."""
+    before = len(cluster.head._objects)
+    for i in range(100):
+        ref = client.put_object(np.zeros(64_000, dtype=np.float32))
+        del ref
+    gc.collect()
+    _wait_for(
+        lambda: len(cluster.head._objects) < before + 20,
+        msg="directory bounded",
+        timeout=15.0,
+    )
