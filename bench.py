@@ -1,36 +1,49 @@
-"""Benchmark: TPU-batched cluster scheduling throughput.
+"""Benchmark: TPU-batched cluster scheduling + end-to-end runtime throughput.
 
-Replicates the north-star workload from BASELINE.json: place ~100k pending
-heterogeneous tasks onto a 1k-node simulated cluster with the batched hybrid
-policy kernel (ray_tpu.scheduler.hybrid_schedule_rounds) running on the TPU.
-The reference baseline for scheduling throughput is 594 tasks/s end-to-end on
-a 64x64-core cluster (release/perf_metrics/benchmarks/many_tasks.json —
-end-to-end task throughput, the recorded metric this workload targets;
-its pure decision loop is O(nodes) per task in C++).
+Three tiers, one JSON line:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+1. **Kernel (north star)**: place ~100k pending heterogeneous tasks onto a
+   1k-node simulated cluster with the batched hybrid policy kernel
+   (ray_tpu.scheduler.hybrid) on the TPU — the BASELINE.json workload
+   (reference scoring loop: hybrid_scheduling_policy.cc:96-181, O(nodes)
+   per task in C++). Headline latency is the steady-state **pipelined**
+   per-batch completion interval *including* device→host readback — the
+   operating mode of a resident scheduler streaming decisions to the head
+   (batch k's readback overlaps batch k+1's compute). The cold blocking
+   single-round figure and this environment's fixed tunnel RTT floor are
+   reported alongside.
+2. **End-to-end cluster**: no-op tasks through a real multi-process
+   head→agents→workers cluster, vs the reference's 594.04 tasks/s
+   (release/perf_metrics/benchmarks/many_tasks.json) — the apples-to-apples
+   `vs_baseline`.
+3. **Async actors n:n**: concurrent async actor calls/s vs the reference's
+   22,974.9 `n_n_actor_calls_async` (release/perf_metrics/microbenchmark.json).
 """
 import json
+import os
+import threading
 import time
+from collections import deque
 
 import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from ray_tpu.scheduler.hybrid import (
-    dedupe_shapes,
-    hybrid_schedule_shapes,
-)
-from ray_tpu.scheduler.resources import CPU, MEMORY, OBJECT_STORE_MEMORY, TPU
 
 NUM_NODES = 1024
 NUM_TASKS = 100_000
 TRIALS = 20
 R = 16
 
+BASELINE_E2E_TASKS_PER_S = 594.04  # many_tasks.json (64x64-core cluster)
+BASELINE_NN_ASYNC_CALLS_PER_S = 22_974.9  # microbenchmark.json n_n_actor_calls_async
+
+
+# ---------------------------------------------------------------------------
+# tier 1: the scheduling kernel on the TPU
+# ---------------------------------------------------------------------------
+
 
 def build_cluster(rng):
+    from ray_tpu.scheduler.resources import CPU, MEMORY, OBJECT_STORE_MEMORY, TPU
+
     totals = np.zeros((NUM_NODES, R), dtype=np.float32)
     n_tpu = NUM_NODES // 4
     totals[:, CPU] = 64.0
@@ -46,6 +59,8 @@ def build_cluster(rng):
 
 
 def build_demands(rng):
+    from ray_tpu.scheduler.resources import CPU, MEMORY, TPU
+
     d = np.zeros((NUM_TASKS, R), dtype=np.float32)
     kind = rng.choice(4, NUM_TASKS, p=[0.70, 0.15, 0.10, 0.05])
     d[:, CPU] = np.where(
@@ -56,7 +71,12 @@ def build_demands(rng):
     return d
 
 
-def main():
+def kernel_bench() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.scheduler.hybrid import dedupe_shapes, hybrid_schedule_shapes
+
     rng = np.random.default_rng(0)
     totals_h, avail_h, alive_h = build_cluster(rng)
     demands_h = build_demands(rng)
@@ -101,56 +121,195 @@ def main():
         rtt_samples.append(time.perf_counter() - t0)
     rtt_floor = float(np.median(rtt_samples[1:]))
 
-    e2e_times = []  # including device→host readback of all assignments
+    # cold blocking round: kernel + one synchronous 100k-assignment readback
+    blocking_times = []
+    last_nodes = None
     for i in range(3):
         av = jnp.asarray(avail_h)
         av.block_until_ready()
         t0 = time.perf_counter()
         res = place_all(av, np.uint32(7000 + i))
         # int16 packs 100k assignments into 200KB (node ids < 1024)
-        nodes_h = np.asarray(res.node.astype(jnp.int16))
-        e2e_times.append(time.perf_counter() - t0)
+        last_nodes = np.asarray(res.node.astype(jnp.int16))
+        blocking_times.append(time.perf_counter() - t0)
 
-    # sustained e2e: pipeline the readbacks (copy_to_host_async) so the
-    # relay latency overlaps the next batch's compute — the steady-state
-    # mode of a resident scheduler streaming decisions back to the head.
-    t0 = time.perf_counter()
-    pending = []
+    # HEADLINE: steady-state pipelined rounds. copy_to_host_async overlaps
+    # batch k's readback with batch k+1's compute; the per-batch completion
+    # interval (incl. readback materialization on host) is what a head
+    # feeding the scheduler continuously observes. Pipeline-fill batches
+    # are excluded from the percentile.
+    DEPTH = 3
+    pending: deque = deque()
+    completions = []
+    t_start = time.perf_counter()
     for i in range(TRIALS):
         res = place_all(avs[i % len(avs)], np.uint32(9000 + i))
         packed = res.node.astype(jnp.int16)
         packed.copy_to_host_async()
         pending.append(packed)
-    pipelined = [np.asarray(p) for p in pending]
-    e2e_pipelined_s = time.perf_counter() - t0
+        if len(pending) > DEPTH:
+            np.asarray(pending.popleft())  # materialize oldest on host
+            completions.append(time.perf_counter())
+    while pending:
+        np.asarray(pending.popleft())
+        completions.append(time.perf_counter())
+    e2e_pipelined_s = time.perf_counter() - t_start
+    intervals = np.diff(np.asarray(completions))
+    steady = intervals[DEPTH:] if intervals.shape[0] > DEPTH + 2 else intervals
+    p50_steady_e2e = float(np.percentile(steady, 50))
     e2e_placements_per_s = NUM_TASKS * TRIALS / e2e_pipelined_s
 
-    placed = int((pipelined[-1] >= 0).sum())
+    # placed fraction + why the remainder is unplaced: after the round, an
+    # unplaced task is *infeasible* if no node's remaining availability fits
+    # its demand (here the workload's 5k TPU-chip demand exceeds the
+    # cluster's 1024 chips by design — a capacity-limited tail, not a kernel
+    # miss). Verify that claim mechanically.
+    placed_mask = last_nodes >= 0
+    placed = int(placed_mask.sum())
+    unplaced_shapes = demands_h[~placed_mask]
+    # remaining availability after the blocking round
+    avail_after = avail_h.copy()
+    np.add.at(avail_after, last_nodes[placed_mask], -demands_h[placed_mask])
+    fits_somewhere = (
+        (avail_after[None, :, :] >= unplaced_shapes[:, None, :] - 1e-6)
+        .all(axis=2)
+        .any(axis=1)
+        if unplaced_shapes.shape[0]
+        else np.zeros(0, dtype=bool)
+    )
+    unplaced_feasible = int(fits_somewhere.sum())
+
     p50 = float(np.percentile(times, 50))
-    # sustained throughput over TRIALS consecutive 100k-task batches
     placements_per_s = NUM_TASKS * TRIALS / sum(times)
-    baseline = 594.04  # tasks/s, reference many_tasks end-to-end
-    e2e_p50 = float(np.percentile(e2e_times, 50))
+    return {
+        "sched_placements_per_s": round(placements_per_s, 1),
+        "p50_ms_100k_tasks_1k_nodes": round(p50 * 1e3, 3),
+        # headline: steady-state per-batch latency including host readback
+        "p50_ms_incl_host_readback": round(p50_steady_e2e * 1e3, 2),
+        "p50_ms_blocking_round_incl_readback": round(
+            float(np.percentile(blocking_times, 50)) * 1e3, 2
+        ),
+        # fixed per-fetch relay RTT of this tunneled environment (what a
+        # co-located host would not pay; the pipelined mode amortizes it):
+        "env_readback_floor_ms": round(rtt_floor * 1e3, 2),
+        "e2e_pipelined_placements_per_s": round(e2e_placements_per_s, 1),
+        "placed_fraction": round(placed / NUM_TASKS, 4),
+        # 0 ⇒ every unplaced task is capacity-infeasible (no node fits it)
+        "unplaced_still_feasible": unplaced_feasible,
+        "north_star_p50_ms": 50.0,
+        "device": str(jax.devices()[0]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# tier 2: end-to-end multi-process cluster (many_tasks analog)
+# ---------------------------------------------------------------------------
+
+
+def _noop():
+    return None
+
+
+def cluster_bench(num_tasks: int = 10_000) -> dict:
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 16.0}, num_workers=4)
+    c.add_node({"CPU": 16.0}, num_workers=4)
+    client = c.client()
+    set_runtime(client)
+    try:
+        f = ray_tpu.remote(_noop).options(num_cpus=0.25, max_retries=0)
+        # warmup: worker pool spin-up + code-path compile
+        ray_tpu.get([f.remote() for _ in range(50)], timeout=60)
+
+        t0 = time.perf_counter()
+        refs = [f.remote() for _ in range(num_tasks)]
+        for i in range(0, num_tasks, 500):
+            ray_tpu.get(refs[i : i + 500], timeout=300)
+        elapsed = time.perf_counter() - t0
+        tasks_per_s = num_tasks / elapsed
+
+        # tier 3: n:n async actor calls (n_n_actor_calls_async analog)
+        @ray_tpu.remote
+        class Echo:
+            async def ping(self, v):
+                return v
+
+        N, CALLS = 4, 400
+        actors = [Echo.remote() for _ in range(N)]
+        # touch each actor once so creation cost is outside the timed region
+        ray_tpu.get([a.ping.remote(0) for a in actors], timeout=60)
+        results = [None] * N
+
+        def drive(idx):
+            a = actors[idx]
+            rs = [a.ping.remote(i) for i in range(CALLS)]
+            ray_tpu.get(rs, timeout=300)
+            results[idx] = True
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=drive, args=(i,)) for i in range(N)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        async_elapsed = time.perf_counter() - t0
+        assert all(results)
+        async_calls_per_s = N * CALLS / async_elapsed
+        return {
+            "cluster_tasks_per_s": round(tasks_per_s, 1),
+            "cluster_num_tasks": num_tasks,
+            "async_actor_calls_per_s": round(async_calls_per_s, 1),
+            "async_vs_baseline": round(
+                async_calls_per_s / BASELINE_NN_ASYNC_CALLS_PER_S, 3
+            ),
+        }
+    finally:
+        set_runtime(None)
+        client.shutdown()
+        c.shutdown()
+
+
+def main():
+    out = {}
+    kernel = kernel_bench()
+    try:
+        cluster = cluster_bench(
+            int(os.environ.get("RAY_TPU_BENCH_E2E_TASKS", 10_000))
+        )
+    except Exception as exc:  # noqa: BLE001 - kernel numbers still publish
+        cluster = {"cluster_error": repr(exc)}
+    out.update(kernel)
+    out.update(cluster)
+    tasks_per_s = cluster.get("cluster_tasks_per_s")
     print(
         json.dumps(
             {
-                "metric": "sched_placements_per_s",
-                "value": round(placements_per_s, 1),
-                "unit": "placements/s",
-                "vs_baseline": round(placements_per_s / baseline, 2),
-                "p50_ms_100k_tasks_1k_nodes": round(p50 * 1e3, 3),
-                "p50_ms_incl_host_readback": round(e2e_p50 * 1e3, 2),
-                # fixed per-fetch relay RTT of this tunneled environment
-                # (what a co-located host would not pay):
-                "env_readback_floor_ms": round(rtt_floor * 1e3, 2),
-                "p50_ms_e2e_minus_env_floor": round(
-                    max(e2e_p50 - rtt_floor, 0.0) * 1e3, 2
+                # headline: the apples-to-apples end-to-end number (the
+                # reference's many_tasks tasks/s), NOT the kernel ratio
+                "metric": "cluster_tasks_per_s",
+                "value": tasks_per_s if tasks_per_s is not None else -1.0,
+                "unit": "tasks/s",
+                "vs_baseline": round(
+                    (tasks_per_s or 0.0) / BASELINE_E2E_TASKS_PER_S, 3
                 ),
-                # steady-state e2e with readback pipelined over compute
-                "e2e_pipelined_placements_per_s": round(e2e_placements_per_s, 1),
-                "placed_fraction": round(placed / NUM_TASKS, 4),
-                "device": str(jax.devices()[0]),
-                "north_star_p50_ms": 50.0,
+                "e2e_baseline_tasks_per_s": BASELINE_E2E_TASKS_PER_S,
+                # context: the reference numbers come from 64-node x 64-core
+                # clusters / 64-vCPU hosts; this whole cluster (head, agents,
+                # workers, driver) shares the cores below
+                "bench_host_cpu_cores": os.cpu_count(),
+                # on-device kernel throughput over the reference's e2e
+                # number is apples-to-oranges; published only under this
+                # explicit name (round-2 advisor finding)
+                "kernel_vs_e2e_baseline": round(
+                    out["sched_placements_per_s"] / BASELINE_E2E_TASKS_PER_S, 2
+                ),
+                **out,
             }
         )
     )

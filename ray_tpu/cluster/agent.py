@@ -19,6 +19,7 @@ import sys
 import tempfile
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -120,14 +121,21 @@ class NodeAgent:
         # --- RPC surface ---
         handlers = {
             "ExecuteLease": self._h_execute_lease,
+            "ExecuteLeaseBatch": self._h_execute_lease_batch,
             "StoreObject": self._h_store_object,
             "FetchObject": self._h_fetch_object,
+            "FetchObjectBatch": lambda r: [
+                self.store.get_bytes(oid) for oid in r["object_ids"]
+            ],
             "DeleteObjects": self._h_delete_objects,
             "GetObjectForWorker": self._h_get_object_for_worker,
             "WorkerPut": self._h_worker_put,
             "WorkerSealed": self._h_worker_sealed,
             "RegisterWorker": self._h_register_worker,
             "TaskDone": self._h_task_done,
+            "TaskDoneBatch": lambda reqs: [
+                self._h_task_done(r) for r in reqs
+            ],
             "RefUpdate": self._h_ref_update,
             "PrepareBundles": self._h_prepare_bundles,
             "CommitBundles": self._h_commit_bundles,
@@ -155,6 +163,11 @@ class NodeAgent:
         # async-actor methods accepted by a worker, completion pending
         # (worker reports via TaskDone): task_id -> (spec, worker handle)
         self._async_pending: Dict[str, tuple] = {}
+        # TaskDone replies that arrived before their PushTask reply did
+        self._early_task_done: Dict[str, dict] = {}
+        # per-async-actor push coalescing (see _drain_async_methods)
+        self._async_buf: Dict[str, deque] = {}
+        self._async_draining: set = set()
         self._num_workers = num_workers
         for _ in range(num_workers):
             self._spawn_worker()
@@ -169,6 +182,19 @@ class NodeAgent:
             max_workers=num_workers + 32,
             thread_name_prefix=f"agent-{self.node_id[:6]}",
         )
+
+        # coalescing completion/seal reporter (see _reporter_loop)
+        self._report_queue: List[Dict[str, Any]] = []
+        self._report_cv = threading.Condition()
+        threading.Thread(
+            target=self._reporter_loop, name="agent-reporter", daemon=True
+        ).start()
+        # plain-task batch dispatcher (see _task_drain_loop)
+        self._task_buf: deque = deque()
+        self._task_cv = threading.Condition()
+        threading.Thread(
+            target=self._task_drain_loop, name="agent-task-drain", daemon=True
+        ).start()
 
         reply = self.head.call(
             "RegisterNode",
@@ -278,6 +304,17 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # lease admission + execution
     # ------------------------------------------------------------------
+    def _h_execute_lease_batch(self, specs: List[LeaseRequest]) -> dict:
+        """Batched grant-or-reject admission: one RPC per scheduling round
+        per node instead of one per lease (the reference amortizes this with
+        lease pipelining, normal_task_submitter pipelining; a batched
+        scheduler makes the whole round one message)."""
+        statuses = [self._h_execute_lease(s)["status"] for s in specs]
+        out: Dict[str, Any] = {"statuses": statuses}
+        if "reject" in statuses:
+            out["available"] = self.ledger.avail_map()
+        return out
+
     def _h_execute_lease(self, spec: LeaseRequest) -> dict:
         req = ResourceRequest.from_map(self.vocab, spec.resources)
         if spec.kind == "actor_method":
@@ -291,10 +328,17 @@ class NodeAgent:
                     }
                 if spec.actor_id in self._async_actors:
                     # asyncio actor: methods multiplex on the worker's event
-                    # loop — no FIFO, no per-worker serialization
-                    self._exec_pool.submit(
-                        self._run_on_worker, spec, handle, None, False
+                    # loop — no FIFO. Pushes coalesce per actor: everything
+                    # queued while the previous PushTaskBatch was in flight
+                    # rides the next one.
+                    self._async_buf.setdefault(spec.actor_id, deque()).append(
+                        spec
                     )
+                    if spec.actor_id not in self._async_draining:
+                        self._async_draining.add(spec.actor_id)
+                        self._exec_pool.submit(
+                            self._drain_async_methods, spec.actor_id
+                        )
                     return {"status": "granted"}
                 # per-actor FIFO: the pool must not reorder method calls
                 fifo = self._actor_fifo.setdefault(spec.actor_id, [])
@@ -313,8 +357,135 @@ class NodeAgent:
         else:
             # stale head view → reject with the authoritative snapshot
             return {"status": "reject", "available": self.ledger.avail_map()}
-        self._exec_pool.submit(self._dispatch_to_worker, spec, alloc)
+        if spec.kind == "actor_creation":
+            # pins its worker for life — dispatched individually
+            self._exec_pool.submit(self._dispatch_to_worker, spec, alloc)
+        else:
+            # plain tasks queue for the batching drainer: one PushTaskBatch
+            # RPC carries several tasks to one worker (amortizes the
+            # per-push round trip the way the reference pipelines leases)
+            with self._task_cv:
+                self._task_buf.append((spec, alloc))
+                self._task_cv.notify()
         return {"status": "granted"}
+
+    PUSH_BATCH = 8
+
+    def _task_drain_loop(self) -> None:
+        """Single drainer: pairs queued plain tasks with idle workers in
+        batches (worker_pool dispatch loop analog, batched)."""
+        while not self._shutdown:
+            with self._task_cv:
+                while not self._task_buf and not self._shutdown:
+                    self._task_cv.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+            handle = self._pop_idle_worker()
+            with self._idle_cv:
+                spare_workers = len(self._idle)
+            with self._task_cv:
+                # spread across idle workers first (process parallelism for
+                # CPU-bound tasks); batch multiple per worker only when
+                # tasks outnumber workers — the regime where the per-push
+                # RPC amortization matters
+                buffered = len(self._task_buf)
+                per_worker = -(-buffered // (spare_workers + 1))  # ceil
+                n = min(buffered, max(1, per_worker), self.PUSH_BATCH)
+                items = [self._task_buf.popleft() for _ in range(n)]
+            if handle is None:
+                for spec, alloc in items:
+                    self._release(alloc)
+                    self._report_to_head(
+                        {
+                            "node_id": self.node_id,
+                            "failed": [
+                                {
+                                    "task_id": spec.task_id,
+                                    "reason": "no worker available",
+                                    "retryable": True,
+                                }
+                            ],
+                        }
+                    )
+                continue
+            if not items:
+                self._return_worker(handle)
+                continue
+            self._exec_pool.submit(self._run_batch_on_worker, items, handle)
+
+    def _run_batch_on_worker(self, items, handle: _WorkerHandle) -> None:
+        reqs = [self._push_req(spec) for spec, _ in items]
+        try:
+            with handle.lock:
+                replies = handle.client.call(
+                    "PushTaskBatch", reqs, timeout=None
+                )
+        except RpcError:
+            for _, alloc in items:
+                self._release(alloc)
+            if not self._shutdown:
+                self._on_worker_death(handle, [s for s, _ in items])
+            return
+        for (spec, alloc), reply in zip(items, replies):
+            self._finish_worker_reply(
+                spec, handle, alloc, reply, return_worker=False
+            )
+        self._return_worker(handle)
+
+    def _drain_async_methods(self, actor_id: str) -> None:
+        """Single-flight batch pusher for one async actor's methods."""
+        while True:
+            with self._lock:
+                buf = self._async_buf.get(actor_id)
+                if not buf:
+                    self._async_draining.discard(actor_id)
+                    return
+                specs = []
+                while buf and len(specs) < 64:
+                    specs.append(buf.popleft())
+                worker_id = self._actor_workers.get(actor_id)
+                handle = self._workers.get(worker_id) if worker_id else None
+            if handle is None:
+                self._report_to_head(
+                    {
+                        "node_id": self.node_id,
+                        "failed": [
+                            {
+                                "task_id": s.task_id,
+                                "reason": "actor worker is gone",
+                                "retryable": False,
+                            }
+                            for s in specs
+                        ],
+                    }
+                )
+                continue
+            try:
+                replies = handle.client.call(
+                    "PushTaskBatch",
+                    [self._push_req(s) for s in specs],
+                    timeout=None,
+                )
+            except RpcError:
+                # clear the single-flight flag or the restarted actor's
+                # methods would buffer forever with no drainer
+                with self._lock:
+                    self._async_draining.discard(actor_id)
+                if not self._shutdown:
+                    self._on_worker_death(handle, specs)
+                return
+            for s, reply in zip(specs, replies):
+                if reply.get("status") == "async_pending":
+                    with self._lock:
+                        early = self._early_task_done.pop(s.task_id, None)
+                        if early is None:
+                            self._async_pending[s.task_id] = (s, handle)
+                    if early is not None:
+                        self._finish_worker_reply(s, handle, None, early)
+                else:
+                    self._finish_worker_reply(
+                        s, handle, None, reply, return_worker=False
+                    )
 
     def _drain_actor_fifo(self, actor_id: str) -> None:
         while True:
@@ -371,6 +542,22 @@ class NodeAgent:
                 self._spawn_worker()
         self._run_on_worker(spec, handle, alloc)
 
+    def _push_req(self, spec: LeaseRequest) -> dict:
+        return {
+            "task_id": spec.task_id,
+            "kind": spec.kind,
+            "actor_id": spec.actor_id,
+            "payload": spec.payload,
+            "return_ids": spec.return_ids,
+            "arg_ids": spec.arg_ids,
+            "name": spec.name,
+            "runtime_env": spec.runtime_env,
+            "actor_meta": spec.actor_meta,
+            "retry_exceptions": (
+                spec.retry_exceptions and spec.attempt < spec.max_retries
+            ),
+        }
+
     def _run_on_worker(
         self, spec: LeaseRequest, handle: _WorkerHandle, alloc, serialize: bool = True
     ) -> None:
@@ -382,23 +569,7 @@ class NodeAgent:
         try:
             with guard:  # per-worker ordering (actor sequential exec)
                 reply = handle.client.call(
-                    "PushTask",
-                    {
-                        "task_id": spec.task_id,
-                        "kind": spec.kind,
-                        "actor_id": spec.actor_id,
-                        "payload": spec.payload,
-                        "return_ids": spec.return_ids,
-                        "arg_ids": spec.arg_ids,
-                        "name": spec.name,
-                        "runtime_env": spec.runtime_env,
-                        "actor_meta": spec.actor_meta,
-                        "retry_exceptions": (
-                            spec.retry_exceptions
-                            and spec.attempt < spec.max_retries
-                        ),
-                    },
-                    timeout=None,
+                    "PushTask", self._push_req(spec), timeout=None
                 )
         except RpcError:
             self._release(alloc)
@@ -407,9 +578,16 @@ class NodeAgent:
             return
         if reply.get("status") == "async_pending":
             # the worker accepted the method onto its event loop and will
-            # deliver the outcome via TaskDone — free this thread now
+            # deliver the outcome via TaskDone — free this thread now.
+            # A fast coroutine's TaskDone can BEAT this reply back to the
+            # agent (two independent RPC paths); it parks in
+            # _early_task_done and is consumed here.
             with self._lock:
-                self._async_pending[spec.task_id] = (spec, handle)
+                early = self._early_task_done.pop(spec.task_id, None)
+                if early is None:
+                    self._async_pending[spec.task_id] = (spec, handle)
+            if early is not None:
+                self._finish_worker_reply(spec, handle, None, early)
             return
         self._finish_worker_reply(spec, handle, alloc, reply)
 
@@ -417,13 +595,22 @@ class NodeAgent:
         """Completion callback for async-actor methods (worker → agent)."""
         with self._lock:
             entry = self._async_pending.pop(req["task_id"], None)
-        if entry is None:
-            return  # already failed via worker death
+            if entry is None:
+                # outran the worker's own PushTask reply: stash for the
+                # dispatch thread (see _run_on_worker). Worker-death entries
+                # land here too and are dropped with the handle.
+                self._early_task_done[req["task_id"]] = req["reply"]
+                return
         spec, handle = entry
         self._finish_worker_reply(spec, handle, None, req["reply"])
 
     def _finish_worker_reply(
-        self, spec: LeaseRequest, handle: _WorkerHandle, alloc, reply: dict
+        self,
+        spec: LeaseRequest,
+        handle: _WorkerHandle,
+        alloc,
+        reply: dict,
+        return_worker: bool = True,
     ) -> None:
         status = reply.get("status")
         if spec.kind == "actor_creation" and status == "ok":
@@ -470,10 +657,12 @@ class NodeAgent:
                         "reason": reply.get("error_repr", "init failed"),
                     }
                 ]
-        if spec.kind != "actor_method" and spec.kind != "actor_creation":
+        if (
+            return_worker
+            and spec.kind != "actor_method"
+            and spec.kind != "actor_creation"
+        ):
             self._return_worker(handle)
-        elif spec.kind == "actor_method":
-            pass  # pinned worker stays with the actor
         self._report_to_head(report)
 
     def _release(self, alloc) -> None:
@@ -665,13 +854,42 @@ class NodeAgent:
             return client
 
     # ------------------------------------------------------------------
-    # reporting (RaySyncer RESOURCE_VIEW analog)
+    # reporting (RaySyncer RESOURCE_VIEW analog). Reports are coalesced
+    # opportunistically: an idle reporter sends immediately (no added
+    # latency); under load, everything queued while the previous RPC was in
+    # flight merges into ONE message — the RaySyncer batching that keeps
+    # the head from drowning in per-task RPCs.
     # ------------------------------------------------------------------
     def _report_to_head(self, report: Dict[str, Any]) -> None:
-        try:
-            self.head.call("ReportSeals", report, timeout=10.0)
-        except RpcError:
-            logger.warning("head unreachable; dropping report")
+        with self._report_cv:
+            self._report_queue.append(report)
+            self._report_cv.notify()
+
+    @staticmethod
+    def _merge_reports(reports: List[Dict[str, Any]]) -> Dict[str, Any]:
+        merged: Dict[str, Any] = {}
+        for r in reports:
+            for k, v in r.items():
+                if isinstance(v, list):
+                    merged.setdefault(k, []).extend(v)
+                else:
+                    merged[k] = v  # node_id fixed; "available" latest wins
+        return merged
+
+    def _reporter_loop(self) -> None:
+        while True:
+            with self._report_cv:
+                while not self._report_queue and not self._shutdown:
+                    self._report_cv.wait(timeout=0.5)
+                if self._shutdown and not self._report_queue:
+                    return
+                batch = self._report_queue
+                self._report_queue = []
+            report = self._merge_reports(batch)
+            try:
+                self.head.call("ReportSeals", report, timeout=10.0)
+            except RpcError:
+                logger.warning("head unreachable; dropping report")
 
     def _report_loop(self) -> None:
         version = 0
@@ -720,6 +938,7 @@ class NodeAgent:
         self._actor_workers.pop(actor_id, None)
         self._actor_meta.pop(actor_id, None)
         self._async_actors.discard(actor_id)
+        self._async_buf.pop(actor_id, None)
         self._release(self._actor_allocs.pop(actor_id, None))
 
     def _h_kill_actor(self, req: dict) -> None:
@@ -742,6 +961,10 @@ class NodeAgent:
         self._shutdown = True
         with self._idle_cv:
             self._idle_cv.notify_all()
+        with self._report_cv:
+            self._report_cv.notify_all()
+        with self._task_cv:
+            self._task_cv.notify_all()
         for handle in list(self._workers.values()):
             try:
                 handle.proc.terminate()

@@ -17,6 +17,7 @@ import sys
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -153,6 +154,99 @@ class _RemoteMethod:
         )
 
 
+class _PipelinedSender:
+    """Client→head submission pipeline (the reference's task-submission
+    pipelining, core_worker/task_submission/normal_task_submitter.h): lease
+    submissions and refcount updates ride ONE ordered queue, coalesced into
+    ``ClientBatch`` RPCs. An idle sender ships immediately (no added
+    latency); under load everything queued while the previous RPC was in
+    flight merges into one message. Ordering between a submission that
+    registers return-id holders and a later release of those ids is
+    preserved by construction."""
+
+    MAX_BATCH = 512
+
+    def __init__(self, client: RpcClient):
+        self._client = client
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._enqueued = 0
+        self._acked = 0
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="lease-pipeline", daemon=True
+        )
+        self._thread.start()
+
+    def enqueue(self, kind: str, payload: Any, wait: bool = False) -> None:
+        with self._cv:
+            if self._stop:
+                return
+            self._q.append((kind, payload))
+            self._enqueued += 1
+            ticket = self._enqueued
+            self._cv.notify_all()
+        if wait:
+            with self._cv:
+                while self._acked < ticket and not self._stop:
+                    self._cv.wait(timeout=0.5)
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.cluster.client")
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if not self._q:
+                    if self._stop:
+                        return
+                    continue
+                n = min(len(self._q), self.MAX_BATCH)
+                batch = [self._q.popleft() for _ in range(n)]
+            delivered = False
+            while not delivered:
+                try:
+                    self._client.call(
+                        "ClientBatch",
+                        batch,
+                        timeout=60.0,
+                        retries=8,
+                        retry_interval=0.25,
+                    )
+                    delivered = True
+                except RpcError:
+                    # a dropped lease would strand its caller's get()
+                    # forever and a dropped release leaks the object —
+                    # keep the batch and retry until the head comes back
+                    # (or this runtime shuts down)
+                    with self._cv:
+                        if self._stop:
+                            return
+                    log.warning(
+                        "head unreachable; retrying %d control items",
+                        len(batch),
+                    )
+                    time.sleep(0.5)
+            with self._cv:
+                self._acked += len(batch)
+                self._cv.notify_all()
+
+    def drain(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            target = self._enqueued
+            while self._acked < target and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.2)
+
+    def stop(self) -> None:
+        self.drain()
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+
 class RemoteRuntime:
     """Duck-typed Runtime whose backend is a live cluster."""
 
@@ -173,19 +267,20 @@ class RemoteRuntime:
         from ray_tpu.core import refcount
 
         self.client_id = refcount.get_holder_id()
+        # dedicated channel for the pipeline: its traffic during a head
+        # outage must not push the main channel into gRPC reconnect backoff
+        self._pipe_chan = RpcClient(address)
+        self._sender = _PipelinedSender(self._pipe_chan)
         incumbent = refcount.current_consumer()
         if isinstance(incumbent, refcount.RefFlusher):
             self._flusher = incumbent
             self._owns_flusher = False
         else:
-            # dedicated channel: flusher sends during a head outage must not
-            # push the main channel into gRPC reconnect backoff
-            self._ref_chan = RpcClient(address)
             self._flusher = refcount.RefFlusher(
-                lambda inc, dec: self._ref_chan.call(
-                    "RefUpdate",
+                lambda inc, dec: self._sender.enqueue(
+                    "ref",
                     {"holder": self.client_id, "increfs": inc, "decrefs": dec},
-                    timeout=10.0,
+                    wait=True,
                 ),
                 holder=self.client_id,
             )
@@ -223,7 +318,7 @@ class RemoteRuntime:
             arg_ids=sorted(arg_ids),
             client_id=self.client_id,
         )
-        self.head.call("SubmitLease", lease)
+        self._sender.enqueue("lease", lease)
         self._flusher.note_registered(lease.return_ids)
         return spec.returns
 
@@ -247,7 +342,7 @@ class RemoteRuntime:
             arg_ids=sorted(arg_ids),
             client_id=self.client_id,
         )
-        self.head.call("SubmitLease", lease)
+        self._sender.enqueue("lease", lease)
         self._flusher.note_registered(lease.return_ids)
         return ref
 
@@ -373,6 +468,70 @@ class RemoteRuntime:
             if deadline is not None and time.monotonic() >= deadline:
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
 
+    def get_objects(
+        self, refs: List[ObjectRef], timeout: Optional[float] = None
+    ) -> List[Any]:
+        """Batched list-get: one WaitObjectBatch RPC resolves many refs, and
+        co-located payloads ride one FetchObjectBatch per node (the
+        reference's batched plasma Get, core_worker Get(batch))."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results: Dict[str, tuple] = {}  # hex -> ("val", v) | ("err", exc)
+        order = [r.hex for r in refs]
+        while True:
+            unresolved = list(dict.fromkeys(h for h in order if h not in results))
+            if not unresolved:
+                break
+            poll = 2.0
+            if deadline is not None:
+                poll = min(poll, max(0.0, deadline - time.monotonic()))
+            replies = self._read(
+                "WaitObjectBatch",
+                {"object_ids": unresolved, "timeout": poll},
+                timeout=poll + 30.0,
+            )
+            located: Dict[tuple, List[str]] = {}
+            for h, rep in zip(unresolved, replies):
+                status = rep["status"]
+                if status == "inline":
+                    results[h] = ("val", self._loads_tracking(rep["data"]))
+                elif status == "error":
+                    results[h] = ("err", pickle.loads(rep["error"]))
+                elif status == "located":
+                    located.setdefault(tuple(rep["locations"][0]), []).append(h)
+            for (nid, addr), hs in located.items():
+                try:
+                    datas = self._agent(nid, addr).call(
+                        "FetchObjectBatch", {"object_ids": hs}, timeout=120.0
+                    )
+                    for h, d in zip(hs, datas):
+                        results[h] = ("val", self._loads_tracking(d))
+                except (RpcError, KeyError):
+                    # stale location/partial store: per-ref fallback path
+                    for h in hs:
+                        try:
+                            remaining = None
+                            if deadline is not None:
+                                remaining = max(0.0, deadline - time.monotonic())
+                            results[h] = (
+                                "val",
+                                self.get_object(ObjectRef(h), remaining),
+                            )
+                        except BaseException as exc:  # noqa: BLE001
+                            results[h] = ("err", exc)
+            if deadline is not None and time.monotonic() >= deadline:
+                missing = [h for h in order if h not in results]
+                if missing:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for {len(missing)} objects"
+                    )
+        out = []
+        for h in order:
+            kind, v = results[h]
+            if kind == "err":
+                raise v
+            out.append(v)
+        return out
+
     def free_objects(self, refs: List[ObjectRef]) -> None:
         self.head.call("FreeObjects", {"object_ids": [r.hex for r in refs]})
 
@@ -466,7 +625,8 @@ class RemoteRuntime:
             # free driver-owned objects (job-exit cleanup analog)
             self._flusher.stop(release_all=True)
             refcount.clear_consumer(self._flusher)
-            self._ref_chan.close()
+        self._sender.stop()
+        self._pipe_chan.close()
         self.head.close()
         with self._lock:
             for client in self._agents.values():

@@ -183,8 +183,10 @@ class HeadServer:
             "NodeReport": self._h_node_report,
             "ReportSeals": self._h_report_seals,
             "SubmitLease": self._h_submit_lease,
+            "ClientBatch": self._h_client_batch,
             "PutObject": self._h_put_object,
             "WaitObject": self._h_wait_object,
+            "WaitObjectBatch": self._h_wait_object_batch,
             "FreeObjects": self._h_free_objects,
             "RefUpdate": self._h_ref_update,
             "CreateActor": self._h_create_actor,
@@ -542,10 +544,16 @@ class HeadServer:
 
     def _apply_seals(self, seals: List[SealInfo]) -> None:
         check: List[str] = []
+        stale: List[Tuple[str, str]] = []  # (node_id, object_id)
         with self._cond:
             for s in seals:
                 if s.object_id in self._freed:
-                    continue  # every handle died before the seal landed
+                    # every handle died before this seal/re-advertisement
+                    # landed: the advertising node's copy must still be
+                    # deleted or its shm leaks
+                    if not s.is_error and s.node_id:
+                        stale.append((s.node_id, s.object_id))
+                    continue
                 e = self._objects.setdefault(s.object_id, _ObjEntry())
                 if s.is_error:
                     e.error = s.error
@@ -563,6 +571,15 @@ class HeadServer:
                 e.event.set()
                 check.append(s.object_id)
             self._cond.notify_all()
+        for nid, oid in stale:
+            client = self._clients.get(nid)
+            if client is not None:
+                self._dispatch_pool.submit(
+                    _best_effort,
+                    client.call,
+                    "DeleteObjects",
+                    {"object_ids": [oid]},
+                )
         # a seal may land after the last holder left: free immediately
         self._maybe_free_many(check)
 
@@ -676,39 +693,77 @@ class HeadServer:
         e.event.set()
         return {"where": "inline"}
 
+    def _freed_reply(self, object_id: str) -> dict:
+        from ray_tpu.core.object_store import ObjectLostError
+
+        return {
+            "status": "error",
+            "error": pickle.dumps(
+                ObjectLostError(
+                    f"object {object_id} was freed (all references "
+                    "dropped or explicitly freed)"
+                )
+            ),
+        }
+
+    def _sealed_reply(self, e: _ObjEntry) -> dict:
+        """Reply for a sealed entry. Caller holds self._lock."""
+        if e.error is not None:
+            return {"status": "error", "error": e.error}
+        if e.inline is not None:
+            return {"status": "inline", "data": e.inline}
+        locs = [
+            (nid, self.nodes[nid].address)
+            for nid in e.locations
+            if nid in self.nodes and self.nodes[nid].alive
+        ]
+        if not locs:
+            return {"status": "pending"}  # recovery in progress
+        return {"status": "located", "locations": locs}
+
     def _h_wait_object(self, req: dict) -> dict:
         """Long-poll for availability (pubsub long-poll analog,
         src/ray/pubsub/)."""
         if req["object_id"] in self._freed:
-            from ray_tpu.core.object_store import ObjectLostError
-
-            return {
-                "status": "error",
-                "error": pickle.dumps(
-                    ObjectLostError(
-                        f"object {req['object_id']} was freed (all references "
-                        "dropped or explicitly freed)"
-                    )
-                ),
-            }
+            return self._freed_reply(req["object_id"])
         e = self._entry(req["object_id"])
         t = req.get("timeout")
         timeout = min(2.0 if t is None else t, 10.0)
         if not e.event.wait(timeout):
             return {"status": "pending"}
-        if e.error is not None:
-            return {"status": "error", "error": e.error}
-        if e.inline is not None:
-            return {"status": "inline", "data": e.inline}
         with self._lock:
-            locs = [
-                (nid, self.nodes[nid].address)
-                for nid in e.locations
-                if nid in self.nodes and self.nodes[nid].alive
-            ]
-        if not locs:
-            return {"status": "pending"}  # recovery in progress
-        return {"status": "located", "locations": locs}
+            return self._sealed_reply(e)
+
+    def _h_wait_object_batch(self, req: dict) -> List[dict]:
+        """Batched long-poll: resolve many object ids in one RPC (the
+        client's list-get path — one message instead of one per ref,
+        matching the reference's batched plasma Get)."""
+        ids = req["object_ids"]
+        t = req.get("timeout")
+        deadline = time.monotonic() + min(2.0 if t is None else t, 10.0)
+        replies: Dict[str, dict] = {}
+        with self._cond:
+            while True:
+                for oid in ids:
+                    if oid in replies and replies[oid]["status"] != "pending":
+                        continue
+                    if oid in self._freed:
+                        replies[oid] = self._freed_reply(oid)
+                        continue
+                    e = self._objects.setdefault(oid, _ObjEntry())
+                    if e.event.is_set():
+                        replies[oid] = self._sealed_reply(e)
+                    else:
+                        replies[oid] = {"status": "pending"}
+                unresolved = sum(
+                    1 for r in replies.values() if r["status"] == "pending"
+                )
+                now = time.monotonic()
+                if not unresolved or now >= deadline:
+                    break
+                # seals notify _cond (_apply_seals), so this wakes promptly
+                self._cond.wait(timeout=min(0.25, deadline - now))
+        return [replies[oid] for oid in ids]
 
     def _h_free_objects(self, req: dict) -> None:
         """Manual force-free (internal_api.free analog): zero the holder
@@ -726,6 +781,10 @@ class HeadServer:
                         hx.discard(oid)
                 e.holders.clear()
                 e.pins = 0
+                # an explicit free overrides the untracked-entry GC
+                # exemption (entries whose refcount state predates a head
+                # restart are still force-freeable)
+                e.tracked = True
         self._maybe_free_many(ids)
 
     # ------------------------------------------------------------------
@@ -760,9 +819,12 @@ class HeadServer:
                     continue
                 self._add_holder(oid, holder)
             for oid in req.get("decrefs", ()):
-                e = self._objects.get(oid)
-                if e is None:
+                if oid in self._freed:
                     continue
+                # a decref can overtake its matching registration across
+                # channels (worker decref via agent vs pipelined lease):
+                # record the negative so the late registration nets to zero
+                e = self._objects.setdefault(oid, _ObjEntry())
                 c = e.holders.get(holder, 0) - 1
                 if c == 0:
                     e.holders.pop(holder, None)
@@ -890,6 +952,15 @@ class HeadServer:
         self.events.record(spec.task_id, spec.name, "SUBMITTED")
         return {"queued": True}
 
+    def _h_client_batch(self, items: List[tuple]) -> None:
+        """Pipelined client control stream: ordered lease submissions +
+        refcount updates coalesced into one RPC (see client._PipelinedSender)."""
+        for kind, payload in items:
+            if kind == "lease":
+                self._h_submit_lease(payload)
+            elif kind == "ref":
+                self._h_ref_update(payload)
+
     @property
     def device_state(self):
         """Lazy DeviceSchedulerState: JAX backend init happens on the first
@@ -998,6 +1069,9 @@ class HeadServer:
                 config=self.hybrid_config,
                 rng=self._rng,
             )
+        # group the round's grants per node: ONE ExecuteLeaseBatch per node
+        # per round instead of one RPC per lease
+        grants: Dict[str, List[LeaseRequest]] = {}
         for (spec, demand), row, ok in zip(sched, rows, granted):
             if row < 0 or not ok:
                 with self._cond:
@@ -1008,7 +1082,55 @@ class HeadServer:
                 # optimistic deduction so later rounds see the placement; the
                 # agent's authoritative report will overwrite the row.
                 self.view.subtract(int(row), demand)
-            self._dispatch(spec, node_id)
+            grants.setdefault(node_id, []).append(spec)
+        for node_id, specs in grants.items():
+            with self._lock:
+                client = self._clients.get(node_id)
+                node = self.nodes.get(node_id)
+                for s in specs:
+                    s.target_node = node_id
+                    self._in_flight[s.task_id] = (s, node_id)
+            if client is None or node is None or not node.alive:
+                with self._cond:
+                    for s in specs:
+                        self._in_flight.pop(s.task_id, None)
+                    self._pending.extend(specs)
+                    self._cond.notify_all()
+                continue
+            self._dispatch_pool.submit(
+                self._dispatch_batch_blocking, specs, node_id, client
+            )
+
+    def _dispatch_batch_blocking(
+        self, specs: List[LeaseRequest], node_id: str, client: RpcClient
+    ) -> None:
+        try:
+            reply = client.call("ExecuteLeaseBatch", specs, timeout=60.0)
+        except RpcError:
+            with self._cond:
+                for s in specs:
+                    self._in_flight.pop(s.task_id, None)
+            for s in specs:
+                self._retry_or_fail(s, f"agent {node_id} unreachable")
+            return
+        rejected = []
+        for s, status in zip(specs, reply["statuses"]):
+            if status == "granted":
+                self.events.record(s.task_id, s.name, "RUNNING", node_id)
+            else:
+                rejected.append(s)
+        if rejected:
+            # stale view: grant-or-reject → spill back to the queue
+            with self._cond:
+                self.metrics["leases_spilled_back"] += len(rejected)
+                for s in rejected:
+                    self._in_flight.pop(s.task_id, None)
+                if reply.get("available") is not None:
+                    node = self.nodes.get(node_id)
+                    if node is not None and node.alive:
+                        self.view.update_available(node_id, reply["available"])
+                self._pending.extend(rejected)
+                self._cond.notify_all()
 
     def _route_constrained(self, spec: LeaseRequest):
         """Actor methods, node affinity, and PG-bound leases bypass the
@@ -1102,14 +1224,56 @@ class HeadServer:
         self._dispatch_pool.submit(self._dispatch_blocking, spec, node_id, client)
 
     def _drain_actor_sends(self, actor_id: str) -> None:
+        """Single-flight per-actor sender. Everything queued while the
+        previous RPC was in flight ships as ONE ordered ExecuteLeaseBatch —
+        submission order is preserved (the reference's sequence-numbered
+        actor queue), but the wire cost amortizes under load."""
         while True:
             with self._lock:
                 q = self._actor_send.get(actor_id)
                 if not q:
                     self._actor_sending.discard(actor_id)
                     return
-                spec, node_id, client = q.popleft()
-            self._dispatch_blocking(spec, node_id, client)
+                items = []
+                while q and len(items) < 128:
+                    items.append(q.popleft())
+            if len(items) == 1:
+                spec, node_id, client = items[0]
+                self._dispatch_blocking(spec, node_id, client)
+                continue
+            # one batch per (node, client) run, preserving order
+            i = 0
+            while i < len(items):
+                j = i
+                client = items[i][2]
+                node_id = items[i][1]
+                while j < len(items) and items[j][2] is client:
+                    j += 1
+                self._dispatch_actor_batch(
+                    [it[0] for it in items[i:j]], node_id, client
+                )
+                i = j
+
+    def _dispatch_actor_batch(
+        self, specs: List[LeaseRequest], node_id: str, client: RpcClient
+    ) -> None:
+        try:
+            reply = client.call("ExecuteLeaseBatch", specs, timeout=60.0)
+        except RpcError:
+            with self._cond:
+                for s in specs:
+                    self._in_flight.pop(s.task_id, None)
+            for s in specs:
+                self._retry_or_fail(s, f"agent {node_id} unreachable")
+            return
+        for s, status in zip(specs, reply["statuses"]):
+            if status == "granted":
+                self.events.record(s.task_id, s.name, "RUNNING", node_id)
+            else:
+                # actor gone on that agent: fail/requeue via the normal path
+                with self._cond:
+                    self._in_flight.pop(s.task_id, None)
+                self._retry_or_fail(s, f"actor lease rejected by {node_id}")
 
     def _dispatch_blocking(
         self, spec: LeaseRequest, node_id: str, client: RpcClient

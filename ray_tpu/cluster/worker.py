@@ -82,6 +82,7 @@ class Worker:
         self._actors: Dict[str, Any] = {}
         self._actor_loops: Dict[str, Any] = {}  # actor_id -> (loop, sems)
         self._env_applied: set = set()
+        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         # seals + TaskDone callbacks for finished async-actor methods run
@@ -89,9 +90,24 @@ class Worker:
         self._done_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="task-done"
         )
+        # completion coalescer: everything finished while the previous
+        # TaskDoneBatch RPC was in flight merges into one message
+        self._done_q: deque = deque()
+        self._done_cv = threading.Condition()
+        threading.Thread(
+            target=self._done_sender_loop, name="task-done-send", daemon=True
+        ).start()
+        # batched pushes execute CONCURRENTLY: two granted leases must both
+        # make progress even if they block on each other (e.g. collective
+        # rendezvous between tasks) — sequential batch execution would
+        # deadlock them.
+        self._batch_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="task-batch"
+        )
         self._server = RpcServer(
             {
                 "PushTask": self._h_push_task,
+                "PushTaskBatch": self._h_push_task_batch,
                 "KillActor": self._h_kill_actor,
                 "Ping": lambda r: "pong",
             },
@@ -279,6 +295,12 @@ class Worker:
             reply["async_actor"] = True
         return reply
 
+    def _h_push_task_batch(self, reqs: List[dict]) -> List[dict]:
+        if len(reqs) == 1:
+            return [self._h_push_task(reqs[0])]
+        futs = [self._batch_pool.submit(self._h_push_task, r) for r in reqs]
+        return [f.result() for f in futs]
+
     def _compute_borrows(self, arg_ids) -> List[str]:
         """Arg refs this process still holds at task completion (stored in
         actor state or a live closure): reported in the completion reply so
@@ -360,15 +382,27 @@ class Worker:
                     reply["borrows"] = borrows
             except BaseException as exc:  # noqa: BLE001 - errors are values
                 reply = self._error_reply(req, exc)
-            self.agent.call(
-                "TaskDone",
-                {"task_id": req["task_id"], "reply": reply},
-                timeout=60.0,
-            )
-        except RpcError:
-            logger.warning("agent unreachable; dropping TaskDone")
+            with self._done_cv:
+                self._done_q.append(
+                    {"task_id": req["task_id"], "reply": reply}
+                )
+                self._done_cv.notify()
         except Exception:  # noqa: BLE001
             logger.exception("async task completion failed")
+
+    def _done_sender_loop(self) -> None:
+        while True:
+            with self._done_cv:
+                while not self._done_q:
+                    self._done_cv.wait(timeout=1.0)
+                batch = list(self._done_q)
+                self._done_q.clear()
+            try:
+                self.agent.call("TaskDoneBatch", batch, timeout=60.0)
+            except RpcError:
+                logger.warning(
+                    "agent unreachable; dropping %d TaskDones", len(batch)
+                )
 
     def _split(self, out: Any, return_ids: List[str]) -> List[Any]:
         if len(return_ids) <= 1:

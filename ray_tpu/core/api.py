@@ -107,6 +107,10 @@ def get(
     rt = get_runtime()
     if isinstance(refs, ObjectRef):
         return rt.get_object(refs, timeout)
+    refs = list(refs)
+    batched = getattr(rt, "get_objects", None)
+    if batched is not None and len(refs) > 1:
+        return batched(refs, timeout)
     return [rt.get_object(r, timeout) for r in refs]
 
 
