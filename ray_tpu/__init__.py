@@ -16,6 +16,7 @@ from ray_tpu.core.api import (  # noqa: F401
     cluster_resources,
     get,
     get_actor,
+    get_runtime_context,
     init,
     is_initialized,
     kill,

@@ -24,6 +24,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.scheduler import ResourceRequest, ResourceVocab
+from ray_tpu.scheduler.instances import NodeAcceleratorState
 from ray_tpu.scheduler.resources import make_ledger
 
 from .common import (
@@ -94,6 +95,9 @@ class NodeAgent:
         self.head = RpcClient(head_address)
         self.vocab = ResourceVocab()
         self.ledger = make_ledger(self.vocab, resources)
+        # chip-index assignment on top of the scalar ledger: granted leases
+        # carry TPU_VISIBLE_CHIPS / CUDA_VISIBLE_DEVICES
+        self.accel = NodeAcceleratorState(resources)
         self.resources = dict(resources)
         self.labels = dict(labels or {})
         self._lock = threading.RLock()
@@ -351,12 +355,20 @@ class NodeAgent:
         if spec.pg_reservation is not None:
             if not self._bundle_allocate(spec.pg_reservation, spec.resources):
                 return {"status": "reject", "available": self.ledger.avail_map()}
-            alloc = ("pg", spec.pg_reservation, dict(spec.resources))
+            scalar_alloc = ("pg", spec.pg_reservation, dict(spec.resources))
         elif self.ledger.try_allocate(req):
-            alloc = ("ledger", req)
+            scalar_alloc = ("ledger", req)
         else:
             # stale head view → reject with the authoritative snapshot
             return {"status": "reject", "available": self.ledger.avail_map()}
+        # chip-index assignment (resource_instance_set.h analog): a
+        # scalar-feasible integer demand always fits; fractional shares can
+        # hit fragmentation → undo the scalar grant and reject
+        assign = self.accel.allocate(spec.resources)
+        if assign is None:
+            self._release(scalar_alloc)
+            return {"status": "reject", "available": self.ledger.avail_map()}
+        alloc = scalar_alloc + (assign,)
         if spec.kind == "actor_creation":
             # pins its worker for life — dispatched individually
             self._exec_pool.submit(self._dispatch_to_worker, spec, alloc)
@@ -414,7 +426,10 @@ class NodeAgent:
             self._exec_pool.submit(self._run_batch_on_worker, items, handle)
 
     def _run_batch_on_worker(self, items, handle: _WorkerHandle) -> None:
-        reqs = [self._push_req(spec) for spec, _ in items]
+        reqs = [
+            self._push_req(spec, self._alloc_env(alloc))
+            for spec, alloc in items
+        ]
         try:
             with handle.lock:
                 replies = handle.client.call(
@@ -542,7 +557,7 @@ class NodeAgent:
                 self._spawn_worker()
         self._run_on_worker(spec, handle, alloc)
 
-    def _push_req(self, spec: LeaseRequest) -> dict:
+    def _push_req(self, spec: LeaseRequest, accel_env=None) -> dict:
         return {
             "task_id": spec.task_id,
             "kind": spec.kind,
@@ -553,10 +568,25 @@ class NodeAgent:
             "name": spec.name,
             "runtime_env": spec.runtime_env,
             "actor_meta": spec.actor_meta,
+            "accel_env": accel_env,
             "retry_exceptions": (
                 spec.retry_exceptions and spec.attempt < spec.max_retries
             ),
         }
+
+    @staticmethod
+    def _alloc_env(alloc):
+        """TPU_VISIBLE_CHIPS / CUDA_VISIBLE_DEVICES for a granted lease."""
+        if alloc is None:
+            return None
+        assign = None
+        if alloc[0] == "ledger" and len(alloc) > 2:
+            assign = alloc[2]
+        elif alloc[0] == "pg" and len(alloc) > 3:
+            assign = alloc[3]
+        if not assign:
+            return None
+        return NodeAcceleratorState.env_for(assign) or None
 
     def _run_on_worker(
         self, spec: LeaseRequest, handle: _WorkerHandle, alloc, serialize: bool = True
@@ -569,7 +599,9 @@ class NodeAgent:
         try:
             with guard:  # per-worker ordering (actor sequential exec)
                 reply = handle.client.call(
-                    "PushTask", self._push_req(spec), timeout=None
+                    "PushTask",
+                    self._push_req(spec, self._alloc_env(alloc)),
+                    timeout=None,
                 )
         except RpcError:
             self._release(alloc)
@@ -670,8 +702,12 @@ class NodeAgent:
             return
         if alloc[0] == "ledger":
             self.ledger.release(alloc[1])
+            if len(alloc) > 2:
+                self.accel.release(alloc[2])
         else:
             self._bundle_release(alloc[1], alloc[2])
+            if len(alloc) > 3:
+                self.accel.release(alloc[3])
 
     # ------------------------------------------------------------------
     # placement-group bundles (PlacementGroupResourceManager analog,

@@ -127,6 +127,8 @@ class HeadServer:
         self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
         self._seed = 0
+        self._spread_rr = 0  # SPREAD round-robin cursor
+        self._label_rr = 0  # label-selector tie-break cursor
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -1009,10 +1011,15 @@ class HeadServer:
     def _schedule_batch(self, batch: List[LeaseRequest]) -> None:
         self.metrics["sched_rounds"] += 1
         kernel_batch: List[LeaseRequest] = []
+        spread_batch: List[LeaseRequest] = []
         for spec in batch:
             routed = self._route_constrained(spec)
             if routed == "kernel":
                 kernel_batch.append(spec)
+            elif routed == "spread":
+                spread_batch.append(spec)
+        if spread_batch:
+            self._schedule_spread(spread_batch)
         if not kernel_batch:
             return
         totals = avail = alive = None
@@ -1083,23 +1090,7 @@ class HeadServer:
                 # agent's authoritative report will overwrite the row.
                 self.view.subtract(int(row), demand)
             grants.setdefault(node_id, []).append(spec)
-        for node_id, specs in grants.items():
-            with self._lock:
-                client = self._clients.get(node_id)
-                node = self.nodes.get(node_id)
-                for s in specs:
-                    s.target_node = node_id
-                    self._in_flight[s.task_id] = (s, node_id)
-            if client is None or node is None or not node.alive:
-                with self._cond:
-                    for s in specs:
-                        self._in_flight.pop(s.task_id, None)
-                    self._pending.extend(specs)
-                    self._cond.notify_all()
-                continue
-            self._dispatch_pool.submit(
-                self._dispatch_batch_blocking, specs, node_id, client
-            )
+        self._send_grants(grants)
 
     def _dispatch_batch_blocking(
         self, specs: List[LeaseRequest], node_id: str, client: RpcClient
@@ -1132,11 +1123,115 @@ class HeadServer:
                 self._pending.extend(rejected)
                 self._cond.notify_all()
 
+    def _schedule_spread(self, specs: List[LeaseRequest]) -> None:
+        """Distinct SPREAD policy: round-robin over feasible alive nodes
+        (spread_scheduling_policy.cc:26 analog), vectorized over the batch
+        with in-batch deductions so one round can't stack one node."""
+        with self._lock:
+            t0, a0, al0 = self.view.active_arrays()
+            totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
+            node_ids = [
+                self.view.node_id(i) for i in range(self.view.num_nodes)
+            ]
+        n = len(node_ids)
+        if n == 0 or not alive.any():
+            with self._cond:
+                self._infeasible.extend(specs)
+            return
+        r = totals.shape[1]
+        reqs = [
+            ResourceRequest.from_map(self.vocab, s.resources) for s in specs
+        ]
+        # demands naming a resource no node has ever reported are
+        # unplaceable until the cluster changes (same guard as the kernel)
+        sched: List[Tuple[LeaseRequest, np.ndarray]] = []
+        with self._cond:
+            for spec, req in zip(specs, reqs):
+                if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                    self._infeasible.append(spec)
+                else:
+                    sched.append((spec, req.dense(r)))
+        if not sched:
+            return
+        specs = [s for s, _ in sched]
+        demands = np.stack([d for _, d in sched])
+        grants: Dict[str, List[LeaseRequest]] = {}
+        order = np.arange(n)
+        for i, spec in enumerate(specs):
+            feasible = (avail >= demands[i]).all(axis=1) & alive
+            rot = np.roll(order, -self._spread_rr)
+            cand = rot[feasible[rot]]
+            if cand.size == 0:
+                with self._cond:
+                    self._infeasible.append(spec)
+                continue
+            row = int(cand[0])
+            self._spread_rr = (row + 1) % n
+            avail[row] -= demands[i]
+            with self._lock:
+                self.view.subtract(row, demands[i])
+            grants.setdefault(node_ids[row], []).append(spec)
+        self._send_grants(grants)
+
+    def _send_grants(self, grants: Dict[str, List[LeaseRequest]]) -> None:
+        for node_id, specs in grants.items():
+            with self._lock:
+                client = self._clients.get(node_id)
+                node = self.nodes.get(node_id)
+                for s in specs:
+                    s.target_node = node_id
+                    self._in_flight[s.task_id] = (s, node_id)
+            if client is None or node is None or not node.alive:
+                with self._cond:
+                    for s in specs:
+                        self._in_flight.pop(s.task_id, None)
+                    self._pending.extend(specs)
+                    self._cond.notify_all()
+                continue
+            self._dispatch_pool.submit(
+                self._dispatch_batch_blocking, specs, node_id, client
+            )
+
+    def _pick_labeled_node(self, strat, resources) -> Optional[str]:
+        """Label-selector placement (node_label_scheduling_policy.cc
+        analog): hard selectors filter, resource feasibility filters
+        (the reference policy only considers feasible labeled nodes),
+        soft selectors prefer; ties go round-robin."""
+        from ray_tpu.scheduler.labels import match_labels
+
+        req = ResourceRequest.from_map(self.vocab, resources)
+        with self._lock:
+            r = self.view.totals.shape[1]
+            if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                return None  # unknown resource: no node can fit it yet
+            d = req.dense(r)
+            avail = self.view.active_arrays()[1]
+            hard = [
+                nid
+                for nid, node in self.nodes.items()
+                if node.alive
+                and match_labels(node.labels, strat.hard)
+                and (avail[self.view.row_of(nid)] >= d).all()
+            ]
+            preferred = [
+                nid
+                for nid in hard
+                if match_labels(self.nodes[nid].labels, strat.soft)
+            ]
+        pool = preferred or hard
+        if not pool:
+            return None
+        self._label_rr += 1
+        return pool[self._label_rr % len(pool)]
+
     def _route_constrained(self, spec: LeaseRequest):
-        """Actor methods, node affinity, and PG-bound leases bypass the
-        kernel (composite policy dispatch, composite_scheduling_policy.cc)."""
+        """Actor methods, node affinity, label selectors, and PG-bound
+        leases bypass the kernel (composite policy dispatch,
+        composite_scheduling_policy.cc); SPREAD gets its own round-robin
+        pass."""
         from ray_tpu.core.scheduling_strategies import (
             NodeAffinitySchedulingStrategy,
+            NodeLabelSchedulingStrategy,
             PlacementGroupSchedulingStrategy,
         )
 
@@ -1155,6 +1250,19 @@ class HeadServer:
             self._dispatch(spec, info.node_id)
             return "done"
         strat = spec.strategy
+        if strat == "SPREAD":
+            return "spread"
+        if isinstance(strat, NodeLabelSchedulingStrategy):
+            node_id = self._pick_labeled_node(strat, spec.resources)
+            if node_id is None:
+                if strat.hard:
+                    # no labeled node yet — parked until membership changes
+                    with self._cond:
+                        self._infeasible.append(spec)
+                    return "done"
+                return "kernel"  # soft-only: any node will do
+            self._dispatch(spec, node_id)
+            return "done"
         if isinstance(strat, NodeAffinitySchedulingStrategy):
             node = self.nodes.get(strat.node_id)
             if node is not None and node.alive:

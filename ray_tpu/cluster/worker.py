@@ -219,8 +219,17 @@ class Worker:
     def _h_push_task(self, req: dict) -> dict:
         kind = req["kind"]
         self._set_context(req)
+        accel_env = req.get("accel_env")
         try:
             self._apply_runtime_env(req.get("runtime_env"))
+            if accel_env:
+                # the granted lease's chip assignment: TPU_VISIBLE_CHIPS /
+                # CUDA_VISIBLE_DEVICES (accelerators/tpu.py:38-56 analog).
+                # For an actor creation this persists for the pinned
+                # worker's lifetime — the actor owns those chips. For plain
+                # tasks it is removed again below: a reused pooled worker
+                # must not leak one lease's chips into the next.
+                os.environ.update(accel_env)
             if kind == "actor_creation":
                 cls, args, kwargs = cloudpickle.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
@@ -281,6 +290,9 @@ class Worker:
         except BaseException as exc:  # noqa: BLE001 - errors are values
             return self._error_reply(req, exc)
         finally:
+            if accel_env and kind != "actor_creation":
+                for k in accel_env:
+                    os.environ.pop(k, None)
             self._clear_context()
         seals = [
             self.put_value(oid, v)

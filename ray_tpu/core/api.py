@@ -140,11 +140,14 @@ def kill(actor_handle, *, no_restart: bool = True) -> None:
     state = actor_handle._actor_state
     state.mark_died(restart=not no_restart)
     if state._held_req is not None:
-        node_id, req = state._held_req
+        node_id, req, assign = state._held_req
         node = rt.nodes.get(node_id)
         if node is not None and node.alive:
-            node.ledger.release(req)
-            rt.view.update_available(node_id, node.ledger.avail_map())
+            if req is not None:  # None for PG actors: the bundle held it
+                node.ledger.release(req)
+                rt.view.update_available(node_id, node.ledger.avail_map())
+            if assign and node.accel:
+                node.accel.release(assign)
         state._held_req = None
     rt.notify_resources_changed()
 
@@ -164,6 +167,45 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
                     err = TaskError(RuntimeError("task cancelled"), spec.name)
                     for rid in spec.return_ids:  # seal every sibling return
                         rt._seal_id(None, rid, err, True)
+
+
+class RuntimeContext:
+    """Per-task/actor execution context (ray.get_runtime_context parity,
+    python/ray/runtime_context.py). Accelerator ids come from the granted
+    lease's chip assignment — in cluster workers via the exported
+    TPU_VISIBLE_CHIPS / CUDA_VISIBLE_DEVICES env vars."""
+
+    def __init__(self, node_id, task_id, actor_id, accelerator_ids):
+        self.node_id = node_id
+        self.task_id = task_id
+        self.actor_id = actor_id
+        self._accelerator_ids = accelerator_ids
+
+    def get_node_id(self):
+        return self.node_id
+
+    def get_task_id(self):
+        return self.task_id
+
+    def get_actor_id(self):
+        return self.actor_id
+
+    def get_accelerator_ids(self) -> Dict[str, List[str]]:
+        return {k: [str(i) for i in v] for k, v in self._accelerator_ids.items()}
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu.scheduler.instances import ACCELERATOR_ENV_VARS
+
+    ctx = get_context()
+    accel = dict(getattr(ctx, "accelerator_ids", None) or {})
+    if not accel:
+        # cluster worker: assignment arrives as exported env vars
+        for name, var in ACCELERATOR_ENV_VARS.items():
+            val = os.environ.get(var)
+            if val:
+                accel[name] = val.split(",")
+    return RuntimeContext(ctx.node_id, ctx.task_id, ctx.actor_id, accel)
 
 
 def nodes() -> List[Dict[str, Any]]:

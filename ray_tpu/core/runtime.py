@@ -96,6 +96,7 @@ class Node:
     alive: bool = True
     running_tasks: Dict[str, TaskSpec] = field(default_factory=dict)
     objects: set = field(default_factory=set)  # hex ids sealed on this node
+    accel: Any = None  # NodeAcceleratorState: chip-index assignment
 
 
 class _GcConsumer:
@@ -112,6 +113,7 @@ class WorkerContext(threading.local):
     node_id: Optional[str] = None
     task_id: Optional[str] = None
     actor_id: Optional[str] = None
+    accelerator_ids: Dict[str, list] = {}
 
 
 _context = WorkerContext()
@@ -157,6 +159,8 @@ class Runtime:
         self._device_state = None  # built lazily: keeps init() off the XLA path
         self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
+        self._spread_rr = 0  # SPREAD round-robin cursor
+        self._label_rr = 0  # label-selector tie-break cursor
         self._seed_counter = itertools.count(1)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -252,6 +256,8 @@ class Runtime:
         resources: Dict[str, float],
         labels: Optional[Dict[str, str]] = None,
     ) -> str:
+        from ray_tpu.scheduler.instances import NodeAcceleratorState
+
         node_id = uuid.uuid4().hex[:16]
         num_workers = max(1, int(resources.get("CPU", 1)))
         node = Node(
@@ -261,6 +267,7 @@ class Runtime:
                 max_workers=num_workers, thread_name_prefix=f"worker-{node_id[:6]}"
             ),
             labels=dict(labels or {}),
+            accel=NodeAcceleratorState(resources),
         )
         with self._cond:
             self.nodes[node_id] = node
@@ -547,17 +554,74 @@ class Runtime:
 
     _SENTINEL = object()
 
+    def _pick_spread_node(self, spec: TaskSpec) -> Optional[str]:
+        """Distinct SPREAD: round-robin over feasible alive nodes
+        (spread_scheduling_policy.cc:26 analog)."""
+        req = ResourceRequest.from_map(self.vocab, spec.resources)
+        with self._lock:
+            avail, alive = self.view.active_arrays()[1:]
+            n = self.view.num_nodes
+            r = avail.shape[1] if n else 0
+            if n == 0 or any(
+                c >= r and fp > 0 for c, fp in req.demands.items()
+            ):
+                return None  # no nodes / unknown resource: park infeasible
+            d = req.dense(r)
+            feasible = (avail >= d).all(axis=1) & alive
+            order = np.roll(np.arange(n), -self._spread_rr)
+            cand = order[feasible[order]]
+            if cand.size == 0:
+                return None
+            row = int(cand[0])
+            self._spread_rr = (row + 1) % n
+            return self.view.node_id(row)
+
+    def _pick_labeled_node(self, strat, resources) -> Optional[str]:
+        """Label-selector placement (node_label_scheduling_policy.cc
+        analog): hard selectors + resource feasibility filter, soft
+        selectors prefer, ties round-robin."""
+        from ray_tpu.scheduler.labels import match_labels
+
+        req = ResourceRequest.from_map(self.vocab, resources)
+        with self._lock:
+            hard = [
+                n.node_id
+                for n in self.nodes.values()
+                if n.alive
+                and match_labels(n.labels, strat.hard)
+                and n.ledger.is_available(req)
+            ]
+            preferred = [
+                nid
+                for nid in hard
+                if match_labels(self.nodes[nid].labels, strat.soft)
+            ]
+        pool = preferred or hard
+        if not pool:
+            return None
+        self._label_rr += 1
+        return pool[self._label_rr % len(pool)]
+
     def _strategy_target(self, spec: TaskSpec):
         """Resolve scheduling strategies. Returns _HYBRID, None (infeasible
         now), or (node_id, via_pg) to dispatch directly."""
         from .scheduling_strategies import (
             NodeAffinitySchedulingStrategy,
+            NodeLabelSchedulingStrategy,
             PlacementGroupSchedulingStrategy,
         )
 
         strat = spec.strategy
-        if strat is None or strat == "DEFAULT" or strat == "SPREAD":
+        if strat is None or strat == "DEFAULT":
             return _HYBRID
+        if strat == "SPREAD":
+            target = self._pick_spread_node(spec)
+            return None if target is None else (target, None)
+        if isinstance(strat, NodeLabelSchedulingStrategy):
+            target = self._pick_labeled_node(strat, spec.resources)
+            if target is None:
+                return None if strat.hard else _HYBRID
+            return (target, None)
         if isinstance(strat, NodeAffinitySchedulingStrategy):
             node = self.nodes.get(strat.node_id)
             if node is not None and node.alive:
@@ -611,30 +675,56 @@ class Runtime:
             self.view.update_available(node_id, node.ledger.avail_map())
             self._enqueue(spec)
             return
+        # chip-index assignment on top of the scalar grant
+        assign = node.accel.allocate(spec.resources) if node.accel else {}
+        if assign is None:  # fractional-share fragmentation
+            if via_pg is not None:
+                pg.release(bundle_idx, req)
+            else:
+                node.ledger.release(req)
+            self._park_infeasible(spec)
+            return
         if via_pg is None:
             self.view.update_available(node_id, node.ledger.avail_map())
         node.running_tasks[spec.task_id] = spec
         self.events.record(spec.task_id, spec.name, "SCHEDULED", node.node_id)
-        node.pool.submit(self._execute, spec, node, req, via_pg)
+        node.pool.submit(self._execute, spec, node, req, via_pg, assign)
 
     # ------------------------------------------------------------------
     # execution (TaskReceiver analog)
     # ------------------------------------------------------------------
     def _execute(
-        self, spec: TaskSpec, node: Node, req: ResourceRequest, via_pg: Optional[tuple]
+        self,
+        spec: TaskSpec,
+        node: Node,
+        req: ResourceRequest,
+        via_pg: Optional[tuple],
+        assign: Optional[dict] = None,
     ) -> None:
         _context.node_id = node.node_id
         _context.task_id = spec.task_id
         _context.actor_id = spec.actor_id
+        _context.accelerator_ids = {
+            name: [i for i, _ in a] for name, a in (assign or {}).items()
+        }
         actor_holds_resources = False
+        assign_held = False
         self.events.record(spec.task_id, spec.name, "RUNNING", node.node_id)
         try:
             args, kwargs = self._resolve_args(spec.args, spec.kwargs)
             result = spec.func(*args, **kwargs)
             if spec.kind == "actor_creation":
                 state = self._actors[spec.actor_id]
-                state.on_created(node.node_id, result, (node.node_id, req))
+                # the actor keeps its chip assignment for life even when the
+                # scalar resources came from a PG bundle (the bundle is
+                # released at creation end, the silicon is not)
+                state.on_created(
+                    node.node_id,
+                    result,
+                    (node.node_id, None if via_pg else req, assign),
+                )
                 actor_holds_resources = via_pg is None
+                assign_held = True
                 self._seal_results(spec, node, spec.actor_id)
             else:
                 self._seal_results(spec, node, result)
@@ -670,9 +760,13 @@ class Runtime:
                 pg = self._pgs.get(pg_id)
                 if pg is not None:
                     pg.release(bundle_idx, req)
+                if assign and node.accel and not assign_held:
+                    node.accel.release(assign)
                 self.notify_resources_changed()
             else:
                 node.ledger.release(req)
+                if assign and node.accel and not assign_held:
+                    node.accel.release(assign)
                 with self._cond:
                     self.view.update_available(node.node_id, node.ledger.avail_map())
                     # freed capacity may unblock queued/infeasible leases
@@ -683,6 +777,7 @@ class Runtime:
             _context.node_id = None
             _context.task_id = None
             _context.actor_id = None
+            _context.accelerator_ids = {}
 
     # ------------------------------------------------------------------
     # actor creation (GcsActorScheduler analog)
