@@ -103,20 +103,36 @@ class NodeAgent:
         self._lock = threading.RLock()
         self._shutdown = False
 
-        # --- object store (plasma-in-raylet analog) ---
+        # --- object store (plasma-in-raylet analog), wrapped with LRU
+        # disk spill + restore so a full arena backpressures to disk
+        # instead of erroring (eviction_policy.h / local_object_manager.h)
+        # paths carry the pid: a node id can be reused across cluster
+        # incarnations (tests, restarts), and a lingering agent from an old
+        # incarnation must never share an arena or spill dir with a new one
         self.store_path = os.path.join(
-            tempfile.gettempdir(), f"ray_tpu_store_{self.node_id}.shm"
+            tempfile.gettempdir(),
+            f"ray_tpu_store_{self.node_id}_{os.getpid()}.shm",
         )
         try:
             from ray_tpu.native import NativeObjectStore
 
-            self.store = NativeObjectStore(
+            inner = NativeObjectStore(
                 path=self.store_path, capacity=store_capacity
             )
         except Exception:  # noqa: BLE001 - toolchain missing
             logger.warning("native store unavailable; using in-memory store")
-            self.store = _MemStore()
+            inner = _MemStore()
             self.store_path = ""
+        from ray_tpu.native.spill import SpillingStore
+
+        self.store = SpillingStore(
+            inner,
+            spill_dir=os.path.join(
+                tempfile.gettempdir(),
+                f"ray_tpu_spill_{self.node_id}_{os.getpid()}",
+            ),
+            capacity=store_capacity,
+        )
 
         # --- bundle (placement group) reservations ---
         # pg_id -> {"state": prepared|committed, "bundles": {idx: avail_map}}
@@ -147,6 +163,7 @@ class NodeAgent:
             "ReturnBundles": self._h_return_bundles,
             "KillActor": self._h_kill_actor,
             "Shutdown": self._h_shutdown,
+            "DebugState": self._h_debug_state,
             "Ping": lambda r: "pong",
         }
         self._server = RpcServer(handlers, host=host, port=0)
@@ -198,6 +215,15 @@ class NodeAgent:
         self._task_cv = threading.Condition()
         threading.Thread(
             target=self._task_drain_loop, name="agent-task-drain", daemon=True
+        ).start()
+        # dependency-waiting leases (see _dep_loop)
+        self._dep_waiting: Dict[str, tuple] = {}  # task_id -> (spec, missing)
+        self._dep_cv = threading.Condition()
+        # ids fetchable from the head without store locality (inline/error)
+        self._dep_ready_ids: set = set()
+        self._pulls_in_flight: set = set()
+        threading.Thread(
+            target=self._dep_loop, name="agent-deps", daemon=True
         ).start()
 
         reply = self.head.call(
@@ -352,6 +378,12 @@ class NodeAgent:
                 self._actor_draining.add(spec.actor_id)
             self._exec_pool.submit(self._drain_actor_fifo, spec.actor_id)
             return {"status": "granted"}
+        if spec.kind == "task" and spec.deps and not self._args_ready(spec):
+            # dependency-aware dispatch: wait for args BEFORE taking
+            # resources or a worker (lease_dependency_manager.h:41-53) —
+            # a ready lease interleaves past this one
+            self._park_for_deps(spec)
+            return {"status": "granted"}
         if spec.pg_reservation is not None:
             if not self._bundle_allocate(spec.pg_reservation, spec.resources):
                 return {"status": "reject", "available": self.ledger.avail_map()}
@@ -380,6 +412,173 @@ class NodeAgent:
                 self._task_buf.append((spec, alloc))
                 self._task_cv.notify()
         return {"status": "granted"}
+
+    # ------------------------------------------------------------------
+    # dependency-aware dispatch (LeaseDependencyManager analog,
+    # raylet/lease_dependency_manager.h:41-53): a lease whose args are not
+    # yet fetchable waits here WITHOUT resources or a worker — a ready
+    # lease interleaves past it. Missing remote args are prefetched into
+    # the local store while waiting (pull-before-grant, the reference's
+    # "args ready → lease dispatchable" contract).
+    # ------------------------------------------------------------------
+    def _args_ready(self, spec: LeaseRequest) -> bool:
+        """True if every TOP-LEVEL arg is local, inline-fetchable, or
+        errored (the worker can resolve all of them without blocking).
+        Nested refs never gate dispatch — a task may be the very thing that
+        unblocks the object a nested ref names."""
+        for oid in spec.deps:
+            if not self.store.contains(oid) and oid not in self._dep_ready_ids:
+                return False
+        return True
+
+    def _park_for_deps(self, spec: LeaseRequest) -> None:
+        missing = [
+            oid
+            for oid in spec.deps
+            if not self.store.contains(oid) and oid not in self._dep_ready_ids
+        ]
+        with self._dep_cv:
+            self._dep_waiting[spec.task_id] = (spec, set(missing))
+            self._dep_cv.notify()
+
+    def _dep_loop(self) -> None:
+        """Resolve waiting leases: one batched head query per tick covers
+        every missing arg; sealed-remote args trigger background pulls."""
+        while not self._shutdown:
+            if len(self._dep_ready_ids) > (1 << 16):
+                self._dep_ready_ids.clear()  # cache, not ground truth
+            with self._dep_cv:
+                if not self._dep_waiting:
+                    self._dep_cv.wait(timeout=0.5)
+                    continue
+                missing_all = sorted(
+                    {o for _, m in self._dep_waiting.values() for o in m}
+                )
+            statuses: Dict[str, str] = {}
+            unseen = [o for o in missing_all if not self.store.contains(o)]
+            for o in missing_all:
+                if o not in unseen:
+                    statuses[o] = "local"
+            if unseen:
+                try:
+                    replies = self.head.call(
+                        "WaitObjectBatch",
+                        {"object_ids": unseen, "timeout": 0.25},
+                        timeout=15.0,
+                    )
+                except RpcError:
+                    time.sleep(0.2)
+                    continue
+                for oid, rep in zip(unseen, replies):
+                    st = rep["status"]
+                    statuses[oid] = st
+                    if st in ("inline", "error"):
+                        # fetchable from the head without blocking
+                        self._dep_ready_ids.add(oid)
+                    elif st == "located":
+                        self._prefetch(oid, rep["locations"])
+            ready: List[LeaseRequest] = []
+            with self._dep_cv:
+                for tid in list(self._dep_waiting):
+                    spec, missing = self._dep_waiting[tid]
+                    missing.difference_update(
+                        o
+                        for o in list(missing)
+                        if statuses.get(o) in ("local", "inline", "error")
+                        or self.store.contains(o)
+                        or o in self._dep_ready_ids
+                    )
+                    if not missing:
+                        del self._dep_waiting[tid]
+                        ready.append(spec)
+            for spec in ready:
+                self._admit_ready(spec)
+
+    def _prefetch(self, oid: str, locations) -> None:
+        """Background pull of a sealed remote object into the local store
+        (pull_manager.h:40 analog), deduped while in flight."""
+        with self._lock:
+            if oid in self._pulls_in_flight:
+                return
+            self._pulls_in_flight.add(oid)
+
+        def pull() -> None:
+            try:
+                for nid, addr in locations:
+                    if nid == self.node_id or self.store.contains(oid):
+                        return
+                    try:
+                        data = self._peer(nid, addr).call(
+                            "FetchObject", {"object_id": oid}, timeout=60.0
+                        )
+                    except (RpcError, KeyError):
+                        continue
+                    try:
+                        self.store.put_bytes(oid, data)
+                        self._report_to_head(
+                            {
+                                "node_id": self.node_id,
+                                "seals": [
+                                    SealInfo(
+                                        object_id=oid,
+                                        node_id=self.node_id,
+                                        size=len(data),
+                                    )
+                                ],
+                            }
+                        )
+                    except Exception:  # noqa: BLE001 - arena full
+                        self._dep_ready_ids.add(oid)  # worker pulls inline
+                    return
+            finally:
+                with self._lock:
+                    self._pulls_in_flight.discard(oid)
+                with self._dep_cv:
+                    self._dep_cv.notify()
+
+        self._exec_pool.submit(pull)
+
+    def _admit_ready(self, spec: LeaseRequest) -> None:
+        """Args are ready: NOW allocate resources + chips and queue for a
+        worker; allocation failure spills back to the head (the resources
+        went to leases that ran while this one waited)."""
+        req = ResourceRequest.from_map(self.vocab, spec.resources)
+        if spec.pg_reservation is not None:
+            if not self._bundle_allocate(spec.pg_reservation, spec.resources):
+                self._spillback(spec, "pg bundle busy after dep wait")
+                return
+            scalar_alloc = ("pg", spec.pg_reservation, dict(spec.resources))
+        elif self.ledger.try_allocate(req):
+            scalar_alloc = ("ledger", req)
+        else:
+            self._spillback(spec, "resources busy after dep wait")
+            return
+        assign = self.accel.allocate(spec.resources)
+        if assign is None:
+            self._release(scalar_alloc)
+            self._spillback(spec, "chips busy after dep wait")
+            return
+        with self._task_cv:
+            self._task_buf.append((spec, scalar_alloc + (assign,)))
+            self._task_cv.notify()
+
+    def _spillback(self, spec: LeaseRequest, reason: str) -> None:
+        # requeue=True: pure resource contention must NOT burn the task's
+        # retry budget (the grant path's "reject" has the same semantics)
+        self._report_to_head(
+            {
+                "node_id": self.node_id,
+                "available": self.ledger.avail_map(),
+                "failed": [
+                    {
+                        "task_id": spec.task_id,
+                        "reason": reason,
+                        "retryable": True,
+                        "requeue": True,
+                    }
+                ],
+            }
+        )
 
     PUSH_BATCH = 8
 
@@ -441,11 +640,22 @@ class NodeAgent:
             if not self._shutdown:
                 self._on_worker_death(handle, [s for s, _ in items])
             return
-        for (spec, alloc), reply in zip(items, replies):
-            self._finish_worker_reply(
-                spec, handle, alloc, reply, return_worker=False
-            )
-        self._return_worker(handle)
+        except BaseException:  # noqa: BLE001 - remote exception shipped back
+            # a handler-level failure must not strand the leases with their
+            # resources held and the worker never returned to the pool
+            logger.exception("PushTaskBatch failed; requeueing %d", len(items))
+            for spec, alloc in items:
+                self._release(alloc)
+                self._spillback(spec, "worker push failed")
+            self._return_worker(handle)
+            return
+        try:
+            for (spec, alloc), reply in zip(items, replies):
+                self._finish_worker_reply(
+                    spec, handle, alloc, reply, return_worker=False
+                )
+        finally:
+            self._return_worker(handle)
 
     def _drain_async_methods(self, actor_id: str) -> None:
         """Single-flight batch pusher for one async actor's methods."""
@@ -489,6 +699,11 @@ class NodeAgent:
                 if not self._shutdown:
                     self._on_worker_death(handle, specs)
                 return
+            except BaseException:  # noqa: BLE001 - shipped remote exception
+                logger.exception("async PushTaskBatch failed; requeueing")
+                for s in specs:
+                    self._spillback(s, "worker push failed")
+                continue
             for s, reply in zip(specs, replies):
                 if reply.get("status") == "async_pending":
                     with self._lock:
@@ -608,6 +823,13 @@ class NodeAgent:
             if not self._shutdown:
                 self._on_worker_death(handle, [spec])
             return
+        except BaseException:  # noqa: BLE001 - remote exception shipped back
+            logger.exception("PushTask failed for %s; requeueing", spec.name)
+            self._release(alloc)
+            self._spillback(spec, "worker push failed")
+            if spec.kind == "task":
+                self._return_worker(handle)
+            return
         if reply.get("status") == "async_pending":
             # the worker accepted the method onto its event loop and will
             # deliver the outcome via TaskDone — free this thread now.
@@ -674,6 +896,7 @@ class NodeAgent:
             ]
         else:
             report["seals"] = reply.get("seals", [])
+            self._note_seals(report["seals"])
             if spec.kind == "actor_creation" and status == "ok":
                 report["actors_alive"] = [
                     {
@@ -785,6 +1008,11 @@ class NodeAgent:
         return self.store.get_bytes(req["object_id"])
 
     def _h_delete_objects(self, req: dict) -> None:
+        logger.debug(
+            "DeleteObjects: %d ids (%s...)",
+            len(req["object_ids"]),
+            ",".join(o[:8] for o in req["object_ids"][:4]),
+        )
         for oid in req["object_ids"]:
             try:
                 self.store.delete(oid)
@@ -800,8 +1028,20 @@ class NodeAgent:
         the head is the refcount authority)."""
         self.head.call("RefUpdate", req, timeout=10.0)
 
+    def _note_seals(self, seals) -> None:
+        """Workers seal big objects straight into the shared arena;
+        register them in the spill LRU book."""
+        for s in seals:
+            if (
+                not s.is_error
+                and s.inline_value is None
+                and s.node_id == self.node_id
+            ):
+                self.store.note_external(s.object_id, s.size)
+
     def _h_worker_sealed(self, req: dict) -> None:
         """Out-of-band seal from a worker (ray_tpu.put inside a task)."""
+        self._note_seals(req["seals"])
         self._report_to_head(
             {"node_id": self.node_id, "seals": req["seals"]}
         )
@@ -861,9 +1101,11 @@ class NodeAgent:
         return {"status": "timeout"}
 
     def _local_reply(self, oid: str) -> dict:
-        """Workers read 'local' objects straight from the shm arena; with the
-        in-memory fallback store (no shared pages) ship the bytes inline."""
-        if self.store_path:
+        """Workers read 'local' objects straight from the shm arena; a
+        spilled object is restored into the arena first (restore path); if
+        it can't fit back, or with the in-memory fallback store (no shared
+        pages), ship the bytes inline."""
+        if self.store_path and self.store.restore_to_arena(oid):
             return {"status": "local"}
         return {"status": "inline", "data": self.store.get_bytes(oid)}
 
@@ -927,8 +1169,14 @@ class NodeAgent:
             except RpcError:
                 logger.warning("head unreachable; dropping report")
 
+    # an orphaned agent (its head gone for good, e.g. a crashed test
+    # driver) must not linger holding ports/arena/spill space forever; a
+    # restarting head recovers in seconds, so a long grace is safe
+    ORPHAN_TIMEOUT_S = 120.0
+
     def _report_loop(self) -> None:
         version = 0
+        last_head_contact = time.monotonic()
         while not self._shutdown:
             time.sleep(REPORT_PERIOD_S)
             version += 1
@@ -952,12 +1200,23 @@ class NodeAgent:
                     ),
                     timeout=5.0,
                 )
+                last_head_contact = time.monotonic()
                 if not reply.get("alive", True):
                     # a transient heartbeat gap (or a head restart) got us
                     # declared dead/unknown — rejoin with our live actors.
                     logger.warning("head declared us dead; re-registering")
                     self.head.call("RegisterNode", self._node_info(), timeout=5.0)
             except RpcError:
+                if (
+                    time.monotonic() - last_head_contact
+                    > self.ORPHAN_TIMEOUT_S
+                ):
+                    logger.warning(
+                        "head unreachable for %.0fs; agent exiting",
+                        self.ORPHAN_TIMEOUT_S,
+                    )
+                    threading.Thread(target=self.shutdown, daemon=True).start()
+                    return
                 continue
             except Exception:  # noqa: BLE001
                 # One bad reply (e.g. a head-side handler bug re-raised over
@@ -990,6 +1249,22 @@ class NodeAgent:
             if not self._shutdown:
                 self._spawn_worker()
 
+    def _h_debug_state(self, req=None) -> dict:
+        """Operator/debugging introspection (node_manager DebugString
+        analog, node_manager.cc HandleGetNodeStats)."""
+        with self._lock:
+            return {
+                "task_buf": [s.task_id for s, _ in self._task_buf],
+                "dep_waiting": {
+                    t: sorted(m) for t, (s, m) in self._dep_waiting.items()
+                },
+                "async_pending": sorted(self._async_pending),
+                "idle_workers": list(self._idle),
+                "num_workers": len(self._workers),
+                "available": self.ledger.avail_map(),
+                "store": self.store.stats(),
+            }
+
     def _h_shutdown(self, req=None) -> None:
         threading.Thread(target=self.shutdown, daemon=True).start()
 
@@ -1001,6 +1276,8 @@ class NodeAgent:
             self._report_cv.notify_all()
         with self._task_cv:
             self._task_cv.notify_all()
+        with self._dep_cv:
+            self._dep_cv.notify_all()
         for handle in list(self._workers.values()):
             try:
                 handle.proc.terminate()
@@ -1024,6 +1301,7 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
     parser.add_argument("--labels", default="{}")
     parser.add_argument("--num-workers", type=int, default=None)
     parser.add_argument("--node-id", default=None)
+    parser.add_argument("--store-capacity", type=int, default=1 << 28)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     agent = NodeAgent(
@@ -1031,6 +1309,7 @@ def main() -> None:  # pragma: no cover - exercised via subprocess in tests
         resources=json.loads(args.resources),
         labels=json.loads(args.labels),
         num_workers=args.num_workers,
+        store_capacity=args.store_capacity,
         node_id=args.node_id,
     )
     print(f"ray_tpu agent {agent.node_id} listening on {agent.address}", flush=True)

@@ -206,8 +206,16 @@ class _PipelinedSender:
                 n = min(len(self._q), self.MAX_BATCH)
                 batch = [self._q.popleft() for _ in range(n)]
             delivered = False
+            attempts = 0
             while not delivered:
                 try:
+                    attempts += 1
+                    if attempts > 1:
+                        log.warning(
+                            "ClientBatch re-send #%d (%d items)",
+                            attempts,
+                            len(batch),
+                        )
                     self._client.call(
                         "ClientBatch",
                         batch,
@@ -304,6 +312,10 @@ class RemoteRuntime:
         _ship_module_by_value(spec.func)
         with collect_serialized() as arg_ids:
             payload = cloudpickle.dumps((spec.func, spec.args, spec.kwargs))
+        deps = [a.hex for a in spec.args if isinstance(a, ObjectRef)]
+        deps += [
+            v.hex for v in spec.kwargs.values() if isinstance(v, ObjectRef)
+        ]
         lease = LeaseRequest(
             task_id=spec.task_id,
             name=spec.name,
@@ -316,6 +328,7 @@ class RemoteRuntime:
             strategy=spec.strategy,
             runtime_env=self.runtime_env,
             arg_ids=sorted(arg_ids),
+            deps=deps,
             client_id=self.client_id,
         )
         self._sender.enqueue("lease", lease)

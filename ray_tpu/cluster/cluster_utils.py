@@ -61,6 +61,7 @@ class Cluster:
         labels: Optional[Dict[str, str]] = None,
         num_workers: int = 2,
         wait: bool = True,
+        store_capacity: int = 1 << 28,
     ) -> str:
         resources = dict(resources or {"CPU": 4.0})
         resources.setdefault("memory", float(4 << 30))
@@ -82,6 +83,8 @@ class Cluster:
                 str(num_workers),
                 "--node-id",
                 node_id,
+                "--store-capacity",
+                str(store_capacity),
             ],
         )
         self._agents[node_id] = proc

@@ -61,9 +61,15 @@ class LeaseRequest:
     # re-describe its actors to a restarted head
     actor_meta: Optional[dict] = None
     # --- distributed refcounting (reference_counter.h analog) ---
-    # every ObjectRef serialized into the payload: the head pins these for
-    # the lease's lifetime (args must outlive dispatch + execution)
+    # every ObjectRef serialized into the payload (nested included): the
+    # head pins these for the lease's lifetime (args must outlive dispatch)
     arg_ids: List[str] = field(default_factory=list)
+    # TOP-LEVEL ObjectRef args only: the set the worker resolves before
+    # running, i.e. what dependency-aware dispatch waits on. Nested refs
+    # reach user code unresolved (reference semantics) and must NOT gate
+    # dispatch — a task may exist precisely to unblock the object a nested
+    # ref points at.
+    deps: List[str] = field(default_factory=list)
     # submitting process's holder id: the initial owner of the return ids
     client_id: str = ""
 

@@ -123,7 +123,9 @@ class HeadServer:
         if use_device_scheduler is None:
             use_device_scheduler = device_scheduler_default()
         self.use_device_scheduler = use_device_scheduler
-        self._device_state = None  # lazy: first scheduling round inits XLA
+        from ray_tpu.scheduler.device import LazyDeviceState
+
+        self._lazy_device = LazyDeviceState(use_device_scheduler)
         self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
         self._seed = 0
@@ -190,7 +192,7 @@ class HeadServer:
             "WaitObject": self._h_wait_object,
             "WaitObjectBatch": self._h_wait_object_batch,
             "FreeObjects": self._h_free_objects,
-            "RefUpdate": self._h_ref_update,
+            "RefUpdate": lambda r: self._h_ref_update(r, src="direct"),
             "CreateActor": self._h_create_actor,
             "GetActor": self._h_get_actor,
             "KillActor": self._h_kill_actor,
@@ -628,6 +630,14 @@ class HeadServer:
             spec = item[0] if item else self._leases.get(fail["task_id"])
             if spec is None:
                 continue
+            if fail.get("requeue"):
+                # contention spillback: back to the queue, no retry burned
+                with self._cond:
+                    self.metrics["leases_spilled_back"] += 1
+                    spec.target_node = None
+                    self._pending.append(spec)
+                    self._cond.notify_all()
+                continue
             if fail.get("retryable", True):
                 self._retry_or_fail(spec, fail.get("reason", "worker failure"))
             else:
@@ -808,7 +818,7 @@ class HeadServer:
         e.pins += 1
         e.tracked = True
 
-    def _h_ref_update(self, req: dict) -> None:
+    def _h_ref_update(self, req: dict, src: str = "batch") -> None:
         """Client/worker holder-count deltas: ``increfs`` are synchronous
         borrow registrations (sent while the borrowed id is still pinned by
         its outer object or lease), ``decrefs`` are 1→0 instance-count
@@ -821,6 +831,7 @@ class HeadServer:
                     continue
                 self._add_holder(oid, holder)
             for oid in req.get("decrefs", ()):
+                logger.debug("decref %s by %s via %s", oid[:8], holder, src)
                 if oid in self._freed:
                     continue
                 # a decref can overtake its matching registration across
@@ -846,6 +857,7 @@ class HeadServer:
                 e.creating_lease = spec.task_id
                 e.tracked = True
                 if holder:
+                    logger.debug("register %s holder %s", oid[:8], holder)
                     self._add_holder(oid, holder)
             if spec.return_ids:
                 self._lease_live_returns[spec.task_id] = len(spec.return_ids)
@@ -907,6 +919,9 @@ class HeadServer:
                     or any(c > 0 for c in e.holders.values())
                 ):
                     continue
+                logger.debug(
+                    "GC free %s holders=%s pins=%s", oid[:8], e.holders, e.pins
+                )
                 del self._objects[oid]
                 self._freed.add(oid)
                 for nid in e.locations:
@@ -965,11 +980,12 @@ class HeadServer:
 
     @property
     def device_state(self):
-        """Lazy DeviceSchedulerState: JAX backend init happens on the first
-        scheduling round, not at head construction."""
-        if self._device_state is None and self.use_device_scheduler:
-            self._device_state = DeviceSchedulerState()
-        return self._device_state
+        """Lazy DeviceSchedulerState with bring-up timeout: JAX backend init
+        happens on the first scheduling round (never at construction), and a
+        wedged accelerator transport degrades to the host golden model
+        instead of freezing the scheduler (scheduler/device.py
+        LazyDeviceState)."""
+        return self._lazy_device.get()
 
     def _scheduler_loop(self) -> None:
         while True:
@@ -1023,12 +1039,16 @@ class HeadServer:
         if not kernel_batch:
             return
         totals = avail = alive = None
+        # lazy XLA/backend init happens OUTSIDE the view lock: a slow (or
+        # wedged) backend bring-up must stall only the scheduler thread,
+        # never every RPC handler that needs the lock
+        device_state = self.device_state
         with self._lock:
             n = self.view.num_nodes
             r = self.view.totals.shape[1]
             any_alive = bool(self.view.alive.any())
-            if self.device_state is not None and n > 0:
-                self.device_state.sync(self.view)
+            if device_state is not None and n > 0:
+                device_state.sync(self.view)
             else:
                 # snapshot copies for the host reference scheduler: RPC
                 # threads mutate the view concurrently (node add/remove,
@@ -1056,10 +1076,10 @@ class HeadServer:
         if not sched:
             return
         demands = np.stack([d for _, d in sched])
-        if self.device_state is not None:
+        if device_state is not None:
             # the default path: shape-grouped waterfall kernel over the
             # device-resident view (device.py module docstring)
-            rows = self.device_state.schedule(
+            rows = device_state.schedule(
                 demands, spread_threshold=self.hybrid_config.spread_threshold
             )
             granted = rows >= 0

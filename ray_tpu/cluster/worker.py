@@ -142,13 +142,16 @@ class Worker:
         )
         status = reply["status"]
         if status == "local":
-            if self.store is None:
-                # our shm mapping failed but the agent's works: fetch bytes
-                data = self.agent.call(
-                    "FetchObject", {"object_id": hex_id}, timeout=120.0
-                )
-                return self._loads_tracking(data)
-            return self._loads_tracking(self.store.get_bytes(hex_id))
+            if self.store is not None:
+                try:
+                    return self._loads_tracking(self.store.get_bytes(hex_id))
+                except (KeyError, BlockingIOError):
+                    pass  # spilled/evicted between reply and read: fall back
+            # our shm read failed but the agent can serve the bytes
+            data = self.agent.call(
+                "FetchObject", {"object_id": hex_id}, timeout=120.0
+            )
+            return self._loads_tracking(data)
         if status == "inline":
             return self._loads_tracking(reply["data"])
         if status == "error":
@@ -220,15 +223,20 @@ class Worker:
         kind = req["kind"]
         self._set_context(req)
         accel_env = req.get("accel_env")
+        prev_env: Dict[str, Optional[str]] = {}
+        persist_env = False
         try:
             self._apply_runtime_env(req.get("runtime_env"))
             if accel_env:
                 # the granted lease's chip assignment: TPU_VISIBLE_CHIPS /
                 # CUDA_VISIBLE_DEVICES (accelerators/tpu.py:38-56 analog).
-                # For an actor creation this persists for the pinned
-                # worker's lifetime — the actor owns those chips. For plain
-                # tasks it is removed again below: a reused pooled worker
-                # must not leak one lease's chips into the next.
+                # A SUCCESSFUL actor creation keeps it for the pinned
+                # worker's lifetime — the actor owns those chips. Every
+                # other case (plain tasks, failed creations, methods with
+                # their own demand) restores the prior values so a reused
+                # worker — or the actor's own lifetime pin — is not
+                # clobbered.
+                prev_env = {k: os.environ.get(k) for k in accel_env}
                 os.environ.update(accel_env)
             if kind == "actor_creation":
                 cls, args, kwargs = cloudpickle.loads(req["payload"])
@@ -254,6 +262,7 @@ class Worker:
                     groups.update(meta.get("concurrency_groups") or {})
                     self._actor_loops[aid] = self._start_actor_loop(aid, groups)
                 self._actors[aid] = cls(*args, **kwargs)
+                persist_env = bool(accel_env)  # actor now owns these chips
                 result_values: List[Any] = []
             elif kind == "actor_method":
                 method, args, kwargs = cloudpickle.loads(req["payload"])
@@ -290,18 +299,27 @@ class Worker:
         except BaseException as exc:  # noqa: BLE001 - errors are values
             return self._error_reply(req, exc)
         finally:
-            if accel_env and kind != "actor_creation":
-                for k in accel_env:
-                    os.environ.pop(k, None)
+            if accel_env and not persist_env:
+                for k, old in prev_env.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
             self._clear_context()
-        seals = [
-            self.put_value(oid, v)
-            for oid, v in zip(req["return_ids"], result_values)
-        ]
-        reply = {"status": "ok", "seals": seals}
-        borrows = self._compute_borrows(req.get("arg_ids"))
-        if borrows:
-            reply["borrows"] = borrows
+        try:
+            # sealing can fail too (store full + agent fallback unreachable):
+            # that MUST become an error reply, not an exception escaping the
+            # RPC handler — the agent would leak the lease's resources
+            seals = [
+                self.put_value(oid, v)
+                for oid, v in zip(req["return_ids"], result_values)
+            ]
+            reply = {"status": "ok", "seals": seals}
+            borrows = self._compute_borrows(req.get("arg_ids"))
+            if borrows:
+                reply["borrows"] = borrows
+        except BaseException as exc:  # noqa: BLE001
+            return self._error_reply(req, exc)
         if kind == "actor_creation" and req["actor_id"] in self._actor_loops:
             # tells the agent to skip per-actor FIFO serialization
             reply["async_actor"] = True
@@ -358,13 +376,13 @@ class Worker:
         logger.debug("task %s failed:\n%s", req["name"], tb)
         from ray_tpu.core.object_store import TaskError
 
-        err = TaskError(exc, req["name"])
+        err = TaskError(exc, req["name"], traceback_str=tb)
         err.__cause__ = exc
         try:
             blob = cloudpickle.dumps(err)
         except Exception:  # noqa: BLE001 - unpicklable exception
             blob = cloudpickle.dumps(
-                TaskError(RuntimeError(f"{exc!r}\n{tb}"), req["name"])
+                TaskError(RuntimeError(repr(exc)), req["name"], traceback_str=tb)
             )
         seals = [
             SealInfo(
@@ -490,6 +508,11 @@ def main() -> None:
     parser.add_argument("--store", default="")
     args = parser.parse_args()
     logging.basicConfig(level=logging.WARNING)
+    # stuck-worker diagnosis: `kill -USR1 <pid>` dumps all thread stacks
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     worker = Worker(args.agent, args.worker_id, args.store)
     worker.serve_forever()
 

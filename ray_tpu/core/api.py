@@ -160,7 +160,7 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
     if getattr(rt, "is_remote", False):
         return  # best-effort: remote cancel not yet supported
     with rt._cond:
-        for q in (rt._pending, rt._infeasible):
+        for q in (rt._pending, rt._infeasible, rt._dep_waiting):
             for spec in list(q):
                 if ref.hex in spec.return_ids:
                     q.remove(spec)

@@ -18,12 +18,23 @@ from . import refcount
 
 
 class TaskError(Exception):
-    """Wraps an exception raised in a remote task (RayTaskError analog)."""
+    """Wraps an exception raised in a remote task (RayTaskError analog);
+    carries the remote traceback text like the reference
+    (python/ray/exceptions.py RayTaskError.__str__)."""
 
-    def __init__(self, cause: BaseException, task_desc: str = ""):
-        super().__init__(f"task {task_desc} failed: {cause!r}")
+    def __init__(
+        self,
+        cause: BaseException,
+        task_desc: str = "",
+        traceback_str: str = "",
+    ):
+        msg = f"task {task_desc} failed: {cause!r}"
+        if traceback_str:
+            msg += f"\n\nremote traceback:\n{traceback_str}"
+        super().__init__(msg)
         self.cause = cause
         self.task_desc = task_desc
+        self.traceback_str = traceback_str
 
 
 class ObjectLostError(Exception):

@@ -48,14 +48,35 @@ class RefTracker:
         self._counts: Dict[str, int] = {}
         self._zeros: deque = deque()
         self.zero_event = threading.Event()
+        import os
+
+        self._debug = os.environ.get("RAY_TPU_REFCOUNT_DEBUG") == "1"
+        self._hist: Dict[str, list] = {}
+
+    def _note(self, hex_id: str, op: str, count: int) -> None:
+        import traceback
+
+        frames = [
+            f"{f.name}:{f.lineno}"
+            for f in traceback.extract_stack(limit=8)[:-3]
+        ]
+        self._hist.setdefault(hex_id, []).append((op, count, frames))
+
+    def history(self, hex_id: str) -> list:
+        with self._lock:
+            return list(self._hist.get(hex_id, ()))
 
     def incref(self, hex_id: str) -> None:
         with self._lock:
             self._counts[hex_id] = self._counts.get(hex_id, 0) + 1
+            if self._debug:
+                self._note(hex_id, "incref", self._counts[hex_id])
 
     def decref(self, hex_id: str) -> None:
         with self._lock:
             c = self._counts.get(hex_id, 0) - 1
+            if self._debug:
+                self._note(hex_id, "decref", c)
             if c > 0:
                 self._counts[hex_id] = c
                 return
@@ -253,6 +274,11 @@ class RefFlusher:
             self._owed.clear()
         if not rel:
             return
+        import logging
+
+        logging.getLogger("ray_tpu.refcount").debug(
+            "flush releases %d ids", len(rel)
+        )
         with self._send_lock:
             try:
                 self._send([], rel)
@@ -275,10 +301,16 @@ class RefFlusher:
         self._stop.set()
         TRACKER.zero_event.set()  # unblock the loop
         if release_all:
+            import logging
+            import traceback
+
             with self._held_lock:
                 rel = list(self._held_at_head | self._owed)
                 self._held_at_head.clear()
                 self._owed.clear()
+            logging.getLogger("ray_tpu.refcount").debug(
+                "flusher release_all: %d ids", len(rel)
+            )
             if rel:
                 with self._send_lock:
                     try:

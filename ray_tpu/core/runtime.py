@@ -156,7 +156,9 @@ class Runtime:
 
             use_device_scheduler = device_scheduler_default()
         self.use_device_scheduler = use_device_scheduler
-        self._device_state = None  # built lazily: keeps init() off the XLA path
+        from ray_tpu.scheduler.device import LazyDeviceState
+
+        self._lazy_device = LazyDeviceState(use_device_scheduler)
         self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
         self._spread_rr = 0  # SPREAD round-robin cursor
@@ -166,6 +168,7 @@ class Runtime:
         self._cond = threading.Condition(self._lock)
         self._pending: List[TaskSpec] = []
         self._infeasible: List[TaskSpec] = []
+        self._dep_waiting: List[TaskSpec] = []  # args not sealed yet
         self._lineage: Dict[str, TaskSpec] = {}  # object hex -> creating spec
         self._actors: Dict[str, "ActorState"] = {}
         self._named_actors: Dict[str, str] = {}
@@ -393,13 +396,26 @@ class Runtime:
     # ------------------------------------------------------------------
     @property
     def device_state(self):
-        """Lazy DeviceSchedulerState: JAX backend init happens on the first
-        scheduling round, not in ray_tpu.init()."""
-        if self._device_state is None and self.use_device_scheduler:
-            from ray_tpu.scheduler.device import DeviceSchedulerState
+        """Lazy DeviceSchedulerState with bring-up timeout (see
+        scheduler/device.py LazyDeviceState): a wedged accelerator backend
+        degrades to the host golden model instead of freezing init."""
+        return self._lazy_device.get()
 
-            self._device_state = DeviceSchedulerState()
-        return self._device_state
+    def _unready_args(self, spec: TaskSpec) -> List[ObjectRef]:
+        """Top-level ObjectRef args not yet sealed (the set the reference's
+        LeaseDependencyManager waits on before making a lease dispatchable,
+        lease_dependency_manager.h:41)."""
+        refs = [a for a in spec.args if isinstance(a, ObjectRef)]
+        refs += [v for v in spec.kwargs.values() if isinstance(v, ObjectRef)]
+        return [r for r in refs if not self.store.contains(r)]
+
+    def _admit_dep_ready(self) -> List[TaskSpec]:
+        ready = []
+        still = []
+        for spec in self._dep_waiting:
+            (ready if not self._unready_args(spec) else still).append(spec)
+        self._dep_waiting = still
+        return ready
 
     def _scheduler_loop(self) -> None:
         while True:
@@ -421,11 +437,22 @@ class Runtime:
                         self._parked_at_change = self.view.change_counter
                         self._pending.extend(self._infeasible)
                         self._infeasible.clear()
+                    if self._dep_waiting:
+                        self._pending.extend(self._admit_dep_ready())
                 if self._shutdown:
                     return
                 self._dirty = False
-                batch = self._pending[:MAX_SCHEDULE_BATCH]
-                del self._pending[: len(batch)]
+                take = min(len(self._pending), MAX_SCHEDULE_BATCH)
+                batch = self._admit_dep_ready() + self._pending[:take]
+                del self._pending[:take]
+                # dependency-aware dispatch: leases with unsealed args wait
+                # here holding NOTHING (no resources, no worker thread) —
+                # ready leases interleave past them
+                waiting = [s for s in batch if self._unready_args(s)]
+                if waiting:
+                    w = {id(s) for s in waiting}
+                    batch = [s for s in batch if id(s) not in w]
+                    self._dep_waiting.extend(waiting)
             try:
                 self._try_schedule_pgs()
                 if batch:
@@ -499,11 +526,14 @@ class Runtime:
             return
 
         totals = avail = alive = None
+        # lazy XLA init outside the lock (a wedged backend must not freeze
+        # every thread that needs the view)
+        device_state = self.device_state
         with self._lock:
             n = self.view.num_nodes
             r = self.view.totals.shape[1]
-            if self.device_state is not None and n > 0:
-                self.device_state.sync(self.view)
+            if device_state is not None and n > 0:
+                device_state.sync(self.view)
             else:
                 totals, avail, alive = self.view.active_arrays()
         if n == 0:
@@ -523,8 +553,8 @@ class Runtime:
         if not sched:
             return
         demands = np.stack(dense_rows)
-        if self.device_state is not None:
-            nodes_idx = self.device_state.schedule(
+        if device_state is not None:
+            nodes_idx = device_state.schedule(
                 demands, spread_threshold=self.hybrid_config.spread_threshold
             )
             granted = nodes_idx >= 0

@@ -1,0 +1,244 @@
+"""Disk-spilling wrapper around a node's object store.
+
+Analog of the reference's plasma eviction + LocalObjectManager spill/restore
+(/root/reference/src/ray/object_manager/plasma/eviction_policy.h,
+src/ray/raylet/local_object_manager.h:139-152), collapsed into one layer:
+
+- ``put_bytes`` NEVER hard-errors on a full arena: it spills
+  least-recently-used sealed objects to disk until the new object fits, and
+  if the object is bigger than what can be freed, the object itself goes to
+  disk (create-request backpressure becomes "succeed via disk" instead of
+  the reference's queue-and-wait — same liveness, simpler protocol).
+- ``get_bytes`` restores from disk transparently (and re-caches into the
+  arena when it fits), so readers never observe the spill.
+- The distributed GC's DeleteObjects reaches both tiers.
+
+Workers write directly into the shared-memory arena from their own
+processes; the agent registers those seals via ``note_external`` so the LRU
+book covers them too (it can read any arena object for spilling).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import uuid
+from collections import OrderedDict
+from typing import Dict, Optional
+
+
+class SpillingStore:
+    def __init__(
+        self,
+        inner,
+        spill_dir: str,
+        capacity: Optional[int] = None,
+        headroom_frac: float = 0.1,
+    ):
+        self.inner = inner
+        self.spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        stats = getattr(inner, "stats", None)
+        self.capacity = capacity or (stats()["capacity"] if stats else 1 << 28)
+        self._headroom = int(self.capacity * headroom_frac)
+        self._lock = threading.RLock()
+        # LRU book of arena-resident objects: oid -> size (insertion order =
+        # recency; move_to_end on access)
+        self._resident: "OrderedDict[str, int]" = OrderedDict()
+        self._spilled: Dict[str, int] = {}  # oid -> size on disk
+        self._spilling: set = set()  # victims with a disk write in flight
+        self.metrics = {"spilled_objects": 0, "spilled_bytes": 0, "restored": 0}
+
+    # -- paths ---------------------------------------------------------
+    def _path(self, oid: str) -> str:
+        return os.path.join(self.spill_dir, oid)
+
+    def _write_spill_file(self, oid: str, data: bytes) -> None:
+        """Atomic write with a UNIQUE temp name: a concurrent spill and a
+        duplicate-put fallback for the same id must never race on one
+        .tmp path (os.replace of a vanished tmp is FileNotFoundError)."""
+        tmp = f"{self._path(oid)}.{uuid.uuid4().hex[:8]}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._path(oid))
+
+    @property
+    def store_path(self) -> str:  # workers map the inner arena
+        return getattr(self.inner, "path", "")
+
+    # -- bookkeeping ---------------------------------------------------
+    def note_external(self, oid: str, size: int) -> None:
+        """A worker sealed this object straight into the shared arena."""
+        with self._lock:
+            if oid not in self._spilled and self.inner.contains(oid):
+                self._resident[oid] = size
+                self._resident.move_to_end(oid)
+
+    def _touch(self, oid: str) -> None:
+        with self._lock:
+            if oid in self._resident:
+                self._resident.move_to_end(oid)
+
+    # -- spill machinery ----------------------------------------------
+    def _make_room(self, need: int) -> None:
+        """Spill LRU residents until ``need`` + headroom fits. Disk writes
+        happen OUTSIDE the lock — contains/get/fetch traffic must not queue
+        behind file I/O (a full arena would otherwise serialize the whole
+        node's object plane on the disk)."""
+        stats = getattr(self.inner, "stats", None)
+        if stats is None:
+            return
+        target_free = need + self._headroom
+        while True:
+            with self._lock:
+                s = stats()
+                if s["capacity"] - s["used"] >= target_free:
+                    return
+                # concurrent _make_room callers must not race on one
+                # victim: the loser's cleanup would delete the winner's
+                # freshly written spill file
+                oid = next(
+                    (o for o in self._resident if o not in self._spilling),
+                    None,
+                )
+                if oid is None:
+                    return
+                self._spilling.add(oid)
+                try:
+                    data = self.inner.get_bytes(oid)
+                except Exception:  # noqa: BLE001 - raced a delete
+                    self._resident.pop(oid, None)
+                    self._spilling.discard(oid)
+                    continue
+            self._write_spill_file(oid, data)
+            with self._lock:
+                self._spilling.discard(oid)
+                if oid not in self._resident:
+                    # deleted (GC) while writing — unless it was spilled by
+                    # a competing path, the file must go too
+                    if oid not in self._spilled:
+                        try:
+                            os.remove(self._path(oid))
+                        except OSError:
+                            pass
+                    continue
+                try:
+                    self.inner.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+                size = self._resident.pop(oid, len(data))
+                self._spilled[oid] = size
+                self.metrics["spilled_objects"] += 1
+                self.metrics["spilled_bytes"] += size
+
+    # -- store interface ----------------------------------------------
+    def put_bytes(self, oid: str, data: bytes) -> None:
+        with self._lock:
+            # duplicate put of an immutable object (task retried after its
+            # first execution's reply was lost): already stored, either tier
+            if self.inner.contains(oid) or oid in self._spilled:
+                return
+        for attempt in range(2):
+            with self._lock:
+                try:
+                    self.inner.put_bytes(oid, data)
+                    self._resident[oid] = len(data)
+                    self._resident.move_to_end(oid)
+                    return
+                except Exception:  # noqa: BLE001 - arena full (or dup key)
+                    if self.inner.contains(oid):
+                        return  # duplicate put: already stored
+            if attempt == 0:
+                self._make_room(len(data))
+        # last resort: the new object itself lives on disk
+        self._write_spill_file(oid, data)
+        with self._lock:
+            self._spilled[oid] = len(data)
+            self.metrics["spilled_objects"] += 1
+            self.metrics["spilled_bytes"] += len(data)
+
+    def get_bytes(self, oid: str) -> bytes:
+        with self._lock:
+            if self.inner.contains(oid):
+                self._touch(oid)
+                return self.inner.get_bytes(oid)
+            spilled = oid in self._spilled or os.path.exists(self._path(oid))
+        if spilled:
+            try:
+                with open(self._path(oid), "rb") as f:  # outside the lock
+                    data = f.read()
+            except FileNotFoundError:
+                # a concurrent restore_to_arena moved it back to shm
+                with self._lock:
+                    if self.inner.contains(oid):
+                        self._touch(oid)
+                        return self.inner.get_bytes(oid)
+                raise KeyError(oid) from None
+            with self._lock:
+                self.metrics["restored"] += 1
+            return data
+        raise KeyError(oid)
+
+    def restore_to_arena(self, oid: str) -> bool:
+        """Bring a spilled object back into shared memory so workers can
+        map it (restore path, local_object_manager.h:152)."""
+        with self._lock:
+            if self.inner.contains(oid):
+                self._touch(oid)  # a reader is coming: keep it hot
+                return True
+            if oid not in self._spilled and not os.path.exists(self._path(oid)):
+                return False
+            with open(self._path(oid), "rb") as f:
+                data = f.read()
+            self._make_room(len(data))
+            try:
+                self.inner.put_bytes(oid, data)
+            except Exception:  # noqa: BLE001
+                return False
+            self._resident[oid] = len(data)
+            self._resident.move_to_end(oid)
+            self._spilled.pop(oid, None)
+            try:
+                os.remove(self._path(oid))
+            except OSError:
+                pass
+            self.metrics["restored"] += 1
+            return True
+
+    def contains(self, oid: str) -> bool:
+        with self._lock:
+            return (
+                self.inner.contains(oid)
+                or oid in self._spilled
+                or os.path.exists(self._path(oid))
+            )
+
+    def delete(self, oid: str) -> None:
+        with self._lock:
+            self._resident.pop(oid, None)
+            self._spilled.pop(oid, None)
+            try:
+                self.inner.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                os.remove(self._path(oid))
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        base = getattr(self.inner, "stats", None)
+        out = dict(base() if base else {})
+        with self._lock:
+            out.update(self.metrics)
+            out["resident_objects"] = len(self._resident)
+            out["spilled_resident"] = len(self._spilled)
+        return out
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self.inner.close(unlink=unlink)
+        except Exception:  # noqa: BLE001
+            pass
+        if unlink:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)

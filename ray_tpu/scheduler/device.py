@@ -112,6 +112,66 @@ def _configure_compile_cache() -> None:
         logger.debug("persistent compile cache unavailable", exc_info=True)
 
 
+class LazyDeviceState:
+    """Deferred DeviceSchedulerState construction with a bring-up timeout.
+
+    XLA backend initialization can block indefinitely when an accelerator
+    transport is unhealthy (e.g. a wedged TPU tunnel). The scheduler must
+    degrade to the NumPy golden model instead of freezing the whole control
+    plane: the first ``get()`` spawns the init in a daemon thread and waits
+    up to ``RAY_TPU_SCHED_INIT_TIMEOUT_S`` (default 30s); on timeout the
+    caller proceeds host-side, and if the backend ever does come up the
+    next round adopts it."""
+
+    def __init__(self, enabled: bool, timeout_s: Optional[float] = None):
+        self.enabled = enabled
+        if timeout_s is None:
+            timeout_s = float(
+                os.environ.get("RAY_TPU_SCHED_INIT_TIMEOUT_S", "30")
+            )
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._result: Optional[DeviceSchedulerState] = None
+        self._deadline: Optional[float] = None
+        self._warned = False
+
+    def _init(self) -> None:
+        try:
+            self._result = DeviceSchedulerState()
+        except Exception:  # noqa: BLE001 - backend broken: host fallback
+            logger.exception("device scheduler init failed; host fallback")
+            self.enabled = False
+
+    def get(self) -> Optional["DeviceSchedulerState"]:
+        if not self.enabled:
+            return None
+        if self._result is not None:
+            return self._result
+        import time
+
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._init, name="sched-xla-init", daemon=True
+                )
+                self._thread.start()
+                self._deadline = time.monotonic() + self.timeout_s
+        remaining = self._deadline - time.monotonic()
+        if remaining > 0:
+            self._thread.join(timeout=remaining)
+        if self._result is not None:
+            return self._result
+        if not self._warned:
+            self._warned = True
+            logger.warning(
+                "XLA scheduler backend not up after %.0fs; scheduling on "
+                "the host golden model until it appears",
+                self.timeout_s,
+            )
+        return None  # adopt later if/when the init thread finishes
+
+
 class DeviceSchedulerState:
     """Resident mirror of a ClusterView on one XLA device + the jitted
     scheduling round.
