@@ -162,6 +162,12 @@ class NodeAgent:
             "RollbackBundles": self._h_rollback_bundles,
             "ReturnBundles": self._h_return_bundles,
             "KillActor": self._h_kill_actor,
+            "DagInstall": lambda r: self._forward_to_actor_worker(
+                "DagInstall", r
+            ),
+            "DagTeardown": lambda r: self._forward_to_actor_worker(
+                "DagTeardown", r
+            ),
             "Shutdown": self._h_shutdown,
             "DebugState": self._h_debug_state,
             "Ping": lambda r: "pong",
@@ -1235,6 +1241,18 @@ class NodeAgent:
         self._async_actors.discard(actor_id)
         self._async_buf.pop(actor_id, None)
         self._release(self._actor_allocs.pop(actor_id, None))
+
+    def _forward_to_actor_worker(self, method: str, req: dict) -> Any:
+        """Relay a compiled-DAG program RPC to the worker process pinned to
+        the actor (the driver only knows the agent's address)."""
+        with self._lock:
+            worker_id = self._actor_workers.get(req["actor_id"])
+            handle = self._workers.get(worker_id) if worker_id else None
+        if handle is None or handle.client is None:
+            raise RuntimeError(
+                f"actor {req['actor_id']} has no live worker on this node"
+            )
+        return handle.client.call(method, req, timeout=60.0)
 
     def _h_kill_actor(self, req: dict) -> None:
         with self._lock:

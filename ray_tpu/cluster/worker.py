@@ -104,11 +104,19 @@ class Worker:
         self._batch_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="task-batch"
         )
+        # compiled-DAG programs resident in this worker:
+        # dag_id -> {"stop": Event, "threads": [...], "channels": [...]}
+        self._dag_programs: Dict[str, dict] = {}
+        # per-actor lock mediating DAG stage threads vs normal pushed
+        # methods on the same instance (created when a DAG binds the actor)
+        self._dag_actor_locks: Dict[str, threading.Lock] = {}
         self._server = RpcServer(
             {
                 "PushTask": self._h_push_task,
                 "PushTaskBatch": self._h_push_task_batch,
                 "KillActor": self._h_kill_actor,
+                "DagInstall": self._h_dag_install,
+                "DagTeardown": self._h_dag_teardown,
                 "Ping": lambda r: "pong",
             },
             port=0,
@@ -289,7 +297,12 @@ class Worker:
                         )
                     )
                     return {"status": "async_pending"}
-                out = getattr(instance, method)(*args, **kwargs)
+                dag_lock = self._dag_actor_locks.get(aid)
+                if dag_lock is not None:
+                    with dag_lock:
+                        out = getattr(instance, method)(*args, **kwargs)
+                else:
+                    out = getattr(instance, method)(*args, **kwargs)
                 result_values = self._split(out, req["return_ids"])
             else:
                 fn, args, kwargs = cloudpickle.loads(req["payload"])
@@ -465,6 +478,104 @@ class Worker:
             ctx.actor_id = None
         except Exception:  # noqa: BLE001
             pass
+
+    # ------------------------------------------------------------------
+    # compiled-DAG programs (reference: compiled_dag_node.py actor-side
+    # execution loops reading/writing channels instead of receiving tasks)
+    # ------------------------------------------------------------------
+    def _h_dag_install(self, req: dict) -> dict:
+        from ray_tpu.dag.channel import ShmChannel
+        from ray_tpu.dag.compiled import run_dag_stage
+
+        actor_id = req["actor_id"]
+        dag_id = req["dag_id"]
+        instance = self._actors[actor_id]
+        entry = self._actor_loops.get(actor_id)
+        dag_lock = self._dag_actor_locks.setdefault(actor_id, threading.Lock())
+        state = self._dag_programs.setdefault(
+            dag_id, {"stop": threading.Event(), "threads": [], "channels": []}
+        )
+        for prog in req["programs"]:
+            in_channels: Dict[tuple, Any] = {}
+            consts_args: List[Any] = []
+            for i, (kind, v) in enumerate(prog["args"]):
+                if kind == "chan":
+                    ch = ShmChannel(v, capacity=prog["capacity"])
+                    in_channels[("arg", i)] = ch
+                    state["channels"].append(ch)
+                    consts_args.append(None)
+                else:
+                    consts_args.append(cloudpickle.loads(v))
+            consts_kwargs: Dict[str, Any] = {}
+            for k, (kind, v) in prog["kwargs"].items():
+                if kind == "chan":
+                    ch = ShmChannel(v, capacity=prog["capacity"])
+                    in_channels[("kw", k)] = ch
+                    state["channels"].append(ch)
+                    consts_kwargs[k] = None
+                else:
+                    consts_kwargs[k] = cloudpickle.loads(v)
+            if prog.get("tick_path"):
+                ch = ShmChannel(prog["tick_path"], capacity=prog["capacity"])
+                in_channels[("tick",)] = ch
+                state["channels"].append(ch)
+            out_channels = []
+            for p in prog["out_paths"]:
+                ch = ShmChannel(p, capacity=prog["capacity"])
+                out_channels.append(ch)
+                state["channels"].append(ch)
+            method = prog["method"]
+            fn = getattr(instance, method)
+            if entry is not None:
+                import asyncio
+                import inspect
+
+                loop, _sems = entry
+
+                def target(*a, _fn=fn, **kw):
+                    with dag_lock:
+                        out = _fn(*a, **kw)
+                    if inspect.isawaitable(out):
+                        return asyncio.run_coroutine_threadsafe(
+                            out, loop
+                        ).result()
+                    return out
+
+            else:
+
+                def target(*a, _fn=fn, **kw):
+                    with dag_lock:
+                        return _fn(*a, **kw)
+            t = threading.Thread(
+                target=run_dag_stage,
+                args=(
+                    target,
+                    in_channels,
+                    out_channels,
+                    consts_args,
+                    consts_kwargs,
+                    state["stop"],
+                    f"{actor_id[:8]}.{method}",
+                ),
+                name=f"dag-{dag_id[:8]}-{method}",
+                daemon=True,
+            )
+            state["threads"].append(t)
+            t.start()
+        return {"status": "ok"}
+
+    def _h_dag_teardown(self, req: dict) -> dict:
+        state = self._dag_programs.pop(req["dag_id"], None)
+        if state is not None:
+            state["stop"].set()
+            for t in state["threads"]:
+                t.join(timeout=2.0)
+            for ch in state["channels"]:
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        return {"status": "ok"}
 
     def _h_kill_actor(self, req: dict) -> None:
         self._actors.pop(req["actor_id"], None)

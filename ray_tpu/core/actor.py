@@ -120,6 +120,9 @@ class ActorState:
         # the entry first does it
         self._inflight: Dict[int, dict] = {}
         self._held_req = None  # (node, ResourceRequest) while alive
+        # set lazily when a compiled DAG binds this actor: serializes DAG
+        # stage calls against normal .remote() method execution
+        self.dag_lock: Optional[threading.Lock] = None
 
     # -- lifecycle ------------------------------------------------------
     def on_created(self, node_id: str, instance: Any, held_req) -> None:
@@ -331,7 +334,12 @@ class ActorState:
         try:
             args, kwargs = self.runtime._resolve_args(call["args"], call["kwargs"])
             fn = getattr(instance, call["method"])
-            result = fn(*args, **kwargs)
+            lock = self.dag_lock
+            if lock is not None:
+                with lock:
+                    result = fn(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
             self._seal_result(call, result)
         except BaseException as exc:  # noqa: BLE001
             self._seal_failure(call, exc)

@@ -1,0 +1,210 @@
+"""Compiled-DAG channels: the per-edge transport under CompiledDAG.
+
+Two implementations behind one interface:
+
+- ``LocalChannel`` — in-process bounded queue passing Python objects **by
+  reference**: a jax device array crossing a local edge never leaves the
+  device (the in-process seed of RDT — the reference moves device tensors
+  via NCCL channels, python/ray/experimental/rdt/; on one host we simply
+  hand over the buffer).
+- ``ShmChannel`` — cross-process SPSC ring over a file-backed mmap with a
+  native C++ core (futex blocking, release/acquire publication; see
+  ray_tpu/native/ring.cc). The analog of the reference's
+  shared_memory_channel.py, without a per-message object-store round trip:
+  messages are length-prefixed blobs in the ring itself.
+
+Wire format (ShmChannel): cloudpickle payloads tagged OK/ERR/STOP. Error
+markers flow through the same edges as data so a failure at stage k
+surfaces at the driver in order, and STOP tears the pipeline down in
+topological order.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import threading
+from collections import deque
+from typing import Any, Optional, Tuple
+
+import cloudpickle
+
+OK = 0
+ERR = 1
+STOP = 2
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+class LocalChannel:
+    """Bounded in-process SPSC queue; items pass by reference."""
+
+    def __init__(self, capacity: int = 16):
+        self._q: deque = deque()
+        self._cap = capacity
+        self._cv = threading.Condition()
+
+    def put(self, tag: int, value: Any, timeout: Optional[float] = None) -> None:
+        with self._cv:
+            while len(self._q) >= self._cap:
+                if not self._cv.wait(timeout=timeout):
+                    raise ChannelTimeout("channel full")
+            self._q.append((tag, value))
+            self._cv.notify_all()
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[int, Any]:
+        with self._cv:
+            while not self._q:
+                if not self._cv.wait(timeout=timeout):
+                    raise ChannelTimeout("channel empty")
+            item = self._q.popleft()
+            self._cv.notify_all()
+            return item
+
+    def close_write(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def unlink(self) -> None:
+        pass
+
+
+_ring_lib = None
+_ring_lock = threading.Lock()
+
+
+def _lib():
+    global _ring_lib
+    with _ring_lock:
+        if _ring_lib is None:
+            from ray_tpu.native.build import build_native
+
+            lib = ctypes.CDLL(build_native("ring"))
+            lib.rtpu_ring_open.restype = ctypes.c_void_p
+            lib.rtpu_ring_open.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_int,
+            ]
+            lib.rtpu_ring_write.restype = ctypes.c_int
+            lib.rtpu_ring_write.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_double,
+            ]
+            lib.rtpu_ring_next_size.restype = ctypes.c_int64
+            lib.rtpu_ring_next_size.argtypes = [ctypes.c_void_p, ctypes.c_double]
+            lib.rtpu_ring_read.restype = ctypes.c_int64
+            lib.rtpu_ring_read.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_double,
+            ]
+            lib.rtpu_ring_close_write.argtypes = [ctypes.c_void_p]
+            lib.rtpu_ring_capacity.restype = ctypes.c_uint64
+            lib.rtpu_ring_capacity.argtypes = [ctypes.c_void_p]
+            lib.rtpu_ring_close.argtypes = [ctypes.c_void_p]
+            _ring_lib = lib
+        return _ring_lib
+
+
+def channel_dir() -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    if base is None:
+        import tempfile
+
+        base = tempfile.gettempdir()
+    d = os.path.join(base, "ray_tpu_dag")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class ShmChannel:
+    """One SPSC edge over shared memory. Same-host only (like the
+    reference's shared-memory channel); cross-host DAG edges are routed by
+    the installer, not this class."""
+
+    def __init__(self, path: str, capacity: int = 1 << 22, create: bool = False):
+        self.path = path
+        self._lib = _lib()
+        self._h = self._lib.rtpu_ring_open(
+            path.encode(), capacity, 1 if create else 0
+        )
+        if not self._h:
+            raise OSError(f"failed to open ring channel at {path}")
+        self._cap = self._lib.rtpu_ring_capacity(self._h)
+        self._closed = False
+
+    def put(self, tag: int, value: Any, timeout: Optional[float] = None) -> None:
+        payload = bytes([tag]) + (
+            cloudpickle.dumps(value) if tag != STOP else b""
+        )
+        self.put_bytes(payload, timeout)
+
+    def put_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        rc = self._lib.rtpu_ring_write(
+            self._h, payload, len(payload), -1.0 if timeout is None else timeout
+        )
+        if rc == -1:
+            raise ChannelTimeout(f"write timed out on {self.path}")
+        if rc == -2:
+            raise ValueError(
+                f"message of {len(payload)} bytes exceeds ring capacity "
+                f"{self._cap}; pass a larger buffer_size_bytes to "
+                f"experimental_compile()"
+            )
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[int, Any]:
+        data = self.get_bytes(timeout)
+        tag = data[0]
+        if tag == STOP:
+            return STOP, None
+        return tag, pickle.loads(data[1:])
+
+    def get_bytes(self, timeout: Optional[float] = None) -> bytes:
+        t = -1.0 if timeout is None else timeout
+        size = self._lib.rtpu_ring_next_size(self._h, t)
+        if size == -1:
+            raise ChannelTimeout(f"read timed out on {self.path}")
+        if size == -3:
+            raise ChannelClosed(self.path)
+        buf = ctypes.create_string_buffer(size)
+        got = self._lib.rtpu_ring_read(self._h, buf, size, t)
+        if got == -1:
+            raise ChannelTimeout(f"read timed out on {self.path}")
+        if got == -3:
+            raise ChannelClosed(self.path)
+        return buf.raw[:got]
+
+    def close_write(self) -> None:
+        if self._h:
+            self._lib.rtpu_ring_close_write(self._h)
+
+    def close(self) -> None:
+        if self._h and not self._closed:
+            self._closed = True
+            self._lib.rtpu_ring_close(self._h)
+            self._h = None
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

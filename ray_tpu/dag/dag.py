@@ -1,8 +1,7 @@
 """DAG nodes, binding, execution."""
 from __future__ import annotations
 
-import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import ray_tpu
 from ray_tpu.core.actor import ActorHandle, ActorMethod
@@ -14,8 +13,14 @@ class DAGNode:
         cache: Dict[int, Any] = {}
         return self._eval(args, cache)
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def experimental_compile(self, **kwargs) -> "CompiledDAG":
+        """Freeze the topology into a channel-driven pipeline executor
+        (see ray_tpu/dag/compiled.py). ``execute`` on the compiled object
+        returns a CompiledDAGRef; multiple in-flight executions pipeline
+        across stages."""
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
 
     def _eval(self, inputs, cache):  # pragma: no cover - abstract
         raise NotImplementedError
@@ -95,27 +100,6 @@ class MultiOutputNode(DAGNode):
         return [o._eval(inputs, cache) for o in self.outputs]
 
 
-class CompiledDAG:
-    """Frozen topology executor.
-
-    Execution runs the topologically-ordered node list on a dedicated driver
-    thread pool, invoking actor methods directly (each actor's own executor
-    thread provides the pipelining; no per-call scheduler round trip) —
-    the in-process analog of the reference's channel-driven compiled DAG.
-    """
-
-    def __init__(self, root: DAGNode):
-        self.root = root
-        self._lock = threading.Lock()
-
-    def execute(self, *args):
-        with self._lock:  # compiled DAGs process one input at a time
-            return self.root.execute(*args)
-
-    def teardown(self):
-        pass
-
-
 def _bind_method(self: ActorMethod, *args, **kwargs) -> MethodNode:
     return MethodNode(self._handle, self._name, args, kwargs)
 
@@ -130,3 +114,22 @@ ActorMethod.bind = _bind_method
 from ray_tpu.core.api import RemoteFunction  # noqa: E402
 
 RemoteFunction.bind = _bind_function
+
+
+def _bind_remote_method(self, *args, **kwargs) -> MethodNode:
+    # cluster-mode actor methods (RemoteActorHandle._RemoteMethod) bind to
+    # the same MethodNode; CompiledDAG detects the remote handle and routes
+    # execution through worker-installed shm-channel programs
+    handle = RemoteActorHandle(self._runtime, self._actor_id, object)
+    return MethodNode(handle, self._method, args, kwargs)
+
+
+try:  # cluster client needs grpc; pure-local DAG use must not require it
+    from ray_tpu.cluster.client import (  # noqa: E402
+        RemoteActorHandle,
+        _RemoteMethod,
+    )
+
+    _RemoteMethod.bind = _bind_remote_method
+except ImportError:  # pragma: no cover - grpc-less environment
+    RemoteActorHandle = None

@@ -10,7 +10,11 @@ _lock = threading.Lock()
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "_build")
 
-SOURCES = {"objstore": "object_store.cc", "ledger": "ledger.cc"}
+SOURCES = {
+    "objstore": "object_store.cc",
+    "ledger": "ledger.cc",
+    "ring": "ring.cc",
+}
 
 
 def build_native(name: str = "objstore") -> str:

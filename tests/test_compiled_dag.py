@@ -1,0 +1,303 @@
+"""Compiled DAG: channel-driven pipeline execution, local + cluster.
+
+Capability targets from the reference's accelerated DAG
+(python/ray/dag/compiled_dag_node.py, experimental/channel/
+shared_memory_channel.py): pre-allocated per-edge channels, pinned actor
+executors, multiple in-flight executions pipelining across stages, error
+propagation through the channels, and — the headline — a 3-actor chain
+whose compiled throughput beats the eager .remote() path by >=5x at
+batch 1.
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.object_store import TaskError
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture()
+def rt():
+    ray_tpu.init(num_nodes=2, resources_per_node={"CPU": 8})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestLocalCompiledDag:
+    def test_chain_correctness_and_pipelining(self, rt):
+        @ray_tpu.remote
+        class Stage:
+            def __init__(self, k):
+                self.k = k
+
+            def f(self, x):
+                return x + self.k
+
+        a, b, c = Stage.remote(1), Stage.remote(10), Stage.remote(100)
+        with InputNode() as inp:
+            dag = c.f.bind(b.f.bind(a.f.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            # pipelined: submit all, then collect
+            refs = [compiled.execute(i) for i in range(20)]
+            for i, r in enumerate(refs):
+                assert r.get(timeout=30) == i + 111
+        finally:
+            compiled.teardown()
+
+    def test_fan_out_fan_in(self, rt):
+        @ray_tpu.remote
+        class W:
+            def mul(self, x, y):
+                return x * y
+
+            def add(self, x, y):
+                return x + y
+
+        w1, w2, w3 = W.remote(), W.remote(), W.remote()
+        with InputNode() as inp:
+            left = w1.mul.bind(inp, 2)
+            right = w2.add.bind(inp, 5)
+            dag = w3.add.bind(left, right)
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(8):
+                assert compiled.execute(i).get(timeout=30) == 2 * i + i + 5
+        finally:
+            compiled.teardown()
+
+    def test_error_propagates_in_order(self, rt):
+        @ray_tpu.remote
+        class S:
+            def f(self, x):
+                if x == 3:
+                    raise ValueError("boom at 3")
+                return x * 2
+
+            def g(self, x):
+                return x + 1
+
+        a, b = S.remote(), S.remote()
+        with InputNode() as inp:
+            dag = b.g.bind(a.f.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            refs = [compiled.execute(i) for i in range(6)]
+            for i, r in enumerate(refs):
+                if i == 3:
+                    with pytest.raises(TaskError):
+                        r.get(timeout=30)
+                else:
+                    assert r.get(timeout=30) == i * 2 + 1
+        finally:
+            compiled.teardown()
+
+    def test_objects_pass_by_reference(self, rt):
+        """Local edges hand objects over without serialization — a device
+        array crossing a local edge stays on device (in-process RDT)."""
+
+        @ray_tpu.remote
+        class Echo:
+            def f(self, x):
+                return x
+
+        marker = object()
+        payload = {"k": marker}
+
+        a, b = Echo.remote(), Echo.remote()
+        with InputNode() as inp:
+            dag = b.f.bind(a.f.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            out = compiled.execute(payload).get(timeout=30)
+            assert out is payload  # same object, zero copies
+        finally:
+            compiled.teardown()
+
+    def test_multi_output(self, rt):
+        @ray_tpu.remote
+        class S:
+            def inc(self, x):
+                return x + 1
+
+            def dec(self, x):
+                return x - 1
+
+        a, b = S.remote(), S.remote()
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.inc.bind(inp), b.dec.bind(inp), inp])
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(7).get(timeout=30) == [8, 6, 7]
+        finally:
+            compiled.teardown()
+
+
+class TestShmRing:
+    def test_large_messages_wrap(self, tmp_path):
+        """Messages bigger than half the ring must still flow (byte-wise
+        wrap; the no-wrap design would stall forever at an unlucky
+        offset)."""
+        from ray_tpu.dag.channel import OK, ShmChannel
+
+        path = str(tmp_path / "wrap.ring")
+        w = ShmChannel(path, capacity=1 << 16, create=True)
+        r = ShmChannel(path, capacity=1 << 16)
+        import threading
+
+        big = os.urandom(40_000)  # > cap/2 after the 4 KiB round-up
+        got = []
+
+        def reader():
+            for _ in range(12):
+                got.append(r.get(timeout=20))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        # odd sizes walk the write offset through every alignment
+        for i in range(12):
+            w.put(OK, big + bytes([i]) * (i * 7 + 1), timeout=20)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        for i, (tag, v) in enumerate(got):
+            assert tag == OK and v == big + bytes([i]) * (i * 7 + 1)
+        w.unlink()
+        r.close()
+
+    def test_oversize_rejected(self, tmp_path):
+        from ray_tpu.dag.channel import OK, ShmChannel
+
+        w = ShmChannel(str(tmp_path / "o.ring"), capacity=1 << 12, create=True)
+        with pytest.raises(ValueError, match="buffer_size_bytes"):
+            w.put(OK, b"z" * (1 << 13))
+        w.unlink()
+
+
+@pytest.fixture(scope="module")
+def cluster_client():
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    client = c.client()
+    set_runtime(client)
+    yield client
+    set_runtime(None)
+    client.shutdown()
+    c.shutdown()
+
+
+class _ChainStage:
+    def __init__(self, k):
+        self.k = k
+
+    def f(self, x):
+        return x + self.k
+
+
+def _kill_quietly(*actors):
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class TestClusterCompiledDag:
+    def test_chain_correctness(self, cluster_client):
+        S = ray_tpu.remote(_ChainStage).options(num_cpus=0.25)
+        a, b, c = S.remote(1), S.remote(10), S.remote(100)
+        with InputNode() as inp:
+            dag = c.f.bind(b.f.bind(a.f.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            refs = [compiled.execute(i) for i in range(10)]
+            for i, r in enumerate(refs):
+                assert r.get(timeout=60) == i + 111
+        finally:
+            compiled.teardown()
+            _kill_quietly(a, b, c)
+
+    def test_throughput_beats_eager_5x(self, cluster_client):
+        """VERDICT round-2 #6 acceptance: 3-actor chain, compiled >= 5x the
+        eager .remote() path at batch 1 (sequential round trips)."""
+        S = ray_tpu.remote(_ChainStage).options(num_cpus=0.25)
+        a, b, c = S.remote(1), S.remote(10), S.remote(100)
+
+        # eager: each hop is a scheduled actor method (chained refs)
+        N = 30
+        # warmup both paths
+        ray_tpu.get(c.f.remote(b.f.remote(a.f.remote(0))), timeout=60)
+        t0 = time.perf_counter()
+        for i in range(N):
+            out = ray_tpu.get(
+                c.f.remote(b.f.remote(a.f.remote(i))), timeout=60
+            )
+        eager_s = time.perf_counter() - t0
+        assert out == N - 1 + 111
+
+        with InputNode() as inp:
+            dag = c.f.bind(b.f.bind(a.f.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(0).get(timeout=60) == 111  # warm
+            t0 = time.perf_counter()
+            for i in range(N):
+                out = compiled.execute(i).get(timeout=60)
+            compiled_s = time.perf_counter() - t0
+            assert out == N - 1 + 111
+        finally:
+            compiled.teardown()
+            _kill_quietly(a, b, c)
+        speedup = eager_s / compiled_s
+        assert speedup >= 5.0, (
+            f"compiled DAG only {speedup:.1f}x faster "
+            f"(eager {eager_s*1e3/N:.2f} ms/iter, "
+            f"compiled {compiled_s*1e3/N:.2f} ms/iter)"
+        )
+
+    def test_error_propagation(self, cluster_client):
+        @ray_tpu.remote(num_cpus=0.25)
+        class Boom:
+            def f(self, x):
+                if x < 0:
+                    raise RuntimeError("negative")
+                return x
+
+        a, b = Boom.remote(), Boom.remote()
+        with InputNode() as inp:
+            dag = b.f.bind(a.f.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(5).get(timeout=60) == 5
+            with pytest.raises(TaskError):
+                compiled.execute(-1).get(timeout=60)
+            # pipeline still healthy after an error
+            assert compiled.execute(9).get(timeout=60) == 9
+        finally:
+            compiled.teardown()
+            _kill_quietly(a, b)
+
+    def test_teardown_unlinks_channels(self, cluster_client):
+        from ray_tpu.dag.channel import channel_dir
+
+        S = ray_tpu.remote(_ChainStage).options(num_cpus=0.25)
+        a = S.remote(1)
+        with InputNode() as inp:
+            dag = a.f.bind(inp)
+        compiled = dag.experimental_compile()
+        dag_id = compiled._dag_id
+        assert compiled.execute(1).get(timeout=60) == 2
+        files = [
+            f for f in os.listdir(channel_dir()) if f.startswith(dag_id)
+        ]
+        assert files, "ring files should exist while the DAG is live"
+        compiled.teardown()
+        _kill_quietly(a)
+        files = [
+            f for f in os.listdir(channel_dir()) if f.startswith(dag_id)
+        ]
+        assert not files, "teardown must unlink ring files"

@@ -113,8 +113,14 @@ def test_dag_bind_and_compile(rt):
         dag = MultiOutputNode([a2.add.bind(square.bind(a1.add.bind(inp))), a1.add.bind(inp)])
     assert dag.execute(3) == [(3 + 1) ** 2 + 10, 4]
     compiled = dag.experimental_compile()
-    for i in range(5):
-        assert compiled.execute(i) == [(i + 1) ** 2 + 10, i + 1]
+    try:
+        for i in range(5):
+            assert compiled.execute(i).get(timeout=30) == [
+                (i + 1) ** 2 + 10,
+                i + 1,
+            ]
+    finally:
+        compiled.teardown()
 
 
 def test_cli_status_and_version(rt):
