@@ -162,6 +162,7 @@ class NodeAgent:
             "RollbackBundles": self._h_rollback_bundles,
             "ReturnBundles": self._h_return_bundles,
             "KillActor": self._h_kill_actor,
+            "ActorWorkerAddress": self._h_actor_worker_address,
             "DagInstall": lambda r: self._forward_to_actor_worker(
                 "DagInstall", r
             ),
@@ -773,8 +774,15 @@ class NodeAgent:
                 # kept for head-restart re-registration (_node_info):
                 # the head rebuilds ActorInfo/name bindings from this
                 self._actor_meta[spec.actor_id] = dict(spec.actor_meta or {})
-            # an actor pins its worker for life; backfill the pool
-            if len(self._workers) <= self._num_workers:
+            # an actor pins its worker for life; backfill the pool 1:1 so
+            # the free pool never shrinks below num_workers (the reference
+            # starts dedicated worker processes per actor on demand,
+            # worker_pool.cc StartWorkerProcess) — the previous total-count
+            # cap starved the Nth actor creation once N-1 actors held all
+            # the workers
+            with self._idle_cv:
+                free = len(self._idle)
+            if free < self._num_workers:
                 self._spawn_worker()
         self._run_on_worker(spec, handle, alloc)
 
@@ -1241,6 +1249,23 @@ class NodeAgent:
         self._async_actors.discard(actor_id)
         self._async_buf.pop(actor_id, None)
         self._release(self._actor_allocs.pop(actor_id, None))
+
+    def _h_actor_worker_address(self, req: dict) -> dict:
+        """Direct actor calls: resolve the worker process hosting an actor
+        so a caller can push method batches to it without head round trips
+        (the reference's direct actor task submission,
+        core_worker/task_submission/actor_task_submitter.h)."""
+        with self._lock:
+            worker_id = self._actor_workers.get(req["actor_id"])
+            handle = self._workers.get(worker_id) if worker_id else None
+            if handle is None or handle.client is None:
+                raise RuntimeError(
+                    f"actor {req['actor_id']} has no live worker on this node"
+                )
+            return {
+                "address": handle.client.address,
+                "async_actor": req["actor_id"] in self._async_actors,
+            }
 
     def _forward_to_actor_worker(self, method: str, req: dict) -> Any:
         """Relay a compiled-DAG program RPC to the worker process pinned to

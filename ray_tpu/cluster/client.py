@@ -12,6 +12,7 @@ anywhere with connectivity to the cluster.
 from __future__ import annotations
 
 import inspect
+import os
 import pickle
 import sys
 import threading
@@ -26,7 +27,7 @@ from ray_tpu.core.object_store import GetTimeoutError, ObjectRef
 from ray_tpu.core.runtime import TaskSpec
 
 from .common import INLINE_OBJECT_MAX, LeaseRequest, new_id
-from .rpc import RpcClient, RpcError
+from .rpc import RpcClient, RpcError, RpcServer
 
 _BY_VALUE_REGISTERED: set = set()
 
@@ -119,6 +120,148 @@ class RemotePlacementGroup:
 
     def __repr__(self) -> str:
         return f"RemotePlacementGroup({self.id[:8]}, {self.strategy})"
+
+
+class _DirectActorChannel:
+    """Caller-side direct submission channel to one actor's worker process
+    (reference: ActorTaskSubmitter's per-actor ordered send queue,
+    core_worker/task_submission/actor_task_submitter.h:79). Methods are
+    coalesced into DirectPushBatch RPCs straight to the worker; results
+    come back via the runtime's callback server. The head never sees the
+    hot path — it only receives coalesced seal reports for the object
+    directory. On any transport failure the channel drains its queue back
+    through the head-scheduled lease path (which owns restart semantics);
+    a batch that died mid-flight may re-execute (at-least-once, like the
+    reference's actor task retries)."""
+
+    MAX_BATCH = 256
+
+    def __init__(self, runtime: "RemoteRuntime", actor_id: str):
+        self._rt = runtime
+        self.actor_id = actor_id
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._dead = False
+        self._accepted: Dict[str, dict] = {}  # ref hex -> item (unresolved)
+        self._worker: Optional[RpcClient] = None
+        self._thread = threading.Thread(
+            target=self._loop, name=f"direct-{actor_id[:6]}", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, item: dict) -> None:
+        with self._cv:
+            if not self._dead:
+                self._q.append(item)
+                self._cv.notify()
+                return
+        # fallback OUTSIDE self._cv: _fallback_submit takes the runtime's
+        # _direct_cv, and _h_direct_results holds _direct_cv while calling
+        # on_result — nesting here would be an AB-BA deadlock
+        self._rt._fallback_submit(item)
+
+    def on_result(self, ref_hex: str) -> None:
+        # single GIL-atomic pop; deliberately lock-free (callers hold the
+        # runtime's _direct_cv — see submit() ordering note)
+        self._accepted.pop(ref_hex, None)
+
+    def _resolve_worker(self) -> Optional[RpcClient]:
+        handle = RemoteActorHandle(self._rt, self.actor_id, object)
+        info = self._rt.wait_actor_alive(handle, timeout=60.0)
+        agent = self._rt._agent(info.node_id, info.address)
+        reply = agent.call(
+            "ActorWorkerAddress", {"actor_id": self.actor_id}, timeout=10.0
+        )
+        return RpcClient(reply["address"])
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.cluster.client")
+        try:
+            self._worker = self._resolve_worker()
+        except BaseException as exc:  # noqa: BLE001
+            log.info(
+                "direct channel to %s unavailable (%r); using head path",
+                self.actor_id[:8],
+                exc,
+            )
+            self._fail_over()
+            return
+        idle_checks = 0.0
+        while True:
+            with self._cv:
+                while not self._q and not self._dead:
+                    self._cv.wait(timeout=1.0)
+                    # watchdog: accepted-but-unresolved items + silent
+                    # worker means the worker may have died mid-call
+                    if self._accepted and not self._q:
+                        idle_checks += 1.0
+                        if idle_checks >= 2.0:
+                            break
+                if self._dead:
+                    return
+                batch = []
+                while self._q and len(batch) < self.MAX_BATCH:
+                    batch.append(self._q.popleft())
+                if batch:
+                    for it in batch:
+                        self._accepted[it["ref"]] = it
+            try:
+                if batch:
+                    # strip client-local fields (e.g. the live arg refs kept
+                    # to pin args until completion) from the wire items
+                    wire = [
+                        {k: v for k, v in it.items() if not k.startswith("_")}
+                        for it in batch
+                    ]
+                    accepts = self._worker.call(
+                        "DirectPushBatch",
+                        {
+                            "client_addr": self._rt._callback_address(),
+                            "items": wire,
+                        },
+                        timeout=60.0,
+                    )
+                    done = []
+                    for it, status in zip(batch, accepts):
+                        if isinstance(status, dict):
+                            # fast path: the result rode the accept reply
+                            done.append(status["done"])
+                        elif status != "accepted":
+                            with self._cv:
+                                self._accepted.pop(it["ref"], None)
+                            self._rt._fallback_submit(it)
+                    if done:
+                        self._rt._h_direct_results(done)
+                else:
+                    # idle probe of a worker that owes us results
+                    self._worker.call("Ping", timeout=5.0)
+                    idle_checks = 0.0
+            except RpcError:
+                self._fail_over(batch)
+                return
+
+    def _fail_over(self, batch: Optional[list] = None) -> None:
+        """Worker unreachable: everything unresolved re-routes through the
+        head, which knows whether the actor is restarting or dead."""
+        with self._cv:
+            self._dead = True
+            items = list(self._accepted.values())
+            self._accepted.clear()
+            queued = list(self._q)
+            self._q.clear()
+        seen = set()
+        for it in (batch or []) + items + queued:
+            if it["ref"] not in seen:
+                seen.add(it["ref"])
+                self._rt._fallback_submit(it)
+        self._rt._drop_direct_channel(self.actor_id, self)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._dead = True
+            self._cv.notify_all()
 
 
 class RemoteActorHandle:
@@ -275,6 +418,19 @@ class RemoteRuntime:
         from ray_tpu.core import refcount
 
         self.client_id = refcount.get_holder_id()
+        # direct actor calls: per-actor submission channels straight to the
+        # hosting worker; results arrive on a lazily-started callback
+        # server. RAY_TPU_DIRECT_ACTOR_CALLS=0 forces everything through
+        # the head-scheduled lease path.
+        self._direct_enabled = (
+            os.environ.get("RAY_TPU_DIRECT_ACTOR_CALLS", "1") != "0"
+        )
+        self._direct_channels: Dict[str, _DirectActorChannel] = {}
+        self._direct_results: Dict[str, tuple] = {}  # hex -> (kind, payload)
+        self._direct_pending: Dict[str, str] = {}  # hex -> actor_id
+        self._direct_arg_pins: Dict[str, List[str]] = {}  # hex -> arg ids
+        self._direct_cv = threading.Condition()
+        self._callback_server: Optional[RpcServer] = None
         # dedicated channel for the pipeline: its traffic during a head
         # outage must not push the main channel into gRPC reconnect backoff
         self._pipe_chan = RpcClient(address)
@@ -343,21 +499,200 @@ class RemoteRuntime:
         ref = ObjectRef.new(owner=actor_id)
         with collect_serialized() as arg_ids:
             payload = cloudpickle.dumps((method, args, kwargs))
-        lease = LeaseRequest(
+        self._flusher.note_registered([ref.hex])
+        if self._direct_enabled:
+            from ray_tpu.core.refcount import TRACKER
+
+            ids = sorted(arg_ids)
+            item = {
+                "task_id": new_id(),
+                "actor_id": actor_id,
+                "ref": ref.hex,
+                "payload": payload,
+                "client_id": self.client_id,
+                "name": f"{actor_id[:8]}.{method}",
+                "arg_ids": ids,
+            }
+            # pin every arg (incl. refs nested in containers) until the
+            # result lands: the worker registers its borrows synchronously
+            # before replying, so our later release can never free an
+            # object the actor still holds (the lease path gets this from
+            # head-side arg pins; the direct path pins at the caller)
+            for h in ids:
+                TRACKER.incref(h)
+            with self._direct_cv:
+                self._direct_pending[ref.hex] = actor_id
+                if ids:
+                    self._direct_arg_pins[ref.hex] = ids
+            chan = self._direct_channels.get(actor_id)
+            if chan is None:
+                with self._lock:
+                    chan = self._direct_channels.get(actor_id)
+                    if chan is None:
+                        chan = _DirectActorChannel(self, actor_id)
+                        self._direct_channels[actor_id] = chan
+            chan.submit(item)
+            return ref
+        self._submit_actor_lease(
             task_id=new_id(),
+            actor_id=actor_id,
             name=f"{actor_id[:8]}.{method}",
             payload=payload,
-            return_ids=[ref.hex],
+            return_id=ref.hex,
+            arg_ids=sorted(arg_ids),
+        )
+        return ref
+
+    def _submit_actor_lease(
+        self,
+        *,
+        task_id: str,
+        actor_id: str,
+        name: str,
+        payload: bytes,
+        return_id: str,
+        arg_ids: List[str],
+    ) -> None:
+        lease = LeaseRequest(
+            task_id=task_id,
+            name=name,
+            payload=payload,
+            return_ids=[return_id],
             resources={},
             kind="actor_method",
             actor_id=actor_id,
             max_retries=0,
-            arg_ids=sorted(arg_ids),
+            arg_ids=arg_ids,
             client_id=self.client_id,
         )
         self._sender.enqueue("lease", lease)
-        self._flusher.note_registered(lease.return_ids)
-        return ref
+
+    # ---- direct-call plumbing ----------------------------------------
+    def _callback_address(self) -> str:
+        with self._lock:
+            if self._callback_server is None:
+                self._callback_server = RpcServer(
+                    {
+                        "DirectResults": self._h_direct_results,
+                        "Ping": lambda r: "pong",
+                    },
+                    port=0,
+                    max_workers=4,
+                )
+            return self._callback_server.address
+
+    def _h_direct_results(self, results: List[dict]) -> None:
+        from ray_tpu.core.refcount import TRACKER
+
+        unpin: List[str] = []
+        with self._direct_cv:
+            for r in results:
+                h = r["ref"]
+                if r["status"] == "ok":
+                    self._direct_results[h] = ("val", r["value"])
+                elif r["status"] == "error":
+                    self._direct_results[h] = ("err", r["error"])
+                else:
+                    self._direct_results[h] = ("seal", r["seal"])
+                aid = self._direct_pending.pop(h, None)
+                if aid is not None:
+                    chan = self._direct_channels.get(aid)
+                    if chan is not None:
+                        chan.on_result(h)
+                unpin.extend(self._direct_arg_pins.pop(h, ()))
+            self._direct_cv.notify_all()
+        # release the per-call arg pins (the worker's borrow registrations
+        # are on the books before its result reaches us)
+        for h in unpin:
+            TRACKER.decref(h)
+
+    def _fallback_submit(self, item: dict) -> None:
+        """Route a direct-call item through the head-scheduled path (actor
+        restarting, worker gone, or no direct route)."""
+        from ray_tpu.core.refcount import TRACKER
+
+        with self._direct_cv:
+            self._direct_pending.pop(item["ref"], None)
+            unpin = self._direct_arg_pins.pop(item["ref"], ())
+            self._direct_cv.notify_all()
+        self._submit_actor_lease(
+            task_id=item["task_id"],
+            actor_id=item["actor_id"],
+            name=item["name"],
+            payload=item["payload"],
+            return_id=item["ref"],
+            arg_ids=item["arg_ids"],
+        )
+        # the lease (queued before this release can flush) pins the args
+        # head-side for the task's lifetime
+        for h in unpin:
+            TRACKER.decref(h)
+
+    def _drop_direct_channel(self, actor_id: str, chan) -> None:
+        with self._lock:
+            if self._direct_channels.get(actor_id) is chan:
+                del self._direct_channels[actor_id]
+
+    # a direct result push can be lost (transient caller-side RPC failure);
+    # the seal still reaches the head, so after this long a getter stops
+    # trusting the push channel and resolves through the head directory
+    DIRECT_WAIT_FALLBACK_S = 10.0
+
+    def _wait_direct(
+        self, h: str, deadline: Optional[float]
+    ) -> Optional[tuple]:
+        """Wait for a direct-call result. Returns the (kind, payload) tuple,
+        or None if the ref fell back to the head path (or the push is
+        taking long enough that the head directory is the better bet)."""
+        give_up = time.monotonic() + self.DIRECT_WAIT_FALLBACK_S
+        with self._direct_cv:
+            while True:
+                if h in self._direct_results:
+                    return self._direct_results[h]
+                if h not in self._direct_pending:
+                    return None
+                now = time.monotonic()
+                if now >= give_up:
+                    return None  # head WaitObject takes over (seal landed)
+                wait = min(0.5, give_up - now)
+                if deadline is not None:
+                    wait = min(wait, deadline - now)
+                    if wait <= 0:
+                        raise GetTimeoutError(
+                            f"get() timed out waiting for {h}"
+                        )
+                self._direct_cv.wait(timeout=wait)
+
+    def _consume_direct(self, h: str, entry: tuple) -> Tuple[bool, Any]:
+        """(resolved, value); raises for error results. Successfully
+        consumed entries are dropped — later gets resolve through the head
+        directory, which received the same seal."""
+        kind, payload = entry
+        if kind == "err":
+            with self._direct_cv:
+                self._direct_results.pop(h, None)
+            raise pickle.loads(payload)
+        if kind == "val":
+            value = self._loads_tracking(payload)
+            with self._direct_cv:
+                self._direct_results.pop(h, None)
+            return True, value
+        # sealed to the actor's node store: fetch from that agent directly
+        seal = payload
+        with self._lock:
+            client = self._agents.get(seal.node_id)
+        if client is not None:
+            try:
+                data = client.call(
+                    "FetchObject", {"object_id": h}, timeout=120.0
+                )
+                value = self._loads_tracking(data)
+                with self._direct_cv:
+                    self._direct_results.pop(h, None)
+                return True, value
+            except (RpcError, KeyError):
+                pass
+        return False, None  # fall back to the head-located fetch
 
     # ------------------------------------------------------------------
     # actors
@@ -457,6 +792,15 @@ class RemoteRuntime:
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
+        h = ref.hex
+        if self._direct_enabled and (
+            h in self._direct_pending or h in self._direct_results
+        ):
+            entry = self._wait_direct(h, deadline)
+            if entry is not None:
+                resolved, value = self._consume_direct(h, entry)
+                if resolved:
+                    return value
         while True:
             poll = 2.0
             if deadline is not None:
@@ -490,6 +834,19 @@ class RemoteRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         results: Dict[str, tuple] = {}  # hex -> ("val", v) | ("err", exc)
         order = [r.hex for r in refs]
+        if self._direct_enabled:
+            for h in dict.fromkeys(order):
+                if h in self._direct_pending or h in self._direct_results:
+                    try:
+                        entry = self._wait_direct(h, deadline)
+                        if entry is not None:
+                            ok, value = self._consume_direct(h, entry)
+                            if ok:
+                                results[h] = ("val", value)
+                    except GetTimeoutError:
+                        raise
+                    except BaseException as exc:  # noqa: BLE001
+                        results[h] = ("err", exc)
         while True:
             unresolved = list(dict.fromkeys(h for h in order if h not in results))
             if not unresolved:
@@ -633,6 +990,12 @@ class RemoteRuntime:
     def shutdown(self) -> None:
         from ray_tpu.core import refcount
 
+        for chan in list(self._direct_channels.values()):
+            chan.stop()
+        self._direct_channels.clear()
+        if self._callback_server is not None:
+            self._callback_server.stop()
+            self._callback_server = None
         if self._owns_flusher:
             # release every id this driver still counts so the cluster can
             # free driver-owned objects (job-exit cleanup analog)

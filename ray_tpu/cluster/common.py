@@ -88,6 +88,10 @@ class SealInfo:
     # while this object is alive (nested-ref ownership,
     # reference_counter.h AddNestedObjectIds)
     contained_ids: List[str] = field(default_factory=list)
+    # direct actor calls: the caller that owns the return object. The head
+    # registers it as a holder when the seal lands (the lease path does
+    # this at submission; direct calls never create a lease).
+    owner: Optional[str] = None
 
 
 @dataclass

@@ -559,6 +559,10 @@ class HeadServer:
                         stale.append((s.node_id, s.object_id))
                     continue
                 e = self._objects.setdefault(s.object_id, _ObjEntry())
+                if s.owner:
+                    # direct-call return object: the caller is its holder
+                    # (no lease ever registered one)
+                    self._add_holder(s.object_id, s.owner)
                 if s.is_error:
                     e.error = s.error
                 else:

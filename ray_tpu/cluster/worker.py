@@ -23,6 +23,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -82,7 +83,6 @@ class Worker:
         self._actors: Dict[str, Any] = {}
         self._actor_loops: Dict[str, Any] = {}  # actor_id -> (loop, sems)
         self._env_applied: set = set()
-        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         # seals + TaskDone callbacks for finished async-actor methods run
@@ -110,6 +110,27 @@ class Worker:
         # per-actor lock mediating DAG stage threads vs normal pushed
         # methods on the same instance (created when a DAG binds the actor)
         self._dag_actor_locks: Dict[str, threading.Lock] = {}
+        # direct actor calls (actor_task_submitter analog): per-actor FIFO
+        # executor threads for sync actors, result push-back to callers,
+        # and seal reports to the agent for the head's object directory
+        self._direct_fifo: Dict[str, deque] = {}
+        self._direct_fifo_cv = threading.Condition()
+        self._direct_fifo_threads: Dict[str, threading.Thread] = {}
+        self._direct_out: Dict[str, list] = {}  # client_addr -> results
+        self._direct_out_cv = threading.Condition()
+        self._direct_clients: Dict[str, RpcClient] = {}
+        self._direct_seals: list = []  # SealInfo batch for the agent
+        self._direct_seal_cv = threading.Condition()
+        threading.Thread(
+            target=self._direct_sender_loop,
+            name="direct-result-send",
+            daemon=True,
+        ).start()
+        threading.Thread(
+            target=self._direct_seal_loop,
+            name="direct-seal-send",
+            daemon=True,
+        ).start()
         self._server = RpcServer(
             {
                 "PushTask": self._h_push_task,
@@ -117,6 +138,7 @@ class Worker:
                 "KillActor": self._h_kill_actor,
                 "DagInstall": self._h_dag_install,
                 "DagTeardown": self._h_dag_teardown,
+                "DirectPushBatch": self._h_direct_push_batch,
                 "Ping": lambda r: "pong",
             },
             port=0,
@@ -480,6 +502,339 @@ class Worker:
             pass
 
     # ------------------------------------------------------------------
+    # direct actor calls (reference: actor_task_submitter.cc caller->worker
+    # submission + task_receiver.h execution, bypassing GCS/raylet).
+    # The accept reply returns as soon as every item is QUEUED; results are
+    # pushed back to the caller's callback server (coalesced), and seals
+    # flow to the agent so the head's object directory stays authoritative
+    # for non-owner consumers.
+    # ------------------------------------------------------------------
+    INLINE_REPLY_WAIT_S = 0.005
+
+    def _h_direct_push_batch(self, req: dict) -> List[Any]:
+        """Accept a batch of direct method calls. Per item the reply entry
+        is "accepted" / "unknown_actor" / {"done": result}: after queueing
+        everything, the handler lingers a few ms so fast results ride the
+        accept reply itself — one RPC round trip for the common case —
+        while slow methods fall back to the pushed DirectResults path
+        (bounded wait, so a parked method can never deadlock the wire)."""
+        import concurrent.futures as cf
+
+        client_addr = req["client_addr"]
+        accepts: List[Any] = []
+        waiters: List[Optional[cf.Future]] = []
+        if os.environ.get("RAY_TPU_DIRECT_TRACE"):
+            for item in req["items"]:
+                item["_t_accept"] = time.perf_counter()
+        for item in req["items"]:
+            aid = item["actor_id"]
+            instance = self._actors.get(aid)
+            if instance is None:
+                accepts.append("unknown_actor")
+                waiters.append(None)
+                continue
+            item["client_addr"] = client_addr
+            item["_claim"] = threading.Lock()
+            item["_claimed"] = False
+            entry = self._actor_loops.get(aid)
+            if entry is not None:
+                fut = self._direct_dispatch_async(item, instance, entry)
+            else:
+                fut = self._direct_fifo_enqueue(aid, item)
+            accepts.append("accepted")
+            waiters.append(fut)
+        live = [f for f in waiters if f is not None]
+        if live:
+            cf.wait(live, timeout=self.INLINE_REPLY_WAIT_S)
+        for i, (item, fut) in enumerate(zip(req["items"], waiters)):
+            if fut is None:
+                continue  # deferred dispatch attaches its own callback
+            if fut.done():
+                with item["_claim"]:
+                    if item["_claimed"]:
+                        continue
+                    item["_claimed"] = True
+                try:
+                    result, seal = self._build_direct_result(
+                        item, fut.result()
+                    )
+                except BaseException as exc:  # noqa: BLE001
+                    result, seal = self._build_direct_error(item, exc)
+                with self._direct_seal_cv:
+                    self._direct_seals.append(seal)
+                    self._direct_seal_cv.notify()
+                accepts[i] = {"done": result}
+            else:
+                # still running: results go via the pushed DirectResults
+                # path once the method settles
+                fut.add_done_callback(
+                    lambda f, it=item: self._done_pool.submit(
+                        self._direct_finish_future, it, f
+                    )
+                )
+        return accepts
+
+    def _direct_dispatch_async(self, item: dict, instance, entry):
+        import asyncio
+
+        from ray_tpu.core.object_store import ObjectRef
+
+        loop, sems = entry
+        method, args, kwargs = cloudpickle.loads(item["payload"])
+
+        def schedule(rargs, rkwargs, attach: bool):
+            fut = asyncio.run_coroutine_threadsafe(
+                _invoke_maybe_async(instance, method, rargs, rkwargs, sems),
+                loop,
+            )
+            if attach:
+                fut.add_done_callback(
+                    lambda f, it=item: self._done_pool.submit(
+                        self._direct_finish_future, it, f
+                    )
+                )
+            return fut
+
+        has_refs = any(isinstance(a, ObjectRef) for a in args) or any(
+            isinstance(v, ObjectRef) for v in kwargs.values()
+        )
+        if not has_refs:
+            # no callback yet: the accept handler claims fast completions
+            # inline and attaches the callback only for slow ones
+            return schedule(args, kwargs, attach=False)
+
+        # arg fetches can block: resolve off the event loop AND off the
+        # RPC handler thread (the accept reply must return promptly)
+        def resolve_then_schedule() -> None:
+            try:
+                rargs, rkwargs = self._resolve(args, kwargs)
+            except BaseException as exc:  # noqa: BLE001
+                self._direct_finish_claimed_error(item, exc)
+                return
+            schedule(rargs, rkwargs, attach=True)
+
+        self._done_pool.submit(resolve_then_schedule)
+        return None
+
+    def _direct_finish_future(self, item: dict, fut) -> None:
+        """Callback-path completion: only fires the result push if the
+        accept handler didn't already claim this item inline."""
+        with item["_claim"]:
+            if item["_claimed"]:
+                return
+            item["_claimed"] = True
+        try:
+            try:
+                result, seal = self._build_direct_result(item, fut.result())
+            except BaseException as exc:  # noqa: BLE001
+                result, seal = self._build_direct_error(item, exc)
+            self._direct_emit(item["client_addr"], result, seal)
+        except Exception:  # noqa: BLE001
+            logger.exception("direct call completion failed")
+
+    def _direct_finish_claimed_error(self, item: dict, exc: BaseException) -> None:
+        with item["_claim"]:
+            if item["_claimed"]:
+                return
+            item["_claimed"] = True
+        result, seal = self._build_direct_error(item, exc)
+        self._direct_emit(item["client_addr"], result, seal)
+
+    def _direct_fifo_enqueue(self, actor_id: str, item: dict):
+        """Sync actor: one FIFO thread per actor preserves per-caller method
+        order (the sender ships batches in submission order). Returns a
+        Future of the raw value, completed by the FIFO thread."""
+        import concurrent.futures as cf
+
+        fut: cf.Future = cf.Future()
+        with self._direct_fifo_cv:
+            self._direct_fifo.setdefault(actor_id, deque()).append(
+                (item, fut)
+            )
+            if actor_id not in self._direct_fifo_threads:
+                t = threading.Thread(
+                    target=self._direct_fifo_loop,
+                    args=(actor_id,),
+                    name=f"direct-{actor_id[:6]}",
+                    daemon=True,
+                )
+                self._direct_fifo_threads[actor_id] = t
+                t.start()
+            self._direct_fifo_cv.notify_all()
+        return fut
+
+    def _direct_fifo_loop(self, actor_id: str) -> None:
+        q = self._direct_fifo[actor_id]
+        lock = self._dag_actor_locks.setdefault(actor_id, threading.Lock())
+        while True:
+            with self._direct_fifo_cv:
+                while not q:
+                    self._direct_fifo_cv.wait(timeout=5.0)
+                    if not q and actor_id not in self._actors:
+                        self._direct_fifo_threads.pop(actor_id, None)
+                        return
+                item, fut = q.popleft()
+            try:
+                instance = self._actors[actor_id]
+                method, args, kwargs = cloudpickle.loads(item["payload"])
+                args, kwargs = self._resolve(args, kwargs)
+                with lock:
+                    out = getattr(instance, method)(*args, **kwargs)
+                fut.set_result(out)
+            except BaseException as exc:  # noqa: BLE001
+                fut.set_exception(exc)
+
+    def _register_direct_borrows(self, item: dict) -> None:
+        """Arg refs this process still holds at completion (stored in actor
+        state / a live closure) are registered with the head SYNCHRONOUSLY
+        before the result is emitted — the caller releases its per-call arg
+        pins once the result arrives, so the registration must already be
+        on the books (lease-path analog: _compute_borrows + head pin
+        conversion)."""
+        from ray_tpu.core.refcount import TRACKER
+
+        borrowed = [
+            h
+            for h in item.get("arg_ids") or ()
+            if TRACKER.count(h) > 0 and not self._flusher.is_registered(h)
+        ]
+        if borrowed:
+            self._flusher.sync_incref(borrowed)
+
+    def _build_direct_result(self, item: dict, value: Any):
+        """(result_dict, seal): inline small values ride back to the caller
+        with an inline seal for the head's directory; large values go to
+        the store with a location seal."""
+        from ray_tpu.core.refcount import collect_serialized
+
+        self._register_direct_borrows(item)
+        oid = item["ref"]
+        owner = item["client_id"]
+        with collect_serialized() as contained:
+            data = cloudpickle.dumps(value)
+        contained_ids = sorted(contained)
+        if len(data) <= INLINE_OBJECT_MAX:
+            seal = SealInfo(
+                object_id=oid,
+                node_id=self.node_id,
+                size=len(data),
+                inline_value=data,
+                contained_ids=contained_ids,
+                owner=owner,
+            )
+            result = {"ref": oid, "status": "ok", "value": data}
+            if "_t_accept" in item:
+                result["_t_accept"] = item["_t_accept"]
+                result["_t_emit"] = time.perf_counter()
+            return result, seal
+        stored = False
+        if self.store is not None:
+            try:
+                self.store.put_bytes(oid, data)
+                stored = True
+            except Exception:  # noqa: BLE001 - arena full
+                pass
+        if not stored:
+            self.agent.call(
+                "WorkerPut", {"object_id": oid, "data": data}, timeout=60.0
+            )
+        seal = SealInfo(
+            object_id=oid,
+            node_id=self.node_id,
+            size=len(data),
+            contained_ids=contained_ids,
+            owner=owner,
+        )
+        return {"ref": oid, "status": "seal", "seal": seal}, seal
+
+    def _build_direct_error(self, item: dict, exc: BaseException):
+        from ray_tpu.core.object_store import TaskError
+
+        try:
+            self._register_direct_borrows(item)
+        except Exception:  # noqa: BLE001 - borrow RPC failure
+            logger.warning("borrow registration failed", exc_info=True)
+        tb = traceback.format_exc()
+        err = TaskError(exc, item.get("name", "direct_call"), traceback_str=tb)
+        err.__cause__ = exc
+        try:
+            blob = cloudpickle.dumps(err)
+        except Exception:  # noqa: BLE001
+            blob = cloudpickle.dumps(
+                TaskError(
+                    RuntimeError(repr(exc)),
+                    item.get("name", "direct_call"),
+                    traceback_str=tb,
+                )
+            )
+        seal = SealInfo(
+            object_id=item["ref"],
+            node_id=self.node_id,
+            is_error=True,
+            error=blob,
+            owner=item["client_id"],
+        )
+        return {"ref": item["ref"], "status": "error", "error": blob}, seal
+
+    def _direct_emit(self, client_addr: str, result: dict, seal) -> None:
+        with self._direct_out_cv:
+            self._direct_out.setdefault(client_addr, []).append(result)
+            self._direct_out_cv.notify()
+        with self._direct_seal_cv:
+            self._direct_seals.append(seal)
+            self._direct_seal_cv.notify()
+
+    def _direct_sender_loop(self) -> None:
+        """Coalescing pusher: everything finished while the previous RPC
+        was in flight merges into one DirectResults per caller. Seal
+        reports ride a separate thread so the latency-critical result
+        push never waits behind an agent round trip."""
+        while True:
+            with self._direct_out_cv:
+                while not self._direct_out:
+                    self._direct_out_cv.wait(timeout=1.0)
+                out = self._direct_out
+                self._direct_out = {}
+            for addr, results in out.items():
+                client = self._direct_clients.get(addr)
+                if client is None:
+                    client = self._direct_clients[addr] = RpcClient(addr)
+                try:
+                    client.call("DirectResults", results, timeout=30.0)
+                except RpcError:
+                    # caller is gone; the head-side seals still record the
+                    # outcomes for any other holder
+                    logger.warning(
+                        "direct caller %s unreachable; dropping %d results",
+                        addr,
+                        len(results),
+                    )
+
+    def _direct_seal_loop(self) -> None:
+        while True:
+            with self._direct_seal_cv:
+                while not self._direct_seals:
+                    self._direct_seal_cv.wait(timeout=1.0)
+                seals = self._direct_seals
+                self._direct_seals = []
+            while True:
+                try:
+                    self.agent.call(
+                        "WorkerSealed", {"seals": seals}, timeout=30.0
+                    )
+                    break
+                except RpcError:
+                    # a dropped seal would orphan the object in the head's
+                    # directory (no location, no holder) — keep the batch
+                    # and retry; if the agent is gone for good the orphan
+                    # check in serve_forever exits this process
+                    logger.warning(
+                        "agent unreachable; retrying %d direct seals",
+                        len(seals),
+                    )
+                    time.sleep(0.5)
+
+    # ------------------------------------------------------------------
     # compiled-DAG programs (reference: compiled_dag_node.py actor-side
     # execution loops reading/writing channels instead of receiving tasks)
     # ------------------------------------------------------------------
@@ -493,16 +848,17 @@ class Worker:
         entry = self._actor_loops.get(actor_id)
         dag_lock = self._dag_actor_locks.setdefault(actor_id, threading.Lock())
         state = self._dag_programs.setdefault(
-            dag_id, {"stop": threading.Event(), "threads": [], "channels": []}
+            dag_id, {"stop": threading.Event(), "threads": []}
         )
         for prog in req["programs"]:
+            prog_channels: List[Any] = []
             in_channels: Dict[tuple, Any] = {}
             consts_args: List[Any] = []
             for i, (kind, v) in enumerate(prog["args"]):
                 if kind == "chan":
                     ch = ShmChannel(v, capacity=prog["capacity"])
                     in_channels[("arg", i)] = ch
-                    state["channels"].append(ch)
+                    prog_channels.append(ch)
                     consts_args.append(None)
                 else:
                     consts_args.append(cloudpickle.loads(v))
@@ -511,19 +867,19 @@ class Worker:
                 if kind == "chan":
                     ch = ShmChannel(v, capacity=prog["capacity"])
                     in_channels[("kw", k)] = ch
-                    state["channels"].append(ch)
+                    prog_channels.append(ch)
                     consts_kwargs[k] = None
                 else:
                     consts_kwargs[k] = cloudpickle.loads(v)
             if prog.get("tick_path"):
                 ch = ShmChannel(prog["tick_path"], capacity=prog["capacity"])
                 in_channels[("tick",)] = ch
-                state["channels"].append(ch)
+                prog_channels.append(ch)
             out_channels = []
             for p in prog["out_paths"]:
                 ch = ShmChannel(p, capacity=prog["capacity"])
                 out_channels.append(ch)
-                state["channels"].append(ch)
+                prog_channels.append(ch)
             method = prog["method"]
             fn = getattr(instance, method)
             if entry is not None:
@@ -560,7 +916,7 @@ class Worker:
                 name=f"dag-{dag_id[:8]}-{method}",
                 daemon=True,
             )
-            state["threads"].append(t)
+            state["threads"].append((t, prog_channels))
             t.start()
         return {"status": "ok"}
 
@@ -568,13 +924,25 @@ class Worker:
         state = self._dag_programs.pop(req["dag_id"], None)
         if state is not None:
             state["stop"].set()
-            for t in state["threads"]:
+            for t, channels in state["threads"]:
                 t.join(timeout=2.0)
-            for ch in state["channels"]:
-                try:
-                    ch.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                if t.is_alive():
+                    # a stage is still mid-method: closing (munmapping) its
+                    # rings under it would segfault the whole worker — leave
+                    # them mapped; the thread exits on its next stop-flag
+                    # check and the mappings die with it
+                    logger.warning(
+                        "dag %s stage %s still running at teardown; "
+                        "leaving its channels mapped",
+                        req["dag_id"][:8],
+                        t.name,
+                    )
+                    continue
+                for ch in channels:
+                    try:
+                        ch.close()
+                    except Exception:  # noqa: BLE001
+                        pass
         return {"status": "ok"}
 
     def _h_kill_actor(self, req: dict) -> None:

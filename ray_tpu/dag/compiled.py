@@ -501,12 +501,17 @@ class CompiledDAG:
                 f"DAG expects {self._required_args} input(s), got {len(args)}"
             )
         # validate + serialize everything BEFORE touching any channel: a
-        # failure mid-fan-out would desynchronize every later execution
+        # failure mid-fan-out would desynchronize every later execution.
+        # One input fanning out to k edges serializes once, not k times.
         payloads: List[tuple] = []
+        encoded: Dict[int, bytes] = {}
         for input_idx, e in self._input_edges:
             value = None if input_idx == _TICK else args[input_idx]
             if isinstance(e.channel, ShmChannel):
-                data = bytes([OK]) + cloudpickle.dumps(value)
+                data = encoded.get(input_idx)
+                if data is None:
+                    data = bytes([OK]) + cloudpickle.dumps(value)
+                    encoded[input_idx] = data
                 if len(data) + 4 > e.channel._cap:
                     raise ValueError(
                         f"input of {len(data)} bytes exceeds ring capacity "
@@ -577,19 +582,22 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
-        for _, e in self._input_edges:
-            try:
-                if e.channel is not None:
-                    e.channel.put(STOP, None, timeout=1.0)
-            except (ChannelTimeout, ChannelClosed, OSError, ValueError):
-                pass
-            try:
-                # wake any consumer parked past the STOP (e.g. a stage
-                # blocked because the STOP could not be enqueued)
-                if e.channel is not None:
-                    e.channel.close_write()
-            except Exception:  # noqa: BLE001
-                pass
+        # the SPSC rings allow ONE writer: hold the submit lock so the STOP
+        # writes cannot interleave with a still-running execute() fan-out
+        with self._submit_lock:
+            for _, e in self._input_edges:
+                try:
+                    if e.channel is not None:
+                        e.channel.put(STOP, None, timeout=1.0)
+                except (ChannelTimeout, ChannelClosed, OSError, ValueError):
+                    pass
+                try:
+                    # wake any consumer parked past the STOP (e.g. a stage
+                    # blocked because the STOP could not be enqueued)
+                    if e.channel is not None:
+                        e.channel.close_write()
+                except Exception:  # noqa: BLE001
+                    pass
         for agent, actor_id in self._installed:
             try:
                 agent.call(
