@@ -73,24 +73,40 @@ class _RemoteStore:
         num_returns: int,
         timeout: Optional[float],
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """One multiplexed server-side long-poll per window: the head
+        blocks until num_returns ids resolve (WaitObjectBatch num_returns),
+        so readiness propagates at RPC latency without client sleep
+        loops."""
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
-        while len(ready) < num_returns:
-            still: List[ObjectRef] = []
-            for r in pending:
-                if len(ready) >= num_returns:
-                    still.append(r)
-                    continue
-                remaining = 0.05
-                if deadline is not None:
-                    remaining = min(remaining, max(0.0, deadline - time.monotonic()))
-                reply = self._rt.head.call(
-                    "WaitObject",
-                    {"object_id": r.hex, "timeout": remaining},
-                    timeout=15.0,
-                )
-                if reply["status"] != "pending":
+        while pending and len(ready) < num_returns:
+            # direct-call results resolve locally without a head round trip
+            if self._rt._direct_enabled:
+                still: List[ObjectRef] = []
+                for r in pending:
+                    if r.hex in self._rt._direct_results:
+                        ready.append(r)
+                    else:
+                        still.append(r)
+                pending = still
+                if not pending or len(ready) >= num_returns:
+                    break
+            window = 5.0
+            if deadline is not None:
+                window = min(window, max(0.0, deadline - time.monotonic()))
+            replies = self._rt.head.call(
+                "WaitObjectBatch",
+                {
+                    "object_ids": [r.hex for r in pending],
+                    "timeout": window,
+                    "num_returns": max(1, num_returns - len(ready)),
+                },
+                timeout=window + 15.0,
+            )
+            still = []
+            for r, rep in zip(pending, replies):
+                if len(ready) < num_returns and rep["status"] != "pending":
                     ready.append(r)
                 else:
                     still.append(r)
@@ -427,6 +443,11 @@ class RemoteRuntime:
         )
         self._direct_channels: Dict[str, _DirectActorChannel] = {}
         self._direct_results: Dict[str, tuple] = {}  # hex -> (kind, payload)
+        # FIFO bound on the local result cache: fire-and-forget callers
+        # never get() their refs, and every result also reached the head's
+        # directory — evicted entries just resolve through the head
+        self._direct_results_order: deque = deque()
+        self._direct_results_cap = 4096
         self._direct_pending: Dict[str, str] = {}  # hex -> actor_id
         self._direct_arg_pins: Dict[str, List[str]] = {}  # hex -> arg ids
         self._direct_cv = threading.Condition()
@@ -594,6 +615,10 @@ class RemoteRuntime:
                     self._direct_results[h] = ("err", r["error"])
                 else:
                     self._direct_results[h] = ("seal", r["seal"])
+                self._direct_results_order.append(h)
+                while len(self._direct_results) > self._direct_results_cap:
+                    old = self._direct_results_order.popleft()
+                    self._direct_results.pop(old, None)
                 aid = self._direct_pending.pop(h, None)
                 if aid is not None:
                     chan = self._direct_channels.get(aid)
@@ -754,15 +779,22 @@ class RemoteRuntime:
         )
 
     def wait_actor_alive(self, handle: RemoteActorHandle, timeout: float = 30.0):
+        """Event-driven: each round is a server-side long-poll (WaitActor),
+        so state changes propagate at RPC latency with no sleep loop."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            info = self._read("GetActor", {"actor_id": handle._actor_id})
+        while True:
+            window = min(5.0, max(0.1, deadline - time.monotonic()))
+            info = self._read(
+                "WaitActor",
+                {"actor_id": handle._actor_id, "timeout": window},
+                timeout=window + 15.0,
+            )
             if info.state == "ALIVE":
                 return info
             if info.state == "DEAD":
                 raise RuntimeError(f"actor {handle._actor_id} died during creation")
-            time.sleep(0.05)
-        raise TimeoutError("actor did not become alive in time")
+            if time.monotonic() >= deadline:
+                raise TimeoutError("actor did not become alive in time")
 
     # ------------------------------------------------------------------
     # objects
@@ -926,14 +958,19 @@ class RemoteRuntime:
 
     def wait_placement_group(self, pg_id: str, timeout: float = 30.0) -> List[str]:
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:
+            window = min(5.0, max(0.1, deadline - time.monotonic()))
             reply = self._read(
-                "WaitPlacementGroup", {"pg_id": pg_id, "timeout": 2.0}
+                "WaitPlacementGroup",
+                {"pg_id": pg_id, "timeout": window},
+                timeout=window + 15.0,
             )
             if reply["ready"]:
                 return reply["node_per_bundle"]
-            time.sleep(0.05)
-        raise TimeoutError(f"placement group {pg_id} not ready in {timeout}s")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"placement group {pg_id} not ready in {timeout}s"
+                )
 
     def remove_placement_group(self, pg_id: str) -> None:
         self.head.call("RemovePlacementGroup", {"pg_id": pg_id})

@@ -89,6 +89,9 @@ class _ObjEntry:
     holders: Dict[str, int] = field(default_factory=dict)
     # in-flight lease arg pins + containing-object pins
     pins: int = 0
+    # return-object owner hold registered (exactly once across the direct
+    # seal path, its at-least-once retries, AND a head-path fallback lease)
+    owner_registered: bool = False
     # ids of ObjectRefs serialized inside this object's sealed value
     contained: List[str] = field(default_factory=list)
     # a holder/pin was registered at least once. Entries that were never
@@ -195,6 +198,7 @@ class HeadServer:
             "RefUpdate": lambda r: self._h_ref_update(r, src="direct"),
             "CreateActor": self._h_create_actor,
             "GetActor": self._h_get_actor,
+            "WaitActor": self._h_wait_actor,
             "KillActor": self._h_kill_actor,
             "CreatePlacementGroup": self._h_create_pg,
             "WaitPlacementGroup": self._h_wait_pg,
@@ -515,6 +519,8 @@ class HeadServer:
                 # release the name so a replacement can rebind it
                 if info.name and self._named_actors.get(info.name) == info.actor_id:
                     del self._named_actors[info.name]
+            # wake WaitActor long-polls (push-based actor-state plane)
+            self._cond.notify_all()
         self.mark_dirty()
         if not restart and spec is not None:
             # the actor is gone for good: its ctor args no longer need to
@@ -559,9 +565,13 @@ class HeadServer:
                         stale.append((s.node_id, s.object_id))
                     continue
                 e = self._objects.setdefault(s.object_id, _ObjEntry())
-                if s.owner:
+                if s.owner and not e.owner_registered:
                     # direct-call return object: the caller is its holder
-                    # (no lease ever registered one)
+                    # (no lease ever registered one). Guarded: seal reports
+                    # are at-least-once (worker retries on transport blips)
+                    # and a fallback lease may also register the owner —
+                    # counting twice would leak the object forever.
+                    e.owner_registered = True
                     self._add_holder(s.object_id, s.owner)
                 if s.is_error:
                     e.error = s.error
@@ -775,7 +785,10 @@ class HeadServer:
                     1 for r in replies.values() if r["status"] == "pending"
                 )
                 now = time.monotonic()
-                if not unresolved or now >= deadline:
+                # ray.wait semantics: return as soon as num_returns distinct
+                # ids resolved (default: all of them)
+                want = req.get("num_returns") or len(set(ids))
+                if len(set(ids)) - unresolved >= want or now >= deadline:
                     break
                 # seals notify _cond (_apply_seals), so this wakes promptly
                 self._cond.wait(timeout=min(0.25, deadline - now))
@@ -860,8 +873,9 @@ class HeadServer:
                 e = self._objects.setdefault(oid, _ObjEntry())
                 e.creating_lease = spec.task_id
                 e.tracked = True
-                if holder:
+                if holder and not e.owner_registered:
                     logger.debug("register %s holder %s", oid[:8], holder)
+                    e.owner_registered = True
                     self._add_holder(oid, holder)
             if spec.return_ids:
                 self._lease_live_returns[spec.task_id] = len(spec.return_ids)
@@ -1489,6 +1503,24 @@ class HeadServer:
             self._infeasible.clear()
             self._cond.notify_all()
         self.mark_dirty()
+
+    def _h_wait_actor(self, req: dict) -> ActorInfo:
+        """Long-poll an actor's state: blocks server-side until it leaves
+        PENDING/RESTARTING or the window closes (publisher.h actor-state
+        channel analog; replaces 20 Hz GetActor polling from clients)."""
+        actor_id = req["actor_id"]
+        deadline = time.monotonic() + min(float(req.get("timeout") or 2.0), 10.0)
+        with self._cond:
+            while True:
+                info = self._actors.get(actor_id)
+                if info is None:
+                    raise ValueError(f"unknown actor {actor_id}")
+                if info.state in ("ALIVE", "DEAD"):
+                    return info
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return info
+                self._cond.wait(remaining)
 
     def _h_get_actor(self, req: dict) -> ActorInfo:
         actor_id = req.get("actor_id")

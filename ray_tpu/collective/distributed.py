@@ -7,16 +7,18 @@ host-level rendezvous across worker processes/hosts — here built on a named
 rendezvous actor reachable from every process in the cluster (DCN traffic
 rides the same gRPC object plane as everything else).
 
-Actor methods run serially, so the protocol is non-blocking
-contribute/poll: every rank posts its contribution, then polls until the
-group is complete. Op ids come from per-op monotonic counters, which are
-consistent across ranks because collective calls are SPMD-ordered (the
-same assumption NCCL makes).
+The rendezvous actor is an *asyncio* actor: every rank makes ONE
+``collect`` call that parks on an asyncio.Event until the group is
+complete, then returns all contributions — push-based wakeup, no client
+polling (pubsub/publisher.h analog for the collective plane; the previous
+contribute+poll protocol burned a 100 Hz loop per rank). Op ids come from
+per-op monotonic counters, which are consistent across ranks because
+collective calls are SPMD-ordered (the same assumption NCCL makes).
 """
 from __future__ import annotations
 
-import time
-from typing import Any, Dict, List, Optional
+import asyncio
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -29,41 +31,69 @@ _REDUCE_OPS = {
     "max": lambda xs: np.max(xs, axis=0),
 }
 
-_POLL_S = 0.01
-
 
 class CollectiveGroupActor:
-    """Rendezvous state for one group (runs as a named actor)."""
+    """Rendezvous state for one group (runs as a named asyncio actor);
+    all methods multiplex on the actor's event loop, so Events are safe."""
 
     def __init__(self, world_size: int):
         self.world = world_size
         self.slots: Dict[str, Dict[int, Any]] = {}
-        self.fetched: Dict[str, set] = {}
+        self.events: Dict[str, asyncio.Event] = {}
+        self.remaining: Dict[str, set] = {}
         self.mailbox: Dict[tuple, Any] = {}
+        self.mail_events: Dict[tuple, asyncio.Event] = {}
 
-    def world_size(self) -> int:
+    async def world_size(self) -> int:
         return self.world
 
-    def contribute(self, op_id: str, rank: int, value: Any) -> None:
-        self.slots.setdefault(op_id, {})[rank] = value
-
-    def poll(self, op_id: str, rank: int) -> Optional[List[Any]]:
-        s = self.slots.get(op_id)
-        if s is None or len(s) < self.world:
-            return None
+    async def collect(
+        self, op_id: str, rank: int, value: Any, timeout: float = 120.0
+    ):
+        """Contribute and await the full group in one round trip. Returns
+        None on rendezvous timeout (an explicit sentinel — NOT an
+        exception, so callers never have to pattern-match error text); the
+        timed-out rank withdraws its contribution so a retry starts
+        clean and nothing leaks in the actor."""
+        s = self.slots.setdefault(op_id, {})
+        s[rank] = value
+        ev = self.events.setdefault(op_id, asyncio.Event())
+        if len(s) == self.world:
+            ev.set()
+        else:
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                s.pop(rank, None)
+                if not s:
+                    self.slots.pop(op_id, None)
+                    self.events.pop(op_id, None)
+                    self.remaining.pop(op_id, None)
+                return None
         out = [s[r] for r in range(self.world)]
-        done = self.fetched.setdefault(op_id, set())
-        done.add(rank)
-        if len(done) == self.world:
+        rem = self.remaining.setdefault(op_id, set(range(self.world)))
+        rem.discard(rank)
+        if not rem:
             del self.slots[op_id]
-            del self.fetched[op_id]
+            del self.events[op_id]
+            del self.remaining[op_id]
         return out
 
-    # point-to-point
-    def put(self, key: tuple, value: Any) -> None:
+    # point-to-point: the receiver parks on an Event until the sender posts
+    async def put(self, key: tuple, value: Any) -> None:
         self.mailbox[key] = value
+        ev = self.mail_events.pop(key, None)
+        if ev is not None:
+            ev.set()
 
-    def take(self, key: tuple) -> tuple:
+    async def take(self, key: tuple, timeout: float = 30.0) -> tuple:
+        if key not in self.mailbox:
+            ev = self.mail_events.setdefault(key, asyncio.Event())
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                self.mail_events.pop(key, None)
+                return (False, None)
         if key in self.mailbox:
             return (True, self.mailbox.pop(key))
         return (False, None)
@@ -86,21 +116,16 @@ class DistributedGroup:
 
     def _rendezvous(self, op: str, value: Any, timeout: float = 120.0) -> List[Any]:
         op_id = self._op_id(op)
-        ray_tpu.get(
-            self.handle.contribute.remote(op_id, self.rank, value), timeout=60
+        out = ray_tpu.get(
+            self.handle.collect.remote(op_id, self.rank, value, timeout),
+            timeout=timeout + 30,
         )
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            out = ray_tpu.get(
-                self.handle.poll.remote(op_id, self.rank), timeout=60
+        if out is None:
+            raise TimeoutError(
+                f"collective {op_id} in group {self.name!r} timed out "
+                f"({self.world} ranks expected)"
             )
-            if out is not None:
-                return out
-            time.sleep(_POLL_S)
-        raise TimeoutError(
-            f"collective {op_id} in group {self.name!r} timed out "
-            f"({self.world} ranks expected)"
-        )
+        return out
 
     # ------------------------------------------------------------------
     def allreduce(self, tensor, op: str = "sum"):
@@ -135,18 +160,15 @@ class DistributedGroup:
     def recv(self, src_rank: int, timeout: float = 30.0):
         counter_key = f"p2p:{src_rank}->{self.rank}"
         key_n = self._counters.get(counter_key, 0)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            ok, value = ray_tpu.get(
-                self.handle.take.remote((src_rank, self.rank, key_n)),
-                timeout=30,
-            )
-            if ok:
-                # advance only on success so a timed-out recv can be retried
-                # without skipping the in-flight message
-                self._counters[counter_key] = key_n + 1
-                return value
-            time.sleep(_POLL_S)
+        ok, value = ray_tpu.get(
+            self.handle.take.remote((src_rank, self.rank, key_n), timeout),
+            timeout=timeout + 30,
+        )
+        if ok:
+            # advance only on success so a timed-out recv can be retried
+            # without skipping the in-flight message
+            self._counters[counter_key] = key_n + 1
+            return value
         raise TimeoutError(f"recv from rank {src_rank} timed out")
 
 

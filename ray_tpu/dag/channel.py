@@ -157,6 +157,8 @@ class ShmChannel:
         )
         if rc == -1:
             raise ChannelTimeout(f"write timed out on {self.path}")
+        if rc == -3:
+            raise ChannelClosed(self.path)
         if rc == -2:
             raise ValueError(
                 f"message of {len(payload)} bytes exceeds ring capacity "

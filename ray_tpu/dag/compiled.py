@@ -582,22 +582,30 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
-        # the SPSC rings allow ONE writer: hold the submit lock so the STOP
-        # writes cannot interleave with a still-running execute() fan-out
-        with self._submit_lock:
+        # the SPSC rings allow ONE writer: take the submit lock so the STOP
+        # writes cannot interleave with an in-flight execute() fan-out. But
+        # never block teardown behind a stuck submitter (e.g. execute()
+        # parked on a full ring whose stage died): on timeout, skip the
+        # STOPs — close_write below wakes the parked writer (ChannelClosed)
+        # and stops consumers, which is teardown enough.
+        locked = self._submit_lock.acquire(timeout=2.0)
+        try:
+            if locked:
+                for _, e in self._input_edges:
+                    try:
+                        if e.channel is not None:
+                            e.channel.put(STOP, None, timeout=1.0)
+                    except (ChannelTimeout, ChannelClosed, OSError, ValueError):
+                        pass
             for _, e in self._input_edges:
                 try:
-                    if e.channel is not None:
-                        e.channel.put(STOP, None, timeout=1.0)
-                except (ChannelTimeout, ChannelClosed, OSError, ValueError):
-                    pass
-                try:
-                    # wake any consumer parked past the STOP (e.g. a stage
-                    # blocked because the STOP could not be enqueued)
                     if e.channel is not None:
                         e.channel.close_write()
                 except Exception:  # noqa: BLE001
                     pass
+        finally:
+            if locked:
+                self._submit_lock.release()
         for agent, actor_id in self._installed:
             try:
                 agent.call(

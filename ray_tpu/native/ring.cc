@@ -162,6 +162,9 @@ int rtpu_ring_write(void* rp, const void* buf, uint64_t len, double timeout_s) {
       futex_wake(&h->data_gen);
       return 0;
     }
+    // channel torn down: a parked writer must not wait for a reader that
+    // will never drain the ring
+    if (h->closed.load(std::memory_order_acquire)) return -3;
     // full: re-sample, then futex-park on the reader's generation word
     uint32_t seen = h->space_gen.load(std::memory_order_acquire);
     uint64_t rd2 = h->read_pos.load(std::memory_order_acquire);
@@ -228,6 +231,9 @@ void rtpu_ring_close_write(void* rp) {
   r->h->closed.store(1, std::memory_order_release);
   r->h->data_gen.fetch_add(1, std::memory_order_release);
   futex_wake(&r->h->data_gen);
+  // also wake writers parked on a full ring (teardown stall-breaker)
+  r->h->space_gen.fetch_add(1, std::memory_order_release);
+  futex_wake(&r->h->space_gen);
 }
 
 uint64_t rtpu_ring_capacity(void* rp) {
