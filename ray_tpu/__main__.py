@@ -156,6 +156,26 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_config(args) -> int:
+    """Print every declared knob: name, env override, type, value, doc."""
+    import json as _json
+
+    from ray_tpu.config import cfg
+
+    rows = cfg.dump()
+    if args.json:
+        print(_json.dumps(rows, indent=2, default=str))
+        return 0
+    width = max(len(r["env"]) for r in rows)
+    for r in rows:
+        star = "*" if r["source"] == "env" else " "
+        print(
+            f"{star} {r['env']:<{width}}  {r['type']:<5} "
+            f"= {r['value']!r:<24} {r['doc']}"
+        )
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -203,7 +223,14 @@ def main() -> int:
 
     sub.add_parser("bench")
 
+    cf = sub.add_parser(
+        "config", help="dump the typed config registry (ray_config_def analog)"
+    )
+    cf.add_argument("--json", action="store_true")
+
     args = p.parse_args()
+    if args.command == "config":
+        return cmd_config(args)
     if args.command == "version":
         return cmd_version(args)
     if args.command == "start":

@@ -1186,7 +1186,11 @@ class NodeAgent:
     # an orphaned agent (its head gone for good, e.g. a crashed test
     # driver) must not linger holding ports/arena/spill space forever; a
     # restarting head recovers in seconds, so a long grace is safe
-    ORPHAN_TIMEOUT_S = 120.0
+    @property
+    def ORPHAN_TIMEOUT_S(self) -> float:  # noqa: N802 - historical name
+        from ray_tpu.config import cfg
+
+        return cfg.orphan_timeout_s
 
     def _report_loop(self) -> None:
         version = 0

@@ -85,7 +85,10 @@ class _RemoteStore:
             if self._rt._direct_enabled:
                 still: List[ObjectRef] = []
                 for r in pending:
-                    if r.hex in self._rt._direct_results:
+                    if (
+                        len(ready) < num_returns
+                        and r.hex in self._rt._direct_results
+                    ):
                         ready.append(r)
                     else:
                         still.append(r)
@@ -438,16 +441,16 @@ class RemoteRuntime:
         # hosting worker; results arrive on a lazily-started callback
         # server. RAY_TPU_DIRECT_ACTOR_CALLS=0 forces everything through
         # the head-scheduled lease path.
-        self._direct_enabled = (
-            os.environ.get("RAY_TPU_DIRECT_ACTOR_CALLS", "1") != "0"
-        )
+        from ray_tpu.config import cfg
+
+        self._direct_enabled = cfg.direct_actor_calls
         self._direct_channels: Dict[str, _DirectActorChannel] = {}
         self._direct_results: Dict[str, tuple] = {}  # hex -> (kind, payload)
         # FIFO bound on the local result cache: fire-and-forget callers
         # never get() their refs, and every result also reached the head's
         # directory — evicted entries just resolve through the head
         self._direct_results_order: deque = deque()
-        self._direct_results_cap = 4096
+        self._direct_results_cap = cfg.direct_results_cap
         self._direct_pending: Dict[str, str] = {}  # hex -> actor_id
         self._direct_arg_pins: Dict[str, List[str]] = {}  # hex -> arg ids
         self._direct_cv = threading.Condition()
@@ -616,9 +619,18 @@ class RemoteRuntime:
                 else:
                     self._direct_results[h] = ("seal", r["seal"])
                 self._direct_results_order.append(h)
-                while len(self._direct_results) > self._direct_results_cap:
-                    old = self._direct_results_order.popleft()
-                    self._direct_results.pop(old, None)
+                # lazy deque hygiene: drop heads already consumed by get()
+                # (so the deque tracks the dict), then evict over cap
+                while self._direct_results_order:
+                    head = self._direct_results_order[0]
+                    if head not in self._direct_results:
+                        self._direct_results_order.popleft()
+                    elif len(self._direct_results) > self._direct_results_cap:
+                        self._direct_results.pop(
+                            self._direct_results_order.popleft(), None
+                        )
+                    else:
+                        break
                 aid = self._direct_pending.pop(h, None)
                 if aid is not None:
                     chan = self._direct_channels.get(aid)
@@ -658,18 +670,18 @@ class RemoteRuntime:
             if self._direct_channels.get(actor_id) is chan:
                 del self._direct_channels[actor_id]
 
-    # a direct result push can be lost (transient caller-side RPC failure);
-    # the seal still reaches the head, so after this long a getter stops
-    # trusting the push channel and resolves through the head directory
-    DIRECT_WAIT_FALLBACK_S = 10.0
-
     def _wait_direct(
         self, h: str, deadline: Optional[float]
     ) -> Optional[tuple]:
         """Wait for a direct-call result. Returns the (kind, payload) tuple,
         or None if the ref fell back to the head path (or the push is
         taking long enough that the head directory is the better bet)."""
-        give_up = time.monotonic() + self.DIRECT_WAIT_FALLBACK_S
+        # a direct result push can be lost (transient caller-side RPC
+        # failure); the seal still reaches the head, so after this long a
+        # getter stops trusting the push channel and resolves there
+        from ray_tpu.config import cfg
+
+        give_up = time.monotonic() + cfg.direct_wait_fallback_s
         with self._direct_cv:
             while True:
                 if h in self._direct_results:

@@ -10,14 +10,16 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.config import cfg
+
 # Values at or below this ride inline through the head's object table
 # (max_direct_call_object_size analog, ray_config_def.h:218).
-INLINE_OBJECT_MAX = 100 * 1024
+INLINE_OBJECT_MAX = cfg.inline_object_max
 
 # Resource report cadence (raylet_report_resources_period_milliseconds=100,
 # ray_config_def.h:65) and health-check strikes (gcs_health_check_manager.h:60).
-REPORT_PERIOD_S = 0.1
-HEALTH_TIMEOUT_S = 3.0
+REPORT_PERIOD_S = cfg.report_period_s
+HEALTH_TIMEOUT_S = cfg.health_timeout_s
 
 
 def new_id() -> str:

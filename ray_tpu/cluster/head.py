@@ -53,8 +53,10 @@ from .rpc import RpcClient, RpcError, RpcServer
 
 logger = logging.getLogger("ray_tpu.cluster.head")
 
-SCHED_TICK_S = 0.002
-MAX_BATCH = 4096
+from ray_tpu.config import cfg
+
+SCHED_TICK_S = cfg.sched_tick_s
+MAX_BATCH = cfg.sched_max_batch
 
 
 def _best_effort(fn, *args, **kwargs):

@@ -509,7 +509,6 @@ class Worker:
     # flow to the agent so the head's object directory stays authoritative
     # for non-owner consumers.
     # ------------------------------------------------------------------
-    INLINE_REPLY_WAIT_S = 0.005
 
     def _h_direct_push_batch(self, req: dict) -> List[Any]:
         """Accept a batch of direct method calls. Per item the reply entry
@@ -523,7 +522,9 @@ class Worker:
         client_addr = req["client_addr"]
         accepts: List[Any] = []
         waiters: List[Optional[cf.Future]] = []
-        if os.environ.get("RAY_TPU_DIRECT_TRACE"):
+        from ray_tpu.config import cfg
+
+        if cfg.direct_trace:
             for item in req["items"]:
                 item["_t_accept"] = time.perf_counter()
         for item in req["items"]:
@@ -545,7 +546,9 @@ class Worker:
             waiters.append(fut)
         live = [f for f in waiters if f is not None]
         if live:
-            cf.wait(live, timeout=self.INLINE_REPLY_WAIT_S)
+            from ray_tpu.config import cfg
+
+            cf.wait(live, timeout=cfg.direct_inline_wait_s)
         for i, (item, fut) in enumerate(zip(req["items"], waiters)):
             if fut is None:
                 continue  # deferred dispatch attaches its own callback

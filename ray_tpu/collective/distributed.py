@@ -64,12 +64,16 @@ class CollectiveGroupActor:
             try:
                 await asyncio.wait_for(ev.wait(), timeout)
             except asyncio.TimeoutError:
-                s.pop(rank, None)
-                if not s:
-                    self.slots.pop(op_id, None)
-                    self.events.pop(op_id, None)
-                    self.remaining.pop(op_id, None)
-                return None
+                # the timer can fire in the same loop tick the last rank
+                # sets the event: withdrawing then would KeyError innocent
+                # ranks mid-gather — re-check before treating it as a miss
+                if not ev.is_set():
+                    s.pop(rank, None)
+                    if not s:
+                        self.slots.pop(op_id, None)
+                        self.events.pop(op_id, None)
+                        self.remaining.pop(op_id, None)
+                    return None
         out = [s[r] for r in range(self.world)]
         rem = self.remaining.setdefault(op_id, set(range(self.world)))
         rem.discard(rank)

@@ -1,0 +1,211 @@
+"""Typed configuration registry — every tunable in one place.
+
+Analog of the reference's RayConfig flag system
+(/root/reference/src/ray/common/ray_config_def.h:18, ~400 RAY_CONFIG
+declarations with env overrides): each knob is declared once with a type,
+default, and doc line, and can be overridden by an environment variable
+named ``RAY_TPU_<NAME>`` (upper-cased). Reads go through ``cfg.<name>``
+and consult the environment live for most knobs; a few structural
+constants (inline_object_max, sched_tick_s, sched_max_batch,
+dag_buffer_bytes, dag_max_inflight) are bound once at module import, so
+set those in the environment before importing ray_tpu (they shape wire
+formats and pre-sized buffers).
+
+Dump everything with ``python -m ray_tpu config``.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    # a SET-but-empty var stays truthy (matches the pre-registry semantics
+    # of every migrated `!= "0"` check; shell templates often leave
+    # FLAG= empty when meaning "don't change it")
+    return s.strip().lower() not in ("0", "false", "no", "off")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: lambda s: int(s, 0),
+    float: float,
+    str: str,
+}
+
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return f"RAY_TPU_{self.name.upper()}"
+
+    def current(self) -> Any:
+        raw = os.environ.get(self.env_var)
+        if raw is None:
+            return self.default
+        try:
+            return _PARSERS[self.type](raw)
+        except (ValueError, KeyError):
+            import logging
+
+            logging.getLogger("ray_tpu.config").warning(
+                "ignoring invalid %s=%r (expected %s); using default %r",
+                self.env_var,
+                raw,
+                self.type.__name__,
+                self.default,
+            )
+            return self.default
+
+
+_REGISTRY: Dict[str, ConfigEntry] = {}
+
+
+def define(name: str, default: Any, doc: str, type_: Optional[type] = None):
+    entry = ConfigEntry(name, type_ or type(default), default, doc)
+    _REGISTRY[name] = entry
+    return entry
+
+
+def registry() -> Dict[str, ConfigEntry]:
+    return dict(_REGISTRY)
+
+
+class _Config:
+    """Attribute access over the registry; env consulted on every read."""
+
+    def __getattr__(self, name: str) -> Any:
+        entry = _REGISTRY.get(name)
+        if entry is None:
+            raise AttributeError(f"unknown config knob {name!r}")
+        return entry.current()
+
+    def dump(self) -> list:
+        out = []
+        for e in sorted(_REGISTRY.values(), key=lambda x: x.name):
+            raw = os.environ.get(e.env_var)
+            out.append(
+                {
+                    "name": e.name,
+                    "env": e.env_var,
+                    "type": e.type.__name__,
+                    "default": e.default,
+                    "value": e.current(),
+                    "source": "env" if raw is not None else "default",
+                    "doc": e.doc,
+                }
+            )
+        return out
+
+
+cfg = _Config()
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+define("sched_tick_s", 0.002, "Head scheduler loop pause between rounds.")
+define("sched_max_batch", 4096, "Max leases per scheduling kernel round.")
+define(
+    "device_scheduler",
+    True,
+    "Run the live scheduling kernels on an XLA backend (vs NumPy golden).",
+)
+define(
+    "sched_platform",
+    "cpu",
+    "XLA platform for the live scheduler kernels (cpu keeps dispatch "
+    "latency off the accelerator tunnel; tpu offloads the hot loop).",
+)
+define(
+    "sched_init_timeout_s",
+    30.0,
+    "XLA backend bring-up budget before degrading to the host scheduler.",
+)
+define("xla_cache", "/tmp/ray_tpu_xla_cache", "JAX compilation cache dir.")
+define(
+    "native_ledger",
+    True,
+    "Use the C++ fixed-point resource ledger (vs pure-Python fallback).",
+)
+
+# ---------------------------------------------------------------------------
+# cluster control plane
+# ---------------------------------------------------------------------------
+define("head_address", "", "Cluster head address for implicit ray_tpu.init().")
+define(
+    "report_period_s", 0.1, "Agent resource/health report period to the head."
+)
+define(
+    "health_timeout_s",
+    3.0,
+    "Head marks a node dead after this long without a report.",
+)
+define(
+    "orphan_timeout_s",
+    120.0,
+    "An agent that cannot reach any head for this long exits.",
+)
+
+# ---------------------------------------------------------------------------
+# object plane
+# ---------------------------------------------------------------------------
+define(
+    "inline_object_max",
+    100 * 1024,
+    "Values at or below this many serialized bytes travel inline in "
+    "control messages instead of the shared-memory store.",
+)
+define("native_store", True, "Use the C++ shared-memory object store.")
+define(
+    "store_bytes",
+    1 << 28,
+    "Default shared-memory arena capacity per node (bytes).",
+)
+define("refcount_debug", False, "Record per-ref count history (diagnostics).")
+
+# ---------------------------------------------------------------------------
+# direct actor calls
+# ---------------------------------------------------------------------------
+define(
+    "direct_actor_calls",
+    True,
+    "Submit actor methods caller->worker directly, head off the hot path.",
+)
+define(
+    "direct_inline_wait_s",
+    0.005,
+    "Worker lingers this long so fast results ride the accept reply.",
+)
+define(
+    "direct_wait_fallback_s",
+    10.0,
+    "Getter stops trusting the direct result push after this long and "
+    "resolves through the head directory.",
+)
+define(
+    "direct_results_cap",
+    4096,
+    "Driver-side FIFO bound on cached direct-call results.",
+)
+define("direct_trace", False, "Stamp direct-call results with timing marks.")
+
+# ---------------------------------------------------------------------------
+# compiled DAG
+# ---------------------------------------------------------------------------
+define(
+    "dag_buffer_bytes",
+    1 << 22,
+    "Default per-edge shm ring capacity for compiled DAGs.",
+)
+define(
+    "dag_max_inflight",
+    16,
+    "Default max concurrently admitted executions per compiled DAG.",
+)

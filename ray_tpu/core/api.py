@@ -57,7 +57,9 @@ def init(
             return get_runtime()
         raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
     if address is None:
-        address = os.environ.get("RAY_TPU_HEAD_ADDRESS") or None
+        from ray_tpu.config import cfg
+
+        address = cfg.head_address or None
     if address is not None:
         from ray_tpu.cluster.client import RemoteRuntime
 

@@ -48,9 +48,9 @@ class RefTracker:
         self._counts: Dict[str, int] = {}
         self._zeros: deque = deque()
         self.zero_event = threading.Event()
-        import os
+        from ray_tpu.config import cfg
 
-        self._debug = os.environ.get("RAY_TPU_REFCOUNT_DEBUG") == "1"
+        self._debug = cfg.refcount_debug
         self._hist: Dict[str, list] = {}
 
     def _note(self, hex_id: str, op: str, count: int) -> None:

@@ -136,13 +136,15 @@ class Runtime:
         self.vocab = ResourceVocab()
         self.view = ClusterView(self.vocab)
         native = None
-        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+        from ray_tpu.config import cfg
+
+        if cfg.native_store:
             try:
                 from ray_tpu.native import NativeObjectStore
 
                 native = NativeObjectStore(
                     capacity=int(
-                        os.environ.get("RAY_TPU_STORE_BYTES", 1 << 28)
+                        cfg.store_bytes
                     )
                 )
             except Exception:  # noqa: BLE001 - toolchain missing → in-proc only
@@ -963,7 +965,9 @@ def get_runtime() -> Runtime:
         # Inside a cluster worker process the head address is in the env —
         # nested ray_tpu API calls connect as a client automatically (the
         # reference's workers similarly auto-connect to their cluster).
-        addr = os.environ.get("RAY_TPU_HEAD_ADDRESS")
+        from ray_tpu.config import cfg
+
+        addr = cfg.head_address or None
         if addr:
             from ray_tpu.cluster.client import RemoteRuntime
 

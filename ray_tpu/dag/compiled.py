@@ -48,8 +48,10 @@ from .channel import (
     channel_dir,
 )
 
-_DEFAULT_BUFFER = 1 << 22  # 4 MiB per edge ring
-_DEFAULT_INFLIGHT = 16
+from ray_tpu.config import cfg
+
+_DEFAULT_BUFFER = cfg.dag_buffer_bytes  # 4 MiB per edge ring by default
+_DEFAULT_INFLIGHT = cfg.dag_max_inflight
 _TICK = -1  # synthetic input index: driver writes None once per execute
 
 

@@ -50,12 +50,9 @@ def device_scheduler_default() -> bool:
     """Default ON (VERDICT r1): the XLA kernels ARE the product scheduler;
     RAY_TPU_DEVICE_SCHEDULER=0/false/no/off selects the NumPy golden model
     (kept for differential testing)."""
-    return os.environ.get("RAY_TPU_DEVICE_SCHEDULER", "1").strip().lower() not in (
-        "0",
-        "false",
-        "no",
-        "off",
-    )
+    from ray_tpu.config import cfg
+
+    return cfg.device_scheduler
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -102,7 +99,9 @@ def _configure_compile_cache() -> None:
     _cache_configured = True
     import jax
 
-    path = os.environ.get("RAY_TPU_XLA_CACHE", "/tmp/ray_tpu_xla_cache")
+    from ray_tpu.config import cfg
+
+    path = cfg.xla_cache
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
@@ -126,9 +125,9 @@ class LazyDeviceState:
     def __init__(self, enabled: bool, timeout_s: Optional[float] = None):
         self.enabled = enabled
         if timeout_s is None:
-            timeout_s = float(
-                os.environ.get("RAY_TPU_SCHED_INIT_TIMEOUT_S", "30")
-            )
+            from ray_tpu.config import cfg
+
+            timeout_s = cfg.sched_init_timeout_s
         self.timeout_s = timeout_s
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -190,7 +189,10 @@ class DeviceSchedulerState:
         import jax
 
         _configure_compile_cache()
-        platform = platform or os.environ.get("RAY_TPU_SCHED_PLATFORM", "cpu")
+        if platform is None:
+            from ray_tpu.config import cfg
+
+            platform = cfg.sched_platform
         try:
             self.device = jax.devices(platform)[0]
         except RuntimeError:

@@ -204,9 +204,9 @@ def make_ledger(vocab: ResourceVocab, total: Mapping[str, float]):
     LocalResourceManager-analog admission hot path); fall back to the pure
     Python implementation when the toolchain is unavailable.
     Disable with RAY_TPU_NATIVE_LEDGER=0."""
-    import os
+    from ray_tpu.config import cfg
 
-    if os.environ.get("RAY_TPU_NATIVE_LEDGER", "1") != "0":
+    if cfg.native_ledger:
         try:
             from ray_tpu.native.native_ledger import NativeNodeResourceLedger
 
