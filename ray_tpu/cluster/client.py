@@ -631,6 +631,14 @@ class RemoteRuntime:
                         )
                     else:
                         break
+                # a live never-consumed entry at the front blocks the lazy
+                # sweep: periodically compact the deque against the dict
+                if len(self._direct_results_order) > 2 * self._direct_results_cap:
+                    self._direct_results_order = deque(
+                        x
+                        for x in self._direct_results_order
+                        if x in self._direct_results
+                    )
                 aid = self._direct_pending.pop(h, None)
                 if aid is not None:
                     chan = self._direct_channels.get(aid)

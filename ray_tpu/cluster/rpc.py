@@ -34,6 +34,65 @@ class RpcError(Exception):
     """Transport-level failure (peer dead/unreachable)."""
 
 
+class _ChaosDrop(Exception):
+    """Injected message drop — handled exactly like a transport failure
+    (same retry budget), so chaos exercises the real recovery path."""
+
+
+class _Chaos:
+    """Message-level failure injection (rpc_chaos.h:24-41 analog).
+
+    Configured by the RAY_TPU_RPC_CHAOS knob, e.g.
+    ``ExecuteLeaseBatch:drop=0.1;PushTaskBatch:delay_ms=20`` — each listed
+    method gets an independent drop probability (the call raises RpcError
+    without ever reaching the peer — the retry/requeue machinery must
+    recover) and/or an added delay. Parsed once per process."""
+
+    def __init__(self) -> None:
+        import random
+
+        from ray_tpu.config import cfg
+
+        self.rules: Dict[str, Dict[str, float]] = {}
+        self._rng = random.Random(0xC4A05)
+        spec = cfg.rpc_chaos
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part or ":" not in part:
+                continue
+            method, params = part.split(":", 1)
+            rule: Dict[str, float] = {}
+            for kv in params.split(","):
+                if "=" in kv:
+                    k, v = kv.split("=", 1)
+                    try:
+                        rule[k.strip()] = float(v)
+                    except ValueError:
+                        pass
+            if rule:
+                self.rules[method.strip()] = rule
+
+    def apply(self, method: str) -> None:
+        rule = self.rules.get(method)
+        if rule is None:
+            return
+        delay = rule.get("delay_ms", 0.0)
+        if delay > 0:
+            time.sleep(delay / 1e3)
+        if self._rng.random() < rule.get("drop", 0.0):
+            raise _ChaosDrop(f"chaos: dropped {method} before send")
+
+
+_chaos: Optional[_Chaos] = None
+
+
+def _get_chaos() -> _Chaos:
+    global _chaos
+    if _chaos is None:
+        _chaos = _Chaos()
+    return _chaos
+
+
 class _GenericHandler(grpc.GenericRpcHandler):
     def __init__(self, handlers: Dict[str, Callable[[Any], Any]]):
         self._handlers = handlers
@@ -120,12 +179,13 @@ class RpcClient:
         attempt = 0
         while True:
             try:
+                _get_chaos().apply(method)
                 raw = self._method(method)(data, timeout=timeout)
                 ok, value = pickle.loads(raw)
                 if not ok:
                     raise value
                 return value
-            except grpc.RpcError as exc:
+            except (grpc.RpcError, _ChaosDrop) as exc:
                 if attempt >= retries:
                     raise RpcError(
                         f"rpc {method} to {self.address} failed: "

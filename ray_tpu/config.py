@@ -21,9 +21,6 @@ from typing import Any, Callable, Dict, Optional
 
 
 def _parse_bool(s: str) -> bool:
-    # a SET-but-empty var stays truthy (matches the pre-registry semantics
-    # of every migrated `!= "0"` check; shell templates often leave
-    # FLAG= empty when meaning "don't change it")
     return s.strip().lower() not in ("0", "false", "no", "off")
 
 
@@ -49,6 +46,11 @@ class ConfigEntry:
     def current(self) -> Any:
         raw = os.environ.get(self.env_var)
         if raw is None:
+            return self.default
+        if self.type is bool and raw.strip() == "":
+            # a SET-but-empty boolean var keeps the default (shell templates
+            # leave FLAG= empty to mean "don't change it"); anything else
+            # would silently flip opt-in flags like direct_trace on
             return self.default
         try:
             return _PARSERS[self.type](raw)
@@ -151,6 +153,14 @@ define(
     "orphan_timeout_s",
     120.0,
     "An agent that cannot reach any head for this long exits.",
+)
+
+define(
+    "rpc_chaos",
+    "",
+    "Message-level failure injection, e.g. "
+    "'ExecuteLeaseBatch:drop=0.1;PushTaskBatch:delay_ms=20' "
+    "(rpc_chaos.h analog; parsed once per process).",
 )
 
 # ---------------------------------------------------------------------------

@@ -259,20 +259,35 @@ class Dataset:
         ]
         return ray_tpu.get(refs)
 
-    def _executed_blocks(self) -> List[List[Any]]:
-        """Apply pending ops, returning materialized blocks (shuffle input)."""
+    def _executed_blocks(self) -> List[Any]:
+        """Apply pending ops, returning blocks as ObjectRefs — blocks stay
+        in the object store end-to-end (streaming_executor.py:77
+        semantics); nothing funnels through the driver. Host-list input
+        blocks with no pending ops pass through as-is (they are already
+        driver-resident; shipping them is the consumer's decision)."""
         if not self._ops:
             return list(self._input_blocks)
-        return list(self.iter_blocks())
+        return [_apply_chain.remote(b, self._ops) for b in self._input_blocks]
 
     def union(self, other: "Dataset") -> "Dataset":
-        return from_items(
-            self._materialize_rows() + other._materialize_rows(),
-            override_num_blocks=len(self._input_blocks)
-            + len(other._input_blocks),
+        """Concatenate block lists — no row materialization; each side's
+        pending ops are submitted as block tasks and the refs carried
+        over."""
+        return Dataset(
+            list(self._executed_blocks()) + list(other._executed_blocks()),
+            [],
         )
 
     def split(self, n: int) -> List["Dataset"]:
+        """Block-granularity split (the reference's equal=False default,
+        dataset.py split): blocks stay refs. When there are fewer blocks
+        than splits, fall back to row-level rebalancing."""
+        if len(self._input_blocks) >= n:
+            blocks = self._executed_blocks()
+            return [
+                Dataset([blocks[i] for i in idx], [])
+                for idx in np.array_split(np.arange(len(blocks)), n)
+            ]
         rows = self._materialize_rows()
         splits = np.array_split(np.arange(len(rows)), n)
         return [
@@ -285,7 +300,8 @@ class Dataset:
         """Streaming executor: bounded in-flight block tasks (backpressure,
         resource_manager.py semantics collapsed to a window). Blocks may be
         host lists or ObjectRefs (shuffle outputs stay in the object store
-        until consumed — no driver funnel)."""
+        until consumed — the driver only materializes a block at its own
+        consumption point, here)."""
         if not self._ops:
             for b in self._input_blocks:
                 yield ray_tpu.get(b) if isinstance(b, ray_tpu.ObjectRef) else b
@@ -334,9 +350,9 @@ class Dataset:
         return sum(len(b) for b in self.iter_blocks())
 
     def materialize(self) -> "Dataset":
-        return from_items(
-            self.take_all(), override_num_blocks=len(self._input_blocks)
-        )
+        """Execute pending ops; blocks land in the object store as refs
+        (MaterializedDataset semantics — NOT a driver copy)."""
+        return Dataset(self._executed_blocks(), [])
 
     def num_blocks(self) -> int:
         return len(self._input_blocks)
