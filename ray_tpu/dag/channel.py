@@ -32,6 +32,7 @@ import cloudpickle
 OK = 0
 ERR = 1
 STOP = 2
+TENSOR = 3  # raw device/host tensor via the RDT codec (no pickle)
 
 
 class ChannelClosed(Exception):
@@ -146,6 +147,15 @@ class ShmChannel:
         self._closed = False
 
     def put(self, tag: int, value: Any, timeout: Optional[float] = None) -> None:
+        if tag == OK:
+            # device arrays skip pickle: raw dtype/shape + buffer bytes
+            # (rdt codec; device→host DMA here, host→device on the reader)
+            from ray_tpu.rdt import encode_tensor
+
+            t = encode_tensor(value)
+            if t is not None:
+                self.put_bytes(bytes([TENSOR]) + t, timeout)
+                return
         payload = bytes([tag]) + (
             cloudpickle.dumps(value) if tag != STOP else b""
         )
@@ -171,6 +181,16 @@ class ShmChannel:
         tag = data[0]
         if tag == STOP:
             return STOP, None
+        if tag == TENSOR:
+            from ray_tpu.rdt import decode_tensor
+
+            ok, value = decode_tensor(data[1:])
+            if not ok:
+                raise ChannelClosed(
+                    f"corrupt tensor frame on {self.path} "
+                    f"({len(data)} bytes)"
+                )
+            return OK, value
         return tag, pickle.loads(data[1:])
 
     def get_bytes(self, timeout: Optional[float] = None) -> bytes:

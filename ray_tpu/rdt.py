@@ -1,0 +1,129 @@
+"""RDT — device-tensor transport between actors/tasks.
+
+TPU-native rethink of the reference's RDT tier
+(/root/reference/python/ray/experimental/rdt/nixl_tensor_transport.py,
+gpu_object_manager/): the reference moves GPU buffers process-to-process
+over NIXL/NCCL side channels. On TPU the transports that exist are:
+
+1. **same process** — hand the ``jax.Array`` over by reference: zero
+   copies, the buffer never moves (local-runtime compiled-DAG edges and
+   direct returns already do this).
+2. **cross process, same host** — one device the processes cannot share:
+   the minimal path is device→host DMA into the *shared-memory arena*
+   (no pickle, no socket), then host→device DMA on the consumer. This
+   module implements that: raw dtype/shape header + buffer bytes staged
+   zero-copy through the node's shm store / DAG ring.
+3. **cross host** — ride the ICI/DCN mesh INSIDE jit: shard or permute
+   with XLA collectives (``ray_tpu.ops``, ``collective``); a framework
+   side channel cannot beat the compiler's own transfer engine, so RDT
+   deliberately does not reinvent it (scaling-book recipe).
+
+``put_tensor``/``get_tensor`` give the explicit API; the tensor codec is
+also used by compiled-DAG shm edges so device arrays crossing a ring skip
+cloudpickle entirely.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+_MAGIC = b"RDT1"
+
+
+def _is_device_array(value: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(value, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def encode_tensor(value: Any) -> Optional[bytes]:
+    """Raw wire form for jax/numpy arrays (None: not a tensor). One
+    device→host DMA for jax arrays; numpy arrays encode without a copy of
+    the payload beyond the write itself."""
+    if _is_device_array(value):
+        host = np.asarray(value)
+        kind = "jax"
+    elif type(value) is np.ndarray:  # subclasses (MaskedArray) need pickle
+        host = value
+        kind = "np"
+    else:
+        return None
+    # only plain numeric/bool buffers: structured dtypes, object dtypes,
+    # and datetime-ish kinds don't survive a raw name+bytes round trip
+    d = host.dtype
+    if d.names is not None or d.hasobject or d.kind not in "biufcV":
+        return None
+    if d.kind == "V" and d.name.startswith("void"):
+        return None  # raw void blobs (e.g. structured leftovers)
+    host = np.ascontiguousarray(host)
+    # dtype by NAME: ml_dtypes types (bfloat16, float8_*) have no loadable
+    # numpy .str form, but their names resolve via ml_dtypes on decode
+    header = json.dumps(
+        {"k": kind, "d": host.dtype.name, "s": list(host.shape)}
+    ).encode()
+    return _MAGIC + len(header).to_bytes(4, "little") + header + host.tobytes()
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def decode_tensor(data: bytes) -> Tuple[bool, Any]:
+    """(is_tensor, value). jax tensors land back on the default device via
+    one host→device DMA; numpy stays host-side."""
+    if not data.startswith(_MAGIC):
+        return False, None
+    hlen = int.from_bytes(data[4:8], "little")
+    meta = json.loads(data[8 : 8 + hlen])
+    arr = np.frombuffer(
+        data, dtype=_resolve_dtype(meta["d"]), offset=8 + hlen
+    ).reshape(meta["s"])
+    if meta["k"] == "jax":
+        import jax
+
+        return True, jax.device_put(arr)
+    return True, arr.copy()  # writable, decoupled from the wire buffer
+
+
+def put_tensor(value: Any) -> "ray_tpu.ObjectRef":
+    """Stage a device/host tensor into the object plane with the raw codec
+    (no pickle). Plain ``ray_tpu.put`` works too — this path skips the
+    serializer and keeps dtype/shape as a 1-line header."""
+    data = encode_tensor(value)
+    if data is None:
+        raise TypeError(f"put_tensor expects a jax or numpy array, got {type(value)}")
+    return ray_tpu.put(_RdtBlob(data))
+
+
+def get_tensor(ref: "ray_tpu.ObjectRef", timeout: Optional[float] = None) -> Any:
+    out = ray_tpu.get(ref, timeout=timeout)
+    if isinstance(out, _RdtBlob):
+        ok, value = decode_tensor(out.data)
+        if ok:
+            return value
+    return out
+
+
+class _RdtBlob:
+    """Pickle-thin wrapper: the payload is already raw bytes, so pickling
+    this object is a header + one memcpy (no element-wise serialization)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+    def __reduce__(self):
+        return (_RdtBlob, (self.data,))

@@ -101,7 +101,9 @@ def test_job_survives_dropped_dispatch_rpcs(tmp_path):
     script = tmp_path / "chaos_job.py"
     script.write_text(_CHAOS_SCRIPT)
     env = dict(os.environ)
-    env["RAY_TPU_RPC_CHAOS"] = "ExecuteLeaseBatch:drop=0.1"
+    env["RAY_TPU_RPC_CHAOS"] = (
+        "ExecuteLeaseBatch:drop=0.1;TaskDoneBatch:drop=0.1"
+    )
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
