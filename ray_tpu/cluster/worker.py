@@ -989,6 +989,19 @@ def main() -> None:
     parser.add_argument("--worker-id", required=True)
     parser.add_argument("--store", default="")
     args = parser.parse_args()
+    # An inherited JAX_PLATFORMS env var must be enforced via jax.config:
+    # accelerator plugin hooks (e.g. the axon TPU tunnel) can initialize
+    # their backend during ANY jax call regardless of the env var, and a
+    # wedged transport then hangs the worker's first user jax call forever.
+    # config.update pins the platform set before any backend comes up.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 - jax optional for pure-CPU tasks
+            pass
     logging.basicConfig(level=logging.WARNING)
     # stuck-worker diagnosis: `kill -USR1 <pid>` dumps all thread stacks
     import faulthandler

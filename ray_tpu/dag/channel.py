@@ -186,7 +186,9 @@ class ShmChannel:
 
             ok, value = decode_tensor(data[1:])
             if not ok:
-                raise ChannelClosed(
+                # NOT ChannelClosed: that reads as clean shutdown to stage
+                # loops; corruption must surface as a stage error
+                raise ValueError(
                     f"corrupt tensor frame on {self.path} "
                     f"({len(data)} bytes)"
                 )

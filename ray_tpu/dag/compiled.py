@@ -134,6 +134,14 @@ def run_dag_stage(
                     except ChannelTimeout:
                         if stop_flag.is_set():
                             return
+                    except ValueError as exc:
+                        # corrupt frame: this execution fails, the
+                        # pipeline survives
+                        items[slot] = (
+                            ERR,
+                            TaskError(exc, name, traceback_str=str(exc)),
+                        )
+                        break
                 if items[slot][0] == STOP:
                     stopped = True
                     break

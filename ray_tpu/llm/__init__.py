@@ -6,6 +6,7 @@ jitted KV-cache prefill + decode on the flagship model
 (ray_tpu.models.transformer), with batch inference as a Data pipeline stage
 (vllm_engine_proc analog) and serving as a Serve deployment.
 """
+from .continuous import ContinuousBatchingEngine, PagedKVPool  # noqa: F401
 from .engine import GenerationConfig, LLMEngine  # noqa: F401
 from .processor import LLMProcessor  # noqa: F401
 from .serving import build_llm_deployment  # noqa: F401

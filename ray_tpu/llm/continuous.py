@@ -1,0 +1,483 @@
+"""Continuous batching engine with a paged KV cache.
+
+The serving tier the reference delegates to vLLM-class engines
+(/root/reference/python/ray/llm/_internal/serve/, vllm passthrough) —
+rebuilt TPU-first in the JetStream/PagedAttention mold:
+
+- **Paged KV pool**: one device buffer of fixed-size pages
+  ``[n_layers, n_pages, page, kv_heads, head_dim]`` shared by every
+  sequence; a per-slot block table maps logical positions to pages. All
+  shapes static — XLA compiles exactly two programs (per prefill bucket):
+  one prefill, one decode step.
+- **Continuous batching**: B decode slots; requests admit into free slots
+  as others finish (no batch restart), so the decode step always runs at
+  the live batch size. Admission backpressures on free pages — the pool,
+  not the batch, is the capacity.
+- **Decode step**: one token for ALL active slots per jit call; the KV
+  write is a per-slot scatter into (page, offset) and attention gathers
+  each slot's pages back into a contiguous [S_max] view (the TPU-friendly
+  formulation of paged attention: gathers + one big einsum, no dynamic
+  shapes).
+
+Reference files for parity intent: vllm paged attention + continuous
+batching scheduler; JetStream's slot/page design is the public TPU
+pattern this follows.
+"""
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import transformer as tfm
+
+from .engine import ByteTokenizer, GenerationConfig
+
+
+@dataclass
+class _Slot:
+    active: bool = False
+    req_id: int = -1
+    pos: int = 0  # next position to write
+    max_pos: int = 0  # hard stop (prompt + max_new)
+    pages: List[int] = field(default_factory=list)
+    out: List[int] = field(default_factory=list)
+    eos: Optional[int] = None
+
+
+@dataclass
+class _Request:
+    req_id: int
+    prompt: List[int]
+    gen: GenerationConfig
+
+
+class PagedKVPool:
+    """Fixed pool of KV pages + host-side free-list allocator."""
+
+    def __init__(self, cfg: tfm.ModelConfig, n_pages: int, page: int):
+        self.page = page
+        self.n_pages = n_pages
+        shape = (cfg.n_layers, n_pages, page, cfg.n_kv_heads, cfg.head_dim)
+        self.k = jnp.zeros(shape, cfg.dtype)
+        self.v = jnp.zeros(shape, cfg.dtype)
+        # page 0 is the SCRATCH page: inactive decode slots are redirected
+        # there so their no-op writes can never collide with a live slot's
+        # page in the same scatter (duplicate-index order is unspecified)
+        self._free = list(range(1, n_pages))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.n_pages - 1  # minus the scratch page
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if len(self._free) < n:
+            return None
+        out = self._free[:n]
+        del self._free[:n]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(pages)
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over the flagship transformer."""
+
+    def __init__(
+        self,
+        cfg: tfm.ModelConfig,
+        params: Optional[Any] = None,
+        *,
+        max_batch: int = 8,
+        page_size: int = 16,
+        n_pages: int = 256,
+        max_pages_per_seq: Optional[int] = None,
+        tokenizer: Optional[Any] = None,
+    ):
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "paged continuous batching currently supports dense MLP "
+                "models (use LLMEngine for MoE)"
+            )
+        self.cfg = cfg
+        self.B = max_batch
+        self.page = page_size
+        self.pool = PagedKVPool(cfg, n_pages, page_size)
+        self.max_pages_per_seq = min(
+            max_pages_per_seq
+            or (min(cfg.max_seq_len, n_pages * page_size) // page_size),
+            self.pool.usable_pages,
+        )
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.params = (
+            params
+            if params is not None
+            else tfm.init_params(cfg, jax.random.PRNGKey(0))
+        )
+        self.slots = [_Slot() for _ in range(self.B)]
+        self.queue: deque = deque()
+        self.results: Dict[int, List[int]] = {}
+        self._next_req = 0
+        # device-side slot state
+        self.block_tables = jnp.full(
+            (self.B, self.max_pages_per_seq), 0, dtype=jnp.int32
+        )
+        self.positions = jnp.zeros((self.B,), jnp.int32)
+        self.cur_tokens = jnp.zeros((self.B,), jnp.int32)
+        self.active_mask = jnp.zeros((self.B,), bool)
+        # per-slot sampling temperature (0 = greedy) + a per-step key
+        self.temps = jnp.zeros((self.B,), jnp.float32)
+        self._step_count = 0
+        self._build_fns()
+
+    # ------------------------------------------------------------------
+    # jitted programs
+    # ------------------------------------------------------------------
+    def _build_fns(self) -> None:
+        cfg = self.cfg
+        page = self.page
+        P_max = self.max_pages_per_seq
+        S_max = P_max * page
+
+        def _attention_pages(q, k_pages, v_pages, q_pos):
+            """q: [B,H,hd] one token per slot; k/v_pages: [B,P,page,KH,hd];
+            q_pos: [B] absolute position of the query token."""
+            b = q.shape[0]
+            kh = cfg.n_kv_heads
+            groups = cfg.n_heads // kh
+            ks = k_pages.reshape(b, S_max, kh, cfg.head_dim)
+            vs = v_pages.reshape(b, S_max, kh, cfg.head_dim)
+            qh = q.reshape(b, kh, groups, cfg.head_dim)
+            scores = jnp.einsum(
+                "bhgd,bshd->bhgs",
+                qh.astype(jnp.float32),
+                ks.astype(jnp.float32),
+            ) / jnp.sqrt(cfg.head_dim)
+            valid = jnp.arange(S_max)[None, :] <= q_pos[:, None]
+            scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum(
+                "bhgs,bshd->bhgd", probs, vs.astype(jnp.float32)
+            )
+            return attn.reshape(b, cfg.n_heads * cfg.head_dim)
+
+        @jax.jit
+        def decode_step(
+            params, pool_k, pool_v, tables, positions, tokens, active,
+            temps, key,
+        ):
+            """One token for every slot. Inactive slots run the same
+            math (one trace) but their KV writes are redirected to the
+            reserved scratch page 0, so they can never collide with a
+            live slot's pages in the scatter."""
+            b = self.B
+            h = params["embed"][tokens].astype(cfg.dtype)  # [B, D]
+            angles = tfm.rope_freqs(
+                cfg.head_dim, cfg.max_seq_len, cfg.rope_theta
+            )
+            ang = angles[positions]  # [B, hd/2]
+            page_idx = positions // page
+            page_ids = jnp.take_along_axis(
+                tables, page_idx[:, None], axis=1
+            )[:, 0]  # [B] physical page per slot
+            # inactive slots write the reserved scratch page (0): their
+            # stale tables may point at pages since reallocated to a LIVE
+            # slot, and a duplicate-index scatter could drop its write
+            page_ids = jnp.where(active, page_ids, 0)
+            offsets = jnp.where(active, positions % page, 0)
+
+            def body(carry, layer):
+                h, pk, pv = carry[0], carry[1], carry[2]
+                p = layer
+                x = tfm.rms_norm(h, p["ln1"])
+                q = (x @ p["wq"]).reshape(b, cfg.n_heads, cfg.head_dim)
+                k = (x @ p["wk"]).reshape(b, cfg.n_kv_heads, cfg.head_dim)
+                v = (x @ p["wv"]).reshape(b, cfg.n_kv_heads, cfg.head_dim)
+                q = _rope1(q, ang)
+                k = _rope1(k, ang)
+                li = carry[3]
+                pk = pk.at[li, page_ids, offsets].set(
+                    jnp.where(active[:, None, None], k.astype(pk.dtype), pk[li, page_ids, offsets])
+                )
+                pv = pv.at[li, page_ids, offsets].set(
+                    jnp.where(active[:, None, None], v.astype(pv.dtype), pv[li, page_ids, offsets])
+                )
+                k_pages = pk[li][tables]  # [B, P, page, KH, hd]
+                v_pages = pv[li][tables]
+                attn = _attention_pages(q, k_pages, v_pages, positions)
+                h = h + (attn.astype(cfg.dtype) @ p["wo"])
+                x2 = tfm.rms_norm(h, p["ln2"])
+                y = tfm.swiglu(x2, p["w_gate"], p["w_up"], p["w_down"])
+                return (h + y, pk, pv, li + 1), None
+
+            (h, pool_k, pool_v, _), _ = jax.lax.scan(
+                body,
+                (h, pool_k, pool_v, jnp.int32(0)),
+                params["blocks"],
+            )
+            h = tfm.rms_norm(h, params["ln_f"])
+            logits = (h @ params["head"]).astype(jnp.float32)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.random.split(key, b)
+            sampled = jax.vmap(
+                lambda kk, lg, tt: jax.random.categorical(
+                    kk, lg / jnp.maximum(tt, 1e-6)
+                )
+            )(keys, logits, temps).astype(jnp.int32)
+            nxt = jnp.where(temps > 0.0, sampled, greedy)
+            return nxt, pool_k, pool_v
+
+        def _rope1(x, ang):
+            """x: [B, H, hd]; ang: [B, hd/2]."""
+            dtype = x.dtype
+            x = x.astype(jnp.float32)
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            cos = jnp.cos(ang)[:, None, :]
+            sin = jnp.sin(ang)[:, None, :]
+            out = jnp.concatenate(
+                [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1
+            )
+            return out.astype(dtype)
+
+        @functools.partial(jax.jit, static_argnums=(4,))
+        def prefill(params, pool_k, pool_v, tokens, t_pad, page_ids):
+            """Prefill ONE sequence of (padded) length t_pad; write its KV
+            into the given pages; return last-token logits. tokens:
+            int32[t_pad]; page_ids: int32[t_pad // page]."""
+            pos = jnp.arange(t_pad)
+            h = params["embed"][tokens][None].astype(cfg.dtype)  # [1,T,D]
+            angles = tfm.rope_freqs(
+                cfg.head_dim, cfg.max_seq_len, cfg.rope_theta
+            )
+            ang = angles[pos][None]
+
+            def body(carry, layer):
+                h, pk, pv, li = carry
+                p = layer
+                x = tfm.rms_norm(h, p["ln1"])
+                q = (x @ p["wq"]).reshape(1, t_pad, cfg.n_heads, cfg.head_dim)
+                k = (x @ p["wk"]).reshape(
+                    1, t_pad, cfg.n_kv_heads, cfg.head_dim
+                )
+                v = (x @ p["wv"]).reshape(
+                    1, t_pad, cfg.n_kv_heads, cfg.head_dim
+                )
+                q = tfm._apply_rope_positions(q, ang)
+                k = tfm._apply_rope_positions(k, ang)
+                # causal self-attention over the prompt
+                groups = cfg.n_heads // cfg.n_kv_heads
+                qh = q.reshape(1, t_pad, cfg.n_kv_heads, groups, cfg.head_dim)
+                scores = jnp.einsum(
+                    "bthgd,bshd->bhgts",
+                    qh.astype(jnp.float32),
+                    k[0][None].astype(jnp.float32),
+                ) / jnp.sqrt(cfg.head_dim)
+                causal = (
+                    jnp.arange(t_pad)[None, :] <= jnp.arange(t_pad)[:, None]
+                )
+                scores = jnp.where(
+                    causal[None, None, None], scores, -1e30
+                )
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum(
+                    "bhgts,bshd->bthgd", probs, v[0][None].astype(jnp.float32)
+                ).reshape(1, t_pad, -1)
+                h = h + (attn.astype(cfg.dtype) @ p["wo"])
+                x2 = tfm.rms_norm(h, p["ln2"])
+                y = tfm.swiglu(x2, p["w_gate"], p["w_up"], p["w_down"])
+                # write pages: [T, KH, hd] -> [n_pages, page, KH, hd]
+                kp = k[0].reshape(-1, page, cfg.n_kv_heads, cfg.head_dim)
+                vp = v[0].reshape(-1, page, cfg.n_kv_heads, cfg.head_dim)
+                pk = pk.at[li, page_ids].set(kp.astype(pk.dtype))
+                pv = pv.at[li, page_ids].set(vp.astype(pv.dtype))
+                return (h + y, pk, pv, li + 1), None
+
+            (h, pool_k, pool_v, _), _ = jax.lax.scan(
+                body,
+                (h, pool_k, pool_v, jnp.int32(0)),
+                params["blocks"],
+            )
+            h = tfm.rms_norm(h, params["ln_f"])
+            logits = (h[0] @ params["head"]).astype(jnp.float32)
+            return logits, pool_k, pool_v
+
+        self._decode_step = decode_step
+        self._prefill = prefill
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+    def submit(self, prompt: List[int], gen: GenerationConfig) -> int:
+        if gen.top_k:
+            raise NotImplementedError(
+                "per-slot top_k is not supported by the continuous engine "
+                "(temperature sampling and greedy are); use LLMEngine"
+            )
+        prompt_pages = -(-max(len(prompt), 1) // self.page)
+        if prompt_pages > self.max_pages_per_seq:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens needs {prompt_pages} pages "
+                f"but max_pages_per_seq={self.max_pages_per_seq} "
+                f"(page_size={self.page})"
+            )
+        rid = self._next_req
+        self._next_req += 1
+        self.queue.append(_Request(rid, list(prompt), gen))
+        return rid
+
+    def _pages_needed(self, req: _Request) -> int:
+        total = len(req.prompt) + req.gen.max_new_tokens
+        return -(-total // self.page)
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue while pages are available."""
+        for si, slot in enumerate(self.slots):
+            if slot.active or not self.queue:
+                continue
+            req = self.queue[0]
+            need = min(self._pages_needed(req), self.max_pages_per_seq)
+            pages = self.pool.alloc(need)
+            if pages is None:
+                break  # backpressure: the POOL is the capacity
+            self.queue.popleft()
+            prompt = req.prompt
+            t = len(prompt)
+            t_pad = max(self.page, -(-t // self.page) * self.page)
+            prompt_pages = t_pad // self.page
+            tokens = np.zeros(t_pad, np.int32)
+            tokens[:t] = prompt
+            logits, self.pool.k, self.pool.v = self._prefill(
+                self.params,
+                self.pool.k,
+                self.pool.v,
+                jnp.asarray(tokens),
+                t_pad,
+                jnp.asarray(pages[:prompt_pages], dtype=jnp.int32),
+            )
+            if req.gen.temperature > 0.0:
+                kk = jax.random.fold_in(
+                    jax.random.PRNGKey(req.gen.seed), t
+                )
+                first = int(
+                    jax.random.categorical(
+                        kk, logits[t - 1] / max(req.gen.temperature, 1e-6)
+                    )
+                )
+            else:
+                first = int(np.asarray(jnp.argmax(logits[t - 1])))
+            slot.active = True
+            slot.req_id = req.req_id
+            slot.pos = t
+            # the prefill already produced token #1, so decode runs
+            # max_new-1 steps; the last token is never written back
+            slot.max_pos = min(
+                t + req.gen.max_new_tokens - 1, len(pages) * self.page
+            )
+            slot.pages = pages
+            slot.eos = req.gen.eos_token  # parity with LLMEngine.generate_ids
+            slot.out = [first]
+            # device state
+            table = np.zeros(self.max_pages_per_seq, np.int32)
+            table[: len(pages)] = pages
+            self.block_tables = self.block_tables.at[si].set(
+                jnp.asarray(table)
+            )
+            self.positions = self.positions.at[si].set(t)
+            self.cur_tokens = self.cur_tokens.at[si].set(first)
+            self.active_mask = self.active_mask.at[si].set(True)
+            self.temps = self.temps.at[si].set(float(req.gen.temperature))
+            self._maybe_finish(si)
+
+    def _maybe_finish(self, si: int) -> None:
+        slot = self.slots[si]
+        done = (
+            slot.pos >= slot.max_pos
+            or (slot.eos is not None and slot.out and slot.out[-1] == slot.eos)
+        )
+        if done and slot.active:
+            out = slot.out
+            if slot.eos is not None and slot.eos in out:
+                out = out[: out.index(slot.eos)]
+            self.results[slot.req_id] = out
+            self.pool.free(slot.pages)
+            self.slots[si] = _Slot()
+            self.active_mask = self.active_mask.at[si].set(False)
+
+    def step(self) -> List[int]:
+        """Admit + one decode step for all active slots. Returns req_ids
+        finished in this step."""
+        self._admit()
+        before = set(self.results)
+        if any(s.active for s in self.slots):
+            self._step_count += 1
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(0xC0FFEE), self._step_count
+            )
+            nxt, self.pool.k, self.pool.v = self._decode_step(
+                self.params,
+                self.pool.k,
+                self.pool.v,
+                self.block_tables,
+                self.positions,
+                self.cur_tokens,
+                self.active_mask,
+                self.temps,
+                key,
+            )
+            nxt_h = np.asarray(nxt)
+            self.positions = self.positions + jnp.where(self.active_mask, 1, 0)
+            self.cur_tokens = nxt
+            for si, slot in enumerate(self.slots):
+                if not slot.active:
+                    continue
+                slot.pos += 1
+                slot.out.append(int(nxt_h[si]))
+                self._maybe_finish(si)
+        return [r for r in self.results if r not in before]
+
+    def pending(self) -> int:
+        return len(self.queue) + sum(s.active for s in self.slots)
+
+    # ------------------------------------------------------------------
+    def generate_ids(
+        self,
+        prompts: List[List[int]],
+        gen: GenerationConfig = GenerationConfig(),
+    ) -> List[List[int]]:
+        ids = [self.submit(p, gen) for p in prompts]
+        while any(i not in self.results for i in ids):
+            self.step()
+        return [self.results.pop(i) for i in ids]
+
+    def generate(
+        self, prompts: List[str], gen: GenerationConfig = GenerationConfig()
+    ) -> List[str]:
+        enc = [self.tokenizer.encode(p) for p in prompts]
+        if gen.eos_token is None:
+            gen = GenerationConfig(
+                max_new_tokens=gen.max_new_tokens,
+                temperature=gen.temperature,
+                top_k=gen.top_k,
+                seed=gen.seed,
+                eos_token=getattr(self.tokenizer, "eos", None),
+            )
+        out = self.generate_ids(enc, gen)
+        return [self.tokenizer.decode(ids) for ids in out]
+
+    def stats(self) -> dict:
+        return {
+            "free_pages": self.pool.free_pages,
+            "total_pages": self.pool.n_pages,
+            "active_slots": sum(s.active for s in self.slots),
+            "queued": len(self.queue),
+        }

@@ -20,11 +20,31 @@ def build_llm_deployment(
     name: str = "llm",
     num_replicas: int = 1,
     max_len: int = 256,
+    engine: str = "dense",  # "dense" | "continuous" (paged KV)
+    max_batch: int = 8,
+    page_size: int = 16,
+    n_pages: int = 256,
 ):
+    if engine not in ("dense", "continuous"):
+        raise ValueError(
+            f"unknown engine {engine!r}; expected 'dense' or 'continuous'"
+        )
+
     @serve.deployment(name=name, num_replicas=num_replicas)
     class LLMServer:
         def __init__(self):
-            self.engine = LLMEngine(model_config, params, max_len=max_len)
+            if engine == "continuous":
+                from .continuous import ContinuousBatchingEngine
+
+                self.engine = ContinuousBatchingEngine(
+                    model_config,
+                    params,
+                    max_batch=max_batch,
+                    page_size=page_size,
+                    n_pages=n_pages,
+                )
+            else:
+                self.engine = LLMEngine(model_config, params, max_len=max_len)
 
         def __call__(self, request):
             prompt = request["prompt"]

@@ -62,6 +62,9 @@ def encode_tensor(value: Any) -> Optional[bytes]:
         return None
     if d.kind == "V" and d.name.startswith("void"):
         return None  # raw void blobs (e.g. structured leftovers)
+    if not d.isnative:
+        # dtype travels by NAME (no byte order): normalize to native first
+        host = host.astype(d.newbyteorder("="))
     host = np.ascontiguousarray(host)
     # dtype by NAME: ml_dtypes types (bfloat16, float8_*) have no loadable
     # numpy .str form, but their names resolve via ml_dtypes on decode

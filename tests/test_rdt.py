@@ -122,7 +122,7 @@ def test_cluster_dag_tensor_edge(cluster_client):
     compiled = dag.experimental_compile()
     try:
         arr = np.full((64,), 3.0, dtype=np.float32)
-        out = compiled.execute(jax.device_put(arr)).get(timeout=60)
+        out = compiled.execute(jax.device_put(arr)).get(timeout=240)
         assert np.allclose(np.asarray(out), arr * 4.0)
     finally:
         compiled.teardown()
