@@ -7,4 +7,7 @@ learner applies GAE + the PPO clipped surrogate with optax. Model compute is
 pure jax (pjit-able for larger policies).
 """
 from .cartpole import CartPoleEnv  # noqa: F401
+from .dqn import DQN, DQNConfig  # noqa: F401
+from .impala import IMPALA, ImpalaConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .replay import ReplayBuffer  # noqa: F401

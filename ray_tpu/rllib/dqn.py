@@ -1,0 +1,220 @@
+"""DQN: epsilon-greedy env runners -> replay buffer actor -> jitted
+double-DQN learner with a periodically synced target network.
+
+Reference shape: rllib/algorithms/dqn/ (replay buffer + target network +
+TD loss); rebuilt on the framework's actor/object plane with a pure-jax
+Q-network and one jitted sgd_step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from .cartpole import CartPoleEnv
+from .replay import ReplayBuffer
+
+
+def init_qnet(key, obs_size: int, num_actions: int, hidden: int = 64):
+    from .ppo import dense_init as dense
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "l1": dense(k1, obs_size, hidden),
+        "l2": dense(k2, hidden, hidden),
+        "out": dense(k3, hidden, num_actions),
+    }
+
+
+def q_forward(params, obs):
+    h = jnp.tanh(obs @ params["l1"]["w"] + params["l1"]["b"])
+    h = jnp.tanh(h @ params["l2"]["w"] + params["l2"]["b"])
+    return h @ params["out"]["w"] + params["out"]["b"]
+
+
+@ray_tpu.remote
+class DQNRunner:
+    """Steps the env epsilon-greedily, shipping transitions to the replay
+    buffer actor (ApeX actor analog: acting decoupled from learning)."""
+
+    def __init__(self, env_factory: Callable, buffer, seed: int):
+        self.env = env_factory()
+        self.buffer = buffer
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+
+    def collect(
+        self, params, num_steps: int, eps: float
+    ) -> Dict[str, Any]:
+        obs_b, act_b, rew_b, nobs_b, done_b = [], [], [], [], []
+        returns: List[float] = []
+        for _ in range(num_steps):
+            if self.rng.random() < eps:
+                action = int(self.rng.integers(self.env.num_actions))
+            else:
+                q = q_forward(params, jnp.asarray(self.obs[None]))
+                action = int(np.asarray(jnp.argmax(q[0])))
+            nobs, reward, term, trunc, _ = self.env.step(action)
+            obs_b.append(self.obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            nobs_b.append(nobs)
+            done_b.append(term)  # bootstrap through time-limit truncation
+            self.episode_return += reward
+            if term or trunc:
+                returns.append(self.episode_return)
+                self.episode_return = 0.0
+                self.obs, _ = self.env.reset()
+            else:
+                self.obs = nobs
+        size = ray_tpu.get(
+            self.buffer.add.remote(
+                {
+                    "obs": np.asarray(obs_b, np.float32),
+                    "actions": np.asarray(act_b, np.int32),
+                    "rewards": np.asarray(rew_b, np.float32),
+                    "next_obs": np.asarray(nobs_b, np.float32),
+                    "dones": np.asarray(done_b, np.bool_),
+                }
+            )
+        )
+        return {
+            "episode_returns": returns,
+            "steps": num_steps,
+            "buffer_size": size,
+        }
+
+
+@dataclass
+class DQNConfig:
+    env_factory: Callable = CartPoleEnv
+    num_env_runners: int = 2
+    rollout_steps: int = 128        # per runner per iteration
+    buffer_capacity: int = 20_000
+    batch_size: int = 128
+    sgd_steps_per_iter: int = 32
+    gamma: float = 0.99
+    lr: float = 1e-3
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_iters: int = 12
+    target_sync_every: int = 2      # iterations between target syncs
+    hidden: int = 64
+    seed: int = 0
+
+
+class DQN:
+    """Algorithm driver (reference Algorithm.train() shape)."""
+
+    def __init__(self, config: DQNConfig = DQNConfig()):
+        self.config = config
+        env = config.env_factory()
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_qnet(
+            key, env.observation_size, env.num_actions, config.hidden
+        )
+        # leaves are immutable jax arrays; sharing them IS the snapshot
+        # (apply_updates replaces leaves, never mutates)
+        self.target_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffer = ReplayBuffer.remote(config.buffer_capacity, config.seed)
+        self.runners = [
+            DQNRunner.remote(
+                config.env_factory, self.buffer, config.seed + 10 + i
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+        cfg = config
+
+        @jax.jit
+        def sgd_step(params, target_params, opt_state, batch):
+            def loss_fn(params):
+                q = q_forward(params, batch["obs"])
+                q_taken = jnp.take_along_axis(
+                    q, batch["actions"][:, None], 1
+                )[:, 0]
+                # double DQN: online net picks, target net evaluates
+                next_online = q_forward(params, batch["next_obs"])
+                next_act = jnp.argmax(next_online, axis=-1)
+                next_target = q_forward(target_params, batch["next_obs"])
+                next_q = jnp.take_along_axis(
+                    next_target, next_act[:, None], 1
+                )[:, 0]
+                target = batch["rewards"] + cfg.gamma * next_q * (
+                    1.0 - batch["dones"].astype(jnp.float32)
+                )
+                td = q_taken - jax.lax.stop_gradient(target)
+                return jnp.mean(optax.huber_loss(td))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._sgd_step = sgd_step
+
+    def _epsilon(self) -> float:
+        """Linear schedule; the FIRST iteration explores at eps_start."""
+        cfg = self.config
+        frac = min(
+            1.0, (self.iteration - 1) / max(1, cfg.eps_decay_iters)
+        )
+        return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        self.iteration += 1
+        eps = self._epsilon()
+        stats = ray_tpu.get(
+            [
+                r.collect.remote(self.params, cfg.rollout_steps, eps)
+                for r in self.runners
+            ]
+        )
+        ep_returns = [x for s in stats for x in s["episode_returns"]]
+        loss = float("nan")
+        sgd_done = 0
+        for _ in range(cfg.sgd_steps_per_iter):
+            batch = ray_tpu.get(self.buffer.sample.remote(cfg.batch_size))
+            if batch is None:
+                break
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.params, self.opt_state, loss_j = self._sgd_step(
+                self.params, self.target_params, self.opt_state, jb
+            )
+            loss = float(loss_j)
+            sgd_done += 1
+        if self.iteration % cfg.target_sync_every == 0:
+            self.target_params = self.params
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(ep_returns)) if ep_returns else float("nan")
+            ),
+            "num_episodes": len(ep_returns),
+            "num_env_steps": cfg.rollout_steps * cfg.num_env_runners,
+            "buffer_size": stats[-1]["buffer_size"],
+            "epsilon": eps,
+            "td_loss": loss,
+            "sgd_steps": sgd_done,
+        }
+
+    def save(self, path: str):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        return Checkpoint.from_state({"params": self.params}, path)
+
+    def restore(self, path: str):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        self.params = Checkpoint(path).load_state()["params"]
+        self.target_params = self.params

@@ -1,0 +1,225 @@
+"""IMPALA: asynchronous rollouts + V-trace off-policy correction.
+
+Reference shape: rllib/algorithms/impala/ — actors stream rollouts
+collected under a stale behavior policy while the learner updates
+continuously; importance-weight clipping (V-trace, Espeholt et al. 2018)
+corrects the off-policyness. Here: env-runner actors keep one rollout in
+flight each (ray_tpu.wait drives the async loop), and the learner is one
+jitted update whose V-trace targets are computed inside the jit with a
+lax.scan (TPU-friendly: no host recursion).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from .cartpole import CartPoleEnv
+from .ppo import init_policy, policy_forward
+
+
+@ray_tpu.remote
+class ImpalaRunner:
+    """Collects fixed-length segments under whatever params it was last
+    handed (the learner may have moved on — that lag is the point)."""
+
+    def __init__(self, env_factory: Callable, seed: int):
+        self.env = env_factory()
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+
+    def rollout(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        obs_b, act_b, rew_b, done_b, blogp_b = [], [], [], [], []
+        returns: List[float] = []
+        for _ in range(num_steps):
+            logits, _ = policy_forward(params, jnp.asarray(self.obs[None]))
+            probs = np.asarray(jax.nn.softmax(logits[0]))
+            action = int(self.rng.choice(len(probs), p=probs / probs.sum()))
+            blogp = float(np.log(probs[action] + 1e-9))
+            nobs, reward, term, trunc, _ = self.env.step(action)
+            obs_b.append(self.obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            done_b.append(term or trunc)
+            blogp_b.append(blogp)
+            self.episode_return += reward
+            if term or trunc:
+                returns.append(self.episode_return)
+                self.episode_return = 0.0
+                self.obs, _ = self.env.reset()
+            else:
+                self.obs = nobs
+        return {
+            "obs": np.asarray(obs_b, np.float32),
+            "actions": np.asarray(act_b, np.int32),
+            "rewards": np.asarray(rew_b, np.float32),
+            "dones": np.asarray(done_b, np.bool_),
+            "behavior_logp": np.asarray(blogp_b, np.float32),
+            "bootstrap_obs": np.asarray(self.obs, np.float32),
+            "episode_returns": np.asarray(returns, np.float32),
+        }
+
+
+@dataclass
+class ImpalaConfig:
+    env_factory: Callable = CartPoleEnv
+    num_env_runners: int = 2
+    rollout_steps: int = 128
+    gamma: float = 0.99
+    lr: float = 3e-3
+    rho_clip: float = 1.0       # V-trace importance-weight clip
+    c_clip: float = 1.0
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    hidden: int = 64
+    seed: int = 0
+    updates_per_iter: int = 4   # segments consumed per train() call
+
+
+class IMPALA:
+    """Algorithm driver (reference Algorithm.train() shape) with an
+    asynchronous rollout pipeline: every runner always has a segment in
+    flight; train() consumes whichever finish first."""
+
+    def __init__(self, config: ImpalaConfig = ImpalaConfig()):
+        self.config = config
+        env = config.env_factory()
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_policy(
+            key, env.observation_size, env.num_actions, config.hidden
+        )
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.runners = [
+            ImpalaRunner.remote(config.env_factory, config.seed + 50 + i)
+            for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+        self._in_flight: Dict[str, Any] = {}  # ref hex -> runner
+        cfg = config
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            def loss_fn(params):
+                logits, values = policy_forward(params, batch["obs"])
+                _, bootstrap_v = policy_forward(
+                    params, batch["bootstrap_obs"][None]
+                )
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][:, None], 1
+                )[:, 0]
+                rho = jnp.exp(logp - batch["behavior_logp"])
+                rho_c = jnp.minimum(rho, cfg.rho_clip)
+                c_c = jnp.minimum(rho, cfg.c_clip)
+                discounts = cfg.gamma * (
+                    1.0 - batch["dones"].astype(jnp.float32)
+                )
+                values_sg = jax.lax.stop_gradient(values)
+                next_values = jnp.concatenate(
+                    [values_sg[1:], bootstrap_v]
+                )
+                deltas = rho_c * (
+                    batch["rewards"] + discounts * next_values - values_sg
+                )
+
+                # v-trace targets via reverse scan (in-jit, no host loop):
+                # vs_t = V_t + delta_t + discount_t * c_t * (vs_{t+1} - V_{t+1})
+                def body(acc, x):
+                    delta_t, disc_t, c_t = x
+                    acc = delta_t + disc_t * c_t * acc
+                    return acc, acc
+
+                _, adv_rev = jax.lax.scan(
+                    body,
+                    jnp.float32(0.0),
+                    (deltas[::-1], discounts[::-1], c_c[::-1]),
+                )
+                vs_minus_v = adv_rev[::-1]
+                vs = values_sg + vs_minus_v
+                next_vs = jnp.concatenate([vs[1:], bootstrap_v])
+                pg_adv = rho_c * (
+                    batch["rewards"] + discounts * next_vs - values_sg
+                )
+                pi_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+                vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+                )
+                total = (
+                    pi_loss
+                    + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy
+                )
+                return total, (pi_loss, vf_loss, entropy)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = update
+
+    def _launch(self, runner) -> None:
+        ref = runner.rollout.remote(self.params, self.config.rollout_steps)
+        self._in_flight[ref] = runner
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        self.iteration += 1
+        for r in self.runners:
+            if r not in self._in_flight.values():
+                self._launch(r)
+        ep_returns: List[float] = []
+        loss = pi_loss = vf_loss = entropy = float("nan")
+        consumed = 0
+        while consumed < cfg.updates_per_iter:
+            ready, _ = ray_tpu.wait(
+                list(self._in_flight), num_returns=1, timeout=120
+            )
+            if not ready:
+                break
+            ref = ready[0]
+            runner = self._in_flight.pop(ref)
+            seg = ray_tpu.get(ref)
+            self._launch(runner)  # keep the pipeline full
+            batch = {k: jnp.asarray(v) for k, v in seg.items()
+                     if k != "episode_returns"}
+            self.params, self.opt_state, loss_j, aux = self._update(
+                self.params, self.opt_state, batch
+            )
+            loss = float(loss_j)
+            pi_loss, vf_loss, entropy = (float(x) for x in aux)
+            ep_returns.extend(seg["episode_returns"].tolist())
+            consumed += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(ep_returns)) if ep_returns else float("nan")
+            ),
+            "num_episodes": len(ep_returns),
+            "num_env_steps": consumed * cfg.rollout_steps,
+            "total_loss": loss,
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    def save(self, path: str):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        return Checkpoint.from_state({"params": self.params}, path)
+
+    def restore(self, path: str):
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        self.params = Checkpoint(path).load_state()["params"]

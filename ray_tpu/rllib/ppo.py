@@ -16,14 +16,17 @@ from .cartpole import CartPoleEnv
 # -- policy/value MLP (pure-jax pytree) -------------------------------------
 
 
+def dense_init(k, i, o):
+    """Fan-in-scaled dense layer init (shared by PPO/DQN/IMPALA nets)."""
+    return {
+        "w": jax.random.normal(k, (i, o), jnp.float32) * (i**-0.5),
+        "b": jnp.zeros((o,), jnp.float32),
+    }
+
+
 def init_policy(key, obs_size: int, num_actions: int, hidden: int = 64):
     k1, k2, k3, k4 = jax.random.split(key, 4)
-
-    def dense(k, i, o):
-        return {
-            "w": jax.random.normal(k, (i, o), jnp.float32) * (i**-0.5),
-            "b": jnp.zeros((o,), jnp.float32),
-        }
+    dense = dense_init
 
     return {
         "torso": [dense(k1, obs_size, hidden), dense(k2, hidden, hidden)],

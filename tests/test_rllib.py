@@ -1,9 +1,17 @@
-"""RL: env correctness + PPO learning signal on CartPole."""
+"""RL: env correctness + PPO/DQN/IMPALA learning signals on CartPole."""
 import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu.rllib import PPO, PPOConfig, CartPoleEnv
+from ray_tpu.rllib import (
+    DQN,
+    DQNConfig,
+    IMPALA,
+    ImpalaConfig,
+    PPO,
+    PPOConfig,
+    CartPoleEnv,
+)
 
 
 def test_cartpole_dynamics():
@@ -38,5 +46,75 @@ def test_ppo_improves_on_cartpole(tmp_path):
         algo2.restore(str(tmp_path / "ppo_ckpt"))
         r = algo2.train()
         assert np.isfinite(r["total_loss"])
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dqn_learns_and_buffer_fills(tmp_path):
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4, "memory": 1e9})
+    try:
+        algo = DQN(
+            DQNConfig(
+                num_env_runners=2,
+                rollout_steps=128,
+                sgd_steps_per_iter=48,
+                batch_size=64,
+                eps_decay_iters=6,
+                seed=1,
+            )
+        )
+        first = algo.train()
+        assert first["buffer_size"] >= 128
+        results = [algo.train() for _ in range(11)]
+        last = results[-1]
+        assert np.isfinite(last["td_loss"]) and last["sgd_steps"] > 0
+        # learning signal: epsilon decayed AND mean return moved up vs the
+        # random-policy start
+        early = first["episode_return_mean"]
+        assert last["episode_return_mean"] > early + 10, (early, last)
+        ckpt = algo.save(str(tmp_path / "dqn_ckpt"))
+        algo2 = DQN(DQNConfig(num_env_runners=1, rollout_steps=32))
+        algo2.restore(str(tmp_path / "dqn_ckpt"))
+        r2 = algo2.train()
+        assert r2["sgd_steps"] == 0 or np.isfinite(r2["td_loss"])
+        # restored params must actually act: greedy eval episode scores
+        env = CartPoleEnv(seed=9)
+        from ray_tpu.rllib.dqn import q_forward
+        import jax.numpy as jnp
+        obs, _ = env.reset(seed=9)
+        total = 0.0
+        for _ in range(500):
+            a = int(np.asarray(jnp.argmax(q_forward(algo2.params, jnp.asarray(obs[None]))[0])))
+            obs, rew, term, trunc, _ = env.step(a)
+            total += rew
+            if term or trunc:
+                break
+        assert total > 40, total  # trained policy far beats random (~20)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_impala_async_pipeline_learns(tmp_path):
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4, "memory": 1e9})
+    try:
+        algo = IMPALA(
+            ImpalaConfig(
+                num_env_runners=2,
+                rollout_steps=192,
+                updates_per_iter=4,
+                seed=5,
+            )
+        )
+        first = algo.train()
+        assert first["num_env_steps"] > 0
+        last = None
+        for _ in range(7):
+            last = algo.train()
+        assert np.isfinite(last["total_loss"])
+        early = first["episode_return_mean"]
+        assert last["episode_return_mean"] > early + 10, (early, last)
+        # rollouts still in flight use stale params by design: the pipeline
+        # must keep every runner busy
+        assert len(algo._in_flight) == 2
     finally:
         ray_tpu.shutdown()
