@@ -77,6 +77,9 @@ class _WorkerHandle:
         self.ready = threading.Event()
         self.actor_id: Optional[str] = None  # pinned for an actor
         self.lock = threading.Lock()  # serializes pushes (actor ordering)
+        # task_id -> dispatch time of in-flight plain tasks (OOM victim
+        # selection: the memory monitor kills the NEWEST task first)
+        self.running: Dict[str, float] = {}
 
 
 class NodeAgent:
@@ -210,6 +213,19 @@ class NodeAgent:
             max_workers=num_workers + 32,
             thread_name_prefix=f"agent-{self.node_id[:6]}",
         )
+
+        # memory-pressure monitor (pressure_memory_monitor.h analog): when
+        # host memory usage crosses the threshold, kill the worker running
+        # the NEWEST plain task (its lease retries; earlier work survives)
+        from ray_tpu.config import cfg
+
+        self.metrics_oom_kills = 0
+        if cfg.memory_monitor_interval_s > 0:
+            threading.Thread(
+                target=self._memory_monitor_loop,
+                name="agent-memmon",
+                daemon=True,
+            ).start()
 
         # coalescing completion/seal reporter (see _reporter_loop)
         self._report_queue: List[Dict[str, Any]] = []
@@ -636,12 +652,18 @@ class NodeAgent:
             self._push_req(spec, self._alloc_env(alloc))
             for spec, alloc in items
         ]
+        now = time.monotonic()
+        for spec, _ in items:
+            if spec.kind == "task":
+                handle.running[spec.task_id] = now
         try:
             with handle.lock:
                 replies = handle.client.call(
                     "PushTaskBatch", reqs, timeout=None
                 )
         except RpcError:
+            for spec, _ in items:
+                handle.running.pop(spec.task_id, None)
             for _, alloc in items:
                 self._release(alloc)
             if not self._shutdown:
@@ -652,12 +674,14 @@ class NodeAgent:
             # resources held and the worker never returned to the pool
             logger.exception("PushTaskBatch failed; requeueing %d", len(items))
             for spec, alloc in items:
+                handle.running.pop(spec.task_id, None)
                 self._release(alloc)
                 self._spillback(spec, "worker push failed")
             self._return_worker(handle)
             return
         try:
             for (spec, alloc), reply in zip(items, replies):
+                handle.running.pop(spec.task_id, None)
                 self._finish_worker_reply(
                     spec, handle, alloc, reply, return_worker=False
                 )
@@ -825,6 +849,8 @@ class NodeAgent:
         # async-actor methods skip the per-worker lock: the worker's event
         # loop multiplexes them (serialize=False from _h_execute_lease)
         guard = handle.lock if serialize else contextlib.nullcontext()
+        if spec.kind == "task":
+            handle.running[spec.task_id] = time.monotonic()
         try:
             with guard:  # per-worker ordering (actor sequential exec)
                 reply = handle.client.call(
@@ -833,17 +859,20 @@ class NodeAgent:
                     timeout=None,
                 )
         except RpcError:
+            handle.running.pop(spec.task_id, None)
             self._release(alloc)
             if not self._shutdown:
                 self._on_worker_death(handle, [spec])
             return
         except BaseException:  # noqa: BLE001 - remote exception shipped back
             logger.exception("PushTask failed for %s; requeueing", spec.name)
+            handle.running.pop(spec.task_id, None)
             self._release(alloc)
             self._spillback(spec, "worker push failed")
             if spec.kind == "task":
                 self._return_worker(handle)
             return
+        handle.running.pop(spec.task_id, None)
         if reply.get("status") == "async_pending":
             # the worker accepted the method onto its event loop and will
             # deliver the outcome via TaskDone — free this thread now.
@@ -1253,6 +1282,87 @@ class NodeAgent:
         self._async_actors.discard(actor_id)
         self._async_buf.pop(actor_id, None)
         self._release(self._actor_allocs.pop(actor_id, None))
+
+    # ------------------------------------------------------------------
+    # memory-pressure monitor (src/ray/common/pressure_memory_monitor.h
+    # analog): /proc/meminfo is the source of truth; the victim is the
+    # newest-dispatched plain task's worker — killing the process trips
+    # the normal worker-death path, which requeues its lease retryably.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _memory_usage_fraction() -> Optional[float]:
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    parts = line.split()
+                    if parts[0] in ("MemTotal:", "MemAvailable:"):
+                        info[parts[0][:-1]] = int(parts[1])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if total <= 0:
+                return None
+            return 1.0 - avail / total
+        except OSError:
+            return None
+
+    def _pick_oom_victim(self):
+        """Newest-task-first victim policy (the reference protects older
+        work); actor workers are exempt — killing one loses state."""
+        victim = None
+        newest = -1.0
+        with self._lock:
+            for handle in self._workers.values():
+                if handle.actor_id is not None:
+                    continue
+                # dispatch threads mutate .running without our lock:
+                # snapshot the values to dodge mid-iteration resizes
+                started_vals = list(dict(handle.running).values())
+                if not started_vals:
+                    continue
+                started = max(started_vals)
+                if started > newest:
+                    newest = started
+                    victim = handle
+        return victim
+
+    def _memory_monitor_loop(self) -> None:
+        from ray_tpu.config import cfg
+
+        while not self._shutdown:
+            time.sleep(cfg.memory_monitor_interval_s)
+            try:
+                self._memory_monitor_tick()
+            except Exception:  # noqa: BLE001 - the monitor must survive
+                logger.exception("memory monitor tick failed")
+
+    def _memory_monitor_tick(self) -> None:
+        from ray_tpu.config import cfg
+
+        frac = self._memory_usage_fraction()
+        if frac is None or frac < cfg.memory_usage_threshold:
+            return
+        victim = self._pick_oom_victim()
+        if victim is None:
+            logger.warning(
+                "memory pressure %.0f%% but no plain task to kill",
+                frac * 100,
+            )
+            return
+        self.metrics_oom_kills += 1
+        logger.warning(
+            "memory pressure %.0f%% >= %.0f%%: OOM-killing worker %s "
+            "(newest task first, %d in flight)",
+            frac * 100,
+            cfg.memory_usage_threshold * 100,
+            victim.worker_id[:8],
+            len(victim.running),
+        )
+        try:
+            victim.proc.kill()
+        except OSError:
+            pass
+        # the blocked PushTask RPC fails -> _on_worker_death requeues
 
     def _h_actor_worker_address(self, req: dict) -> dict:
         """Direct actor calls: resolve the worker process hosting an actor

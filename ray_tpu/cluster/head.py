@@ -167,7 +167,11 @@ class HeadServer:
         self._shutdown = False
         self._persist_path = persist_path
         self._persist_dirty = False
+        self._backend = None
         if persist_path:
+            from .persistence import FilePersistence
+
+            self._backend = FilePersistence(persist_path)
             with _PERSIST_REG_LOCK:
                 _PERSIST_LOCKS.setdefault(persist_path, threading.Lock())
                 _PERSIST_OWNER[persist_path] = id(self)
@@ -275,14 +279,25 @@ class HeadServer:
                 "jobs": self.jobs.snapshot() if hasattr(self, "jobs") else [],
             }
 
-    def _load_persisted(self) -> None:
-        try:
-            with open(self._persist_path, "rb") as f:
-                snap = pickle.load(f)
-        except FileNotFoundError:
+    def _wal(self, record: tuple) -> None:
+        """Write-ahead a durable registration: survives a crash BETWEEN
+        snapshot ticks (store_client write-through analog). Only the
+        owning head instance may append."""
+        if self._backend is None:
             return
-        except Exception:  # noqa: BLE001 - corrupt snapshot: start fresh
-            logger.exception("could not load persisted head state; starting fresh")
+        lock = _PERSIST_LOCKS[self._persist_path]
+        with lock:
+            if _PERSIST_OWNER.get(self._persist_path) != id(self):
+                return
+            try:
+                self._backend.wal_append(record)
+            except Exception:  # noqa: BLE001 - durability is best-effort
+                logger.exception("WAL append failed")
+
+    def _load_persisted(self) -> None:
+        snap = self._backend.load() or {}
+        records = self._backend.wal_replay()
+        if not snap and not records:
             return
         self._kv = dict(snap.get("kv", {}))
         self._named_actors = dict(snap.get("named_actors", {}))
@@ -296,11 +311,41 @@ class HeadServer:
                 info.address = None
             self._actors[actor_id] = info
         self._recovered_jobs = snap.get("jobs", [])
+        # replay registrations that landed after the last snapshot tick
+        for rec in records:
+            kind = rec[0]
+            if kind == "kv_put":
+                self._kv[rec[1]] = rec[2]
+            elif kind == "kv_del":
+                self._kv.pop(rec[1], None)
+            elif kind == "actor":
+                fields, spec, name = rec[1], rec[2], rec[3]
+                info = ActorInfo(**fields)
+                if info.state != "DEAD":
+                    info.state = "RESTARTING"
+                    info.node_id = None
+                    info.address = None
+                self._actors[info.actor_id] = info
+                if spec is not None:
+                    self._actor_specs[info.actor_id] = spec
+                if name:
+                    self._named_actors[name] = info.actor_id
+            elif kind == "actor_dead":
+                info = self._actors.get(rec[1])
+                if info is not None:
+                    info.state = "DEAD"
+                    if (
+                        info.name
+                        and self._named_actors.get(info.name) == rec[1]
+                    ):
+                        del self._named_actors[info.name]
         logger.info(
-            "recovered head state: %d kv keys, %d actors, %d jobs",
+            "recovered head state: %d kv keys, %d actors, %d jobs, "
+            "%d WAL records",
             len(self._kv),
             len(self._actors),
             len(self._recovered_jobs),
+            len(records),
         )
 
     def mark_dirty(self) -> None:
@@ -312,13 +357,7 @@ class HeadServer:
             if _PERSIST_OWNER.get(self._persist_path) != id(self):
                 return  # a newer head owns this file now; never write stale
             try:
-                tmp = (
-                    f"{self._persist_path}.{os.getpid()}"
-                    f".{threading.get_ident()}.tmp"
-                )
-                with open(tmp, "wb") as f:
-                    pickle.dump(self._snapshot_state(), f)
-                os.replace(tmp, self._persist_path)
+                self._backend.save_snapshot(self._snapshot_state())
             except Exception:  # noqa: BLE001
                 self._persist_dirty = True  # don't lose the write; retry
                 logger.exception("head state persistence failed")
@@ -338,12 +377,16 @@ class HeadServer:
     # ------------------------------------------------------------------
     def _h_kv_put(self, r: dict) -> None:
         with self._lock:
+            # WAL under the same lock as the memory write: replay order
+            # must match acknowledged state (two racing puts to one key)
             self._kv[r["key"]] = r["value"]
+            self._wal(("kv_put", r["key"], r["value"]))
         self.mark_dirty()
 
     def _h_kv_del(self, r: dict) -> None:
         with self._lock:
             self._kv.pop(r["key"], None)
+            self._wal(("kv_del", r["key"]))
         self.mark_dirty()
 
     def _h_register_node(self, info: NodeInfo) -> dict:
@@ -521,6 +564,9 @@ class HeadServer:
                 # release the name so a replacement can rebind it
                 if info.name and self._named_actors.get(info.name) == info.actor_id:
                     del self._named_actors[info.name]
+                # death must out-survive a WAL'd registration, or recovery
+                # resurrects a killed actor from the log
+                self._wal(("actor_dead", info.actor_id))
             # wake WaitActor long-polls (push-based actor-state plane)
             self._cond.notify_all()
         self.mark_dirty()
@@ -1031,9 +1077,7 @@ class HeadServer:
                         self._infeasible.clear()
                 if self._shutdown:
                     return
-                batch = []
-                while self._pending and len(batch) < MAX_BATCH:
-                    batch.append(self._pending.popleft())
+                batch = self._pop_fair_batch()
             try:
                 self._try_schedule_pgs()
                 if batch:
@@ -1043,6 +1087,49 @@ class HeadServer:
                 with self._cond:
                     self._pending.extend(batch)
             time.sleep(SCHED_TICK_S)
+
+    def _pop_fair_batch(self) -> List[LeaseRequest]:
+        """Take up to MAX_BATCH leases. When the queue overflows one round,
+        round-robin across scheduling classes (resource shapes) so a storm
+        of one shape cannot monopolize dispatch for rounds on end
+        (local_lease_manager.h per-class throttling analog). Caller holds
+        self._cond."""
+        if len(self._pending) <= MAX_BATCH:
+            batch = list(self._pending)
+            self._pending.clear()
+            return batch
+        # bound the rebucketing window: scanning the WHOLE queue per tick
+        # would be O(pending) under the head lock during exactly the storm
+        # that triggers this branch. Fairness applies within the window;
+        # the untouched tail keeps FIFO order.
+        window = min(len(self._pending), 4 * MAX_BATCH)
+        scanned = [self._pending.popleft() for _ in range(window)]
+        by_class: Dict[tuple, deque] = {}
+        order: List[tuple] = []
+        for spec in scanned:
+            key = tuple(sorted(spec.resources.items()))
+            q = by_class.get(key)
+            if q is None:
+                q = by_class[key] = deque()
+                order.append(key)
+            q.append(spec)
+        batch: List[LeaseRequest] = []
+        while len(batch) < MAX_BATCH:
+            progressed = False
+            for key in order:
+                q = by_class[key]
+                if q:
+                    batch.append(q.popleft())
+                    progressed = True
+                    if len(batch) >= MAX_BATCH:
+                        break
+            if not progressed:
+                break
+        # window remainder returns to the FRONT (per-class FIFO preserved),
+        # ahead of the untouched tail
+        for key in reversed(order):
+            self._pending.extendleft(reversed(by_class[key]))
+        return batch
 
     def _schedule_batch(self, batch: List[LeaseRequest]) -> None:
         self.metrics["sched_rounds"] += 1
@@ -1477,6 +1564,7 @@ class HeadServer:
             self._actor_specs[spec.actor_id] = spec
             self._leases[spec.task_id] = spec
             self._pending.append(spec)
+            self._wal(("actor", dict(vars(info)), spec, name))
             self._cond.notify_all()
         self.mark_dirty()
         return {"actor_id": spec.actor_id}

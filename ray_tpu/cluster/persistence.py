@@ -1,0 +1,110 @@
+"""Pluggable head-state persistence: snapshot store + write-ahead log.
+
+Analog of the reference's GCS storage layer
+(/root/reference/src/ray/gcs/store_client/ — pluggable Redis/in-memory
+backends) plus write-ahead durability for registrations that land between
+snapshot ticks: every durable mutation (KV write, actor registration) is
+appended to the WAL immediately; a snapshot supersedes and truncates it.
+Recovery = load snapshot, then replay the WAL.
+
+``FilePersistence`` is the built-in backend (length-prefixed pickled
+records; atomic snapshot swap). Anything implementing the same four
+methods can be passed to ``HeadServer(persist_backend=...)`` — e.g. a
+Redis- or cloud-bucket-backed store.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.cluster.persistence")
+
+
+class FilePersistence:
+    """Snapshot at ``path``, WAL at ``path + '.wal'``."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.wal_path = path + ".wal"
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._wal_f = None
+
+    # -- snapshot ------------------------------------------------------
+    def load(self) -> Optional[dict]:
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception:  # noqa: BLE001 - corrupt snapshot: start fresh
+            logger.exception("could not load snapshot; starting fresh")
+            return None
+
+    def save_snapshot(self, snap: dict) -> None:
+        """Atomic snapshot swap; the WAL it supersedes is truncated."""
+        with self._lock:
+            tmp = f"{self.path}.{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._truncate_wal_locked()
+
+    # -- write-ahead log -----------------------------------------------
+    def wal_append(self, record: Tuple[Any, ...]) -> None:
+        with self._lock:
+            if self._wal_f is None:
+                self._wal_f = open(self.wal_path, "ab")
+            blob = pickle.dumps(record)
+            self._wal_f.write(struct.pack("<I", len(blob)) + blob)
+            self._wal_f.flush()
+            if self.fsync:
+                os.fsync(self._wal_f.fileno())
+
+    def wal_replay(self) -> List[Tuple[Any, ...]]:
+        out: List[Tuple[Any, ...]] = []
+        try:
+            with open(self.wal_path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return out
+        off = 0
+        while off + 4 <= len(data):
+            (n,) = struct.unpack_from("<I", data, off)
+            off += 4
+            if off + n > len(data):
+                break  # torn tail write: ignore the partial record
+            try:
+                out.append(pickle.loads(data[off : off + n]))
+            except Exception:  # noqa: BLE001 - skip corrupt record
+                logger.warning("skipping corrupt WAL record at offset %d", off)
+            off += n
+        return out
+
+    def _truncate_wal_locked(self) -> None:
+        if self._wal_f is not None:
+            try:
+                self._wal_f.close()
+            except OSError:
+                pass
+            self._wal_f = None
+        try:
+            os.unlink(self.wal_path)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal_f is not None:
+                try:
+                    self._wal_f.close()
+                except OSError:
+                    pass
+                self._wal_f = None

@@ -82,7 +82,16 @@ class Worker:
                 logger.warning("worker could not open shm store %s", store_path)
         self._actors: Dict[str, Any] = {}
         self._actor_loops: Dict[str, Any] = {}  # actor_id -> (loop, sems)
-        self._env_applied: set = set()
+        # runtime-env gate: tasks sharing ONE env signature run
+        # concurrently (refcounted application); a DIFFERENT env waits for
+        # the current one to drain. Env-less tasks skip the gate entirely
+        # — they can observe a concurrently-applied env (process-level
+        # isolation needs a dedicated worker, which actors get; the
+        # reference isolates via per-env worker processes the same way).
+        self._env_cv = threading.Condition()
+        self._env_sig: Optional[str] = None
+        self._env_active = 0
+        self._env_undo = lambda: None
         from concurrent.futures import ThreadPoolExecutor
 
         # seals + TaskDone callbacks for finished async-actor methods run
@@ -221,22 +230,76 @@ class Worker:
         )
 
     # ------------------------------------------------------------------
-    # runtime envs (the per-lease slice of _private/runtime_env/)
+    # runtime envs (the per-lease slice of _private/runtime_env/).
+    # Isolation contract: a PLAIN task's env is applied for exactly its
+    # execution and then undone (env_vars restored, injected sys.path
+    # entries removed), and tasks carrying a runtime_env serialize on one
+    # lock so two different envs can never interleave on a shared worker.
+    # An ACTOR CREATION keeps its env for the worker's life (the actor
+    # owns the process, same as its chip assignment). Modules already
+    # imported from a working_dir stay imported — process-level isolation
+    # needs a dedicated worker, which actors get by construction.
     # ------------------------------------------------------------------
-    def _apply_runtime_env(self, env: Optional[dict]) -> None:
+    def _env_enter(self, env: dict) -> None:
+        """Join the env gate: same-signature tasks share one application
+        (refcounted — co-scheduled tasks of one job, e.g. collective
+        rendezvous peers, run CONCURRENTLY); a different signature waits
+        for the current one to drain, so two envs never interleave."""
+        import json
+
+        sig = json.dumps(env, sort_keys=True, default=str)
+        with self._env_cv:
+            while self._env_active > 0 and self._env_sig != sig:
+                self._env_cv.wait(timeout=1.0)
+            if self._env_active == 0:
+                self._env_sig = sig
+                self._env_undo = self._apply_runtime_env(env)
+            self._env_active += 1
+
+    def _env_exit(self, persist: bool = False) -> None:
+        with self._env_cv:
+            self._env_active -= 1
+            if self._env_active == 0:
+                if not persist:
+                    self._env_undo()
+                # an actor owns its worker: a persisted env's undo is
+                # simply discarded
+                self._env_undo = lambda: None
+                self._env_sig = None
+            self._env_cv.notify_all()
+
+    def _apply_runtime_env(self, env: Optional[dict]):
+        """Apply ``env``; returns an undo() closure (no-op when env is
+        empty). Called under the env gate (_env_enter)."""
         if not env:
-            return
+            return lambda: None
+        prev_vars: Dict[str, Optional[str]] = {}
         for k, v in (env.get("env_vars") or {}).items():
+            prev_vars[k] = os.environ.get(k)
             os.environ[k] = str(v)
-        key = env.get("working_dir")
-        if key and key not in self._env_applied:
-            self._env_applied.add(key)
-            if key not in sys.path:
+        added_paths: List[str] = []
+        for key in [env.get("working_dir"), *(env.get("py_modules") or [])]:
+            if key and key not in sys.path:
                 sys.path.insert(0, key)
-        for p in env.get("py_modules") or []:
-            if p not in sys.path:
-                sys.path.insert(0, p)
-        importlib.invalidate_caches()
+                added_paths.append(key)
+        if added_paths:
+            importlib.invalidate_caches()
+
+        def undo() -> None:
+            for k, old in prev_vars.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            for p in added_paths:
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
+            if added_paths:
+                importlib.invalidate_caches()
+
+        return undo
 
     # ------------------------------------------------------------------
     # execution
@@ -255,8 +318,11 @@ class Worker:
         accel_env = req.get("accel_env")
         prev_env: Dict[str, Optional[str]] = {}
         persist_env = False
+        creation_ok = False
+        runtime_env = req.get("runtime_env")
+        if runtime_env:
+            self._env_enter(runtime_env)
         try:
-            self._apply_runtime_env(req.get("runtime_env"))
             if accel_env:
                 # the granted lease's chip assignment: TPU_VISIBLE_CHIPS /
                 # CUDA_VISIBLE_DEVICES (accelerators/tpu.py:38-56 analog).
@@ -293,6 +359,7 @@ class Worker:
                     self._actor_loops[aid] = self._start_actor_loop(aid, groups)
                 self._actors[aid] = cls(*args, **kwargs)
                 persist_env = bool(accel_env)  # actor now owns these chips
+                creation_ok = True
                 result_values: List[Any] = []
             elif kind == "actor_method":
                 method, args, kwargs = cloudpickle.loads(req["payload"])
@@ -340,6 +407,8 @@ class Worker:
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = old
+            if runtime_env:
+                self._env_exit(persist=creation_ok)
             self._clear_context()
         try:
             # sealing can fail too (store full + agent fallback unreachable):

@@ -179,6 +179,17 @@ define(
     "Default shared-memory arena capacity per node (bytes).",
 )
 define("refcount_debug", False, "Record per-ref count history (diagnostics).")
+define(
+    "memory_monitor_interval_s",
+    1.0,
+    "Agent memory-pressure check period; 0 disables OOM killing.",
+)
+define(
+    "memory_usage_threshold",
+    0.95,
+    "Host memory usage fraction above which the agent kills the newest "
+    "plain task's worker to relieve pressure.",
+)
 
 # ---------------------------------------------------------------------------
 # direct actor calls
