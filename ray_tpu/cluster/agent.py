@@ -35,7 +35,10 @@ from .common import (
     SealInfo,
     new_id,
 )
-from .rpc import RpcClient, RpcError, RpcServer
+from .rpc import HANDLER_STATS, RpcClient, RpcError, RpcServer
+
+
+from ray_tpu.config import cfg
 
 logger = logging.getLogger("ray_tpu.cluster.agent")
 
@@ -205,6 +208,14 @@ class NodeAgent:
 
         # remote-fetch client cache (peer addresses come from head lookups)
         self._peer_clients: Dict[str, RpcClient] = {}
+        # pull admission (push_manager.h / pull_manager.h analog): bound
+        # concurrent inbound transfers, and coalesce concurrent pulls of
+        # ONE object into a single fetch (broadcast of a big object to N
+        # workers on this node = one wire transfer, not N)
+        self._pull_sem = threading.Semaphore(
+            max(1, int(cfg.max_concurrent_pulls))
+        )
+        self._pull_waiters: Dict[str, threading.Event] = {}
 
         # IO-bound pool: threads mostly park on worker RPCs. Sized well past
         # the worker count so async-actor methods (which each hold a thread
@@ -217,8 +228,6 @@ class NodeAgent:
         # memory-pressure monitor (pressure_memory_monitor.h analog): when
         # host memory usage crosses the threshold, kill the worker running
         # the NEWEST plain task (its lease retries; earlier work survives)
-        from ray_tpu.config import cfg
-
         self.metrics_oom_kills = 0
         if cfg.memory_monitor_interval_s > 0:
             threading.Thread(
@@ -1112,7 +1121,28 @@ class NodeAgent:
             if status == "inline":
                 return {"status": "inline", "data": reply["data"]}
             if status == "located":
-                for nid, addr in reply["locations"]:
+                out = self._pull_located(oid, reply["locations"])
+                if out is not None:
+                    return out
+        return {"status": "timeout"}
+
+    def _pull_located(self, oid: str, locations) -> Optional[dict]:
+        """Admission-controlled peer pull: concurrent requests for the same
+        object coalesce behind one leader fetch, and total in-flight
+        transfers are bounded by the pull semaphore."""
+        with self._lock:
+            ev = self._pull_waiters.get(oid)
+            leader = ev is None
+            if leader:
+                ev = self._pull_waiters[oid] = threading.Event()
+        if not leader:
+            ev.wait(timeout=120.0)
+            if self.store.contains(oid):
+                return self._local_reply(oid)
+            return None  # leader failed; retry via the locate loop
+        try:
+            with self._pull_sem:
+                for nid, addr in locations:
                     if nid == self.node_id:
                         if self.store.contains(oid):
                             return self._local_reply(oid)
@@ -1141,7 +1171,11 @@ class NodeAgent:
                         return self._local_reply(oid)
                     except Exception:  # noqa: BLE001 - arena full
                         return {"status": "inline", "data": data}
-        return {"status": "timeout"}
+            return None
+        finally:
+            with self._lock:
+                self._pull_waiters.pop(oid, None)
+            ev.set()
 
     def _local_reply(self, oid: str) -> dict:
         """Workers read 'local' objects straight from the shm arena; a
@@ -1420,6 +1454,9 @@ class NodeAgent:
                 "num_workers": len(self._workers),
                 "available": self.ledger.avail_map(),
                 "store": self.store.stats(),
+                "oom_kills": self.metrics_oom_kills,
+                # instrumented_io_context analog: every handler counted+timed
+                "rpc_handlers": HANDLER_STATS.snapshot(),
             }
 
     def _h_shutdown(self, req=None) -> None:

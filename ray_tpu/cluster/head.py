@@ -121,6 +121,7 @@ class HeadServer:
         use_device_scheduler: Optional[bool] = None,
         dashboard_port: Optional[int] = None,
         persist_path: Optional[str] = None,
+        persist_backend: Optional[Any] = None,
     ):
         self.vocab = ResourceVocab()
         self.view = ClusterView(self.vocab)
@@ -167,8 +168,14 @@ class HeadServer:
         self._shutdown = False
         self._persist_path = persist_path
         self._persist_dirty = False
-        self._backend = None
-        if persist_path:
+        self._wal_queue: deque = deque()
+        # pluggable persistence (store_client analog): any object with
+        # load/save_snapshot/wal_append/wal_replay; FilePersistence default
+        self._backend = persist_backend
+        if persist_backend is not None and not persist_path:
+            persist_path = f"<backend:{id(persist_backend)}>"
+            self._persist_path = persist_path
+        if persist_path and self._backend is None:
             from .persistence import FilePersistence
 
             self._backend = FilePersistence(persist_path)
@@ -280,19 +287,37 @@ class HeadServer:
             }
 
     def _wal(self, record: tuple) -> None:
-        """Write-ahead a durable registration: survives a crash BETWEEN
-        snapshot ticks (store_client write-through analog). Only the
-        owning head instance may append."""
+        """Queue a durable registration for the WAL. Called UNDER
+        self._lock so queue order matches memory-mutation order; the
+        actual disk append happens in _wal_flush() AFTER the head lock is
+        released — taking the persist lock here would invert the
+        persist-thread's (persist lock -> head lock) order and deadlock
+        the whole head."""
         if self._backend is None:
+            return
+        self._wal_queue.append(record)
+
+    def _wal_flush(self) -> None:
+        """Drain queued WAL records to disk (call with self._lock NOT
+        held). Records drain in queue order regardless of which handler
+        thread flushes, so replay order always matches acknowledged
+        state."""
+        if self._backend is None or not self._wal_queue:
             return
         lock = _PERSIST_LOCKS[self._persist_path]
         with lock:
             if _PERSIST_OWNER.get(self._persist_path) != id(self):
+                self._wal_queue.clear()
                 return
-            try:
-                self._backend.wal_append(record)
-            except Exception:  # noqa: BLE001 - durability is best-effort
-                logger.exception("WAL append failed")
+            while True:
+                try:
+                    record = self._wal_queue.popleft()
+                except IndexError:
+                    return
+                try:
+                    self._backend.wal_append(record)
+                except Exception:  # noqa: BLE001 - durability best-effort
+                    logger.exception("WAL append failed")
 
     def _load_persisted(self) -> None:
         snap = self._backend.load() or {}
@@ -347,6 +372,53 @@ class HeadServer:
             len(self._recovered_jobs),
             len(records),
         )
+        # actors recovered as RESTARTING normally re-attach when their
+        # hosting agents re-register. One registered-but-never-created
+        # (the WAL window) has NO hosting agent — after a grace period,
+        # resubmit its creation lease or it parks RESTARTING forever.
+        if any(a.state == "RESTARTING" for a in self._actors.values()):
+            threading.Thread(
+                target=self._recover_orphan_actors,
+                name="head-actor-recover",
+                daemon=True,
+            ).start()
+
+    def _recover_orphan_actors(self, grace_s: float = 10.0) -> None:
+        time.sleep(grace_s)
+        to_create = []
+        with self._cond:
+            if self._shutdown:
+                return
+            for info in self._actors.values():
+                if info.state != "RESTARTING" or info.node_id is not None:
+                    continue
+                spec = self._actor_specs.get(info.actor_id)
+                if spec is None:
+                    continue
+                clone = LeaseRequest(
+                    task_id=new_id(),
+                    name=spec.name,
+                    payload=spec.payload,
+                    return_ids=[],
+                    resources=spec.resources,
+                    kind="actor_creation",
+                    actor_id=info.actor_id,
+                    max_retries=0,
+                    strategy=spec.strategy,
+                    runtime_env=spec.runtime_env,
+                    actor_meta=spec.actor_meta,
+                )
+                to_create.append(clone)
+                self._leases[clone.task_id] = clone
+                self._pending.append(clone)
+            if to_create:
+                self._cond.notify_all()
+        if to_create:
+            logger.info(
+                "resubmitting %d recovered actor creations with no "
+                "hosting agent",
+                len(to_create),
+            )
 
     def mark_dirty(self) -> None:
         self._persist_dirty = True
@@ -377,16 +449,18 @@ class HeadServer:
     # ------------------------------------------------------------------
     def _h_kv_put(self, r: dict) -> None:
         with self._lock:
-            # WAL under the same lock as the memory write: replay order
+            # queue under the same lock as the memory write: replay order
             # must match acknowledged state (two racing puts to one key)
             self._kv[r["key"]] = r["value"]
             self._wal(("kv_put", r["key"], r["value"]))
+        self._wal_flush()
         self.mark_dirty()
 
     def _h_kv_del(self, r: dict) -> None:
         with self._lock:
             self._kv.pop(r["key"], None)
             self._wal(("kv_del", r["key"]))
+        self._wal_flush()
         self.mark_dirty()
 
     def _h_register_node(self, info: NodeInfo) -> dict:
@@ -569,6 +643,7 @@ class HeadServer:
                 self._wal(("actor_dead", info.actor_id))
             # wake WaitActor long-polls (push-based actor-state plane)
             self._cond.notify_all()
+        self._wal_flush()
         self.mark_dirty()
         if not restart and spec is not None:
             # the actor is gone for good: its ctor args no longer need to
@@ -1566,6 +1641,7 @@ class HeadServer:
             self._pending.append(spec)
             self._wal(("actor", dict(vars(info)), spec, name))
             self._cond.notify_all()
+        self._wal_flush()
         self.mark_dirty()
         return {"actor_id": spec.actor_id}
 
@@ -1808,6 +1884,11 @@ class HeadServer:
 
     def _h_query_state(self, req: dict) -> Any:
         kind = req.get("kind", "summary")
+        if kind == "rpc_handlers":
+            # per-handler timing (instrumented_io_context stats analog)
+            from .rpc import HANDLER_STATS
+
+            return HANDLER_STATS.snapshot()
         with self._lock:
             if kind == "actors":
                 return [dict(vars(a)) for a in self._actors.values()]

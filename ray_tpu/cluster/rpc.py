@@ -93,6 +93,43 @@ def _get_chaos() -> _Chaos:
     return _chaos
 
 
+class HandlerStats:
+    """Per-handler timing (the reference's event-loop/handler stats,
+    src/ray/common/asio/instrumented_io_context.h — every posted handler
+    is counted and timed). One instance per process; servers share it."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._stats: Dict[str, list] = {}  # name -> [count, total_s, max_s]
+
+    def record(self, name: str, elapsed: float) -> None:
+        with self._lock:
+            row = self._stats.get(name)
+            if row is None:
+                row = self._stats[name] = [0, 0.0, 0.0]
+            row[0] += 1
+            row[1] += elapsed
+            if elapsed > row[2]:
+                row[2] = elapsed
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "count": c,
+                    "total_ms": round(t * 1e3, 3),
+                    "mean_ms": round(t / c * 1e3, 3) if c else 0.0,
+                    "max_ms": round(mx * 1e3, 3),
+                }
+                for name, (c, t, mx) in sorted(self._stats.items())
+            }
+
+
+HANDLER_STATS = HandlerStats()
+
+
 class _GenericHandler(grpc.GenericRpcHandler):
     def __init__(self, handlers: Dict[str, Callable[[Any], Any]]):
         self._handlers = handlers
@@ -104,6 +141,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return None
 
         def unary(request_bytes, context):
+            t0 = time.perf_counter()
             try:
                 req = cloudpickle.loads(request_bytes)
                 return cloudpickle.dumps((True, fn(req)))
@@ -112,6 +150,8 @@ class _GenericHandler(grpc.GenericRpcHandler):
                     return cloudpickle.dumps((False, exc))
                 except Exception:  # unpicklable exception
                     return cloudpickle.dumps((False, RuntimeError(repr(exc))))
+            finally:
+                HANDLER_STATS.record(name, time.perf_counter() - t0)
 
         return grpc.unary_unary_rpc_method_handler(
             unary,

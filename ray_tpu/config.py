@@ -180,6 +180,12 @@ define(
 )
 define("refcount_debug", False, "Record per-ref count history (diagnostics).")
 define(
+    "max_concurrent_pulls",
+    4,
+    "Bound on concurrent inbound peer object transfers per node "
+    "(pull_manager admission; same-object pulls coalesce regardless).",
+)
+define(
     "memory_monitor_interval_s",
     1.0,
     "Agent memory-pressure check period; 0 disables OOM killing.",

@@ -139,3 +139,19 @@ def test_cli_status_and_version(rt):
     )
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout)["nodes"] == 2
+
+
+def test_rpc_handler_stats_recorded():
+    """Every served RPC handler is counted + timed (the reference's
+    instrumented_io_context event-loop stats analog)."""
+    from ray_tpu.cluster.rpc import HANDLER_STATS, RpcClient, RpcServer
+
+    srv = RpcServer({"EchoX": lambda r: r}, port=0)
+    cli = RpcClient(srv.address)
+    for i in range(5):
+        assert cli.call("EchoX", i) == i
+    snap = HANDLER_STATS.snapshot()
+    assert snap["EchoX"]["count"] >= 5
+    assert snap["EchoX"]["max_ms"] >= snap["EchoX"]["mean_ms"] >= 0
+    cli.close()
+    srv.stop()
