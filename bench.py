@@ -350,11 +350,18 @@ def main():
                 "bench_host_cpu_cores": os.cpu_count(),
                 # on-device kernel throughput over the reference's e2e
                 # number is apples-to-oranges; published only under this
-                # explicit name (round-2 advisor finding)
-                "kernel_vs_e2e_baseline": round(
-                    out.get("sched_placements_per_s", 0.0)
-                    / BASELINE_E2E_TASKS_PER_S,
-                    2,
+                # explicit name (round-2 advisor finding), and only when
+                # the kernel tier actually ran
+                **(
+                    {
+                        "kernel_vs_e2e_baseline": round(
+                            out["sched_placements_per_s"]
+                            / BASELINE_E2E_TASKS_PER_S,
+                            2,
+                        )
+                    }
+                    if "sched_placements_per_s" in out
+                    else {}
                 ),
                 **out,
             }

@@ -1,2 +1,3 @@
 """ray_tpu.autoscaler — demand-driven cluster scaling on the binpack kernels."""
 from .autoscaler import Autoscaler, NodeTypeConfig, SimNodeProvider  # noqa: F401
+from .providers import InstanceManager, LocalNodeProvider  # noqa: F401

@@ -81,6 +81,13 @@ class Autoscaler:
         self.runtime = runtime
         self.node_types = {t.name: t for t in node_types}
         self.provider = provider or SimNodeProvider(runtime)
+        # cluster-mode runtimes have no resource vocab of their own
+        if getattr(runtime, "vocab", None) is not None:
+            self.vocab = runtime.vocab
+        else:
+            from ray_tpu.scheduler import ResourceVocab
+
+            self.vocab = ResourceVocab()
         self.idle_timeout_s = idle_timeout_s
         self.tick_interval_s = tick_interval_s
         self._idle_since: Dict[str, float] = {}
@@ -108,6 +115,9 @@ class Autoscaler:
 
     # -- one reconcile pass --------------------------------------------
     def tick(self) -> ScalingDecision:
+        # v2 reconciler: retry lost launches, promote REQUESTED->RUNNING
+        if hasattr(self.provider, "reconcile"):
+            self.provider.reconcile()
         decision = self.plan()
         for type_name, count in decision.launch.items():
             for _ in range(count):
@@ -134,33 +144,31 @@ class Autoscaler:
         # 2. demand-driven launches
         demands = self.runtime.pending_resource_demands()
         if demands:
-            width = self.runtime.vocab.capacity
+            width = self.vocab.capacity
             dmat = np.stack(
-                [
-                    self.runtime.vocab.pack(d).astype(np.float32)
-                    for d in demands
-                ]
+                [self.vocab.pack(d).astype(np.float32) for d in demands]
             )[:, :width]
             dmat = dmat[sort_demands(dmat)]
             avail_rows = [
-                self.runtime.vocab.pack(n["Available"])[:width] for n in nodes
+                self.vocab.pack(n["Available"])[:width] for n in nodes
             ]
             # nodes already queued for launch (min_workers fill) count as
             # capacity — otherwise demand double-provisions on cold start
             for type_name, count in decision.launch.items():
-                row = self.runtime.vocab.pack(
+                row = self.vocab.pack(
                     self.node_types[type_name].resources
                 )[:width]
                 avail_rows.extend([row] * count)
-            avail = (
-                np.stack(avail_rows)
-                if avail_rows
-                else np.zeros((0, width), np.float32)
-            )
-            res = bin_pack_residual(avail, dmat)
-            unfulfilled = dmat[np.asarray(res.node) < 0]
+            if avail_rows:
+                avail = np.stack(avail_rows)
+                res = bin_pack_residual(avail, dmat)
+                unfulfilled = dmat[np.asarray(res.node) < 0]
+            else:
+                # zero nodes (cold cluster): everything is unfulfilled —
+                # the packing kernel needs at least one bin
+                unfulfilled = dmat
             type_rows = {
-                t.name: self.runtime.vocab.pack(t.resources)[:width]
+                t.name: self.vocab.pack(t.resources)[:width]
                 for t in self.node_types.values()
             }
             names = list(type_rows)
@@ -189,11 +197,15 @@ class Autoscaler:
 
         # 3. idle termination (keep min_workers)
         now = time.monotonic()
+        local_nodes = getattr(self.runtime, "nodes", None)
         for n in nodes:
             nid = n["NodeID"]
-            idle = n["Available"] == n["Resources"] and not self.runtime.nodes[
-                nid
-            ].running_tasks
+            # Available==Resources alone is NOT idle: zero-resource actors
+            # and tasks hold nothing — consult the Busy flag (cluster mode)
+            # or the node's running-task set (in-process mode)
+            idle = n["Available"] == n["Resources"] and not n.get("Busy")
+            if idle and local_nodes is not None and nid in local_nodes:
+                idle = not local_nodes[nid].running_tasks
             if idle:
                 self._idle_since.setdefault(nid, now)
                 t = n["Labels"].get(NODE_TYPE_LABEL)

@@ -1,0 +1,230 @@
+"""Node providers + the v2 instance-manager reconciler.
+
+Reference analogs: the provider zoo under
+/root/reference/python/ray/autoscaler/ (aws/gcp/.../local/fake_multi_node
+node_provider.py) and the v2 InstanceManager
+(autoscaler/v2/instance_manager/) that reconciles desired instances
+against what the cloud actually delivered.
+
+``LocalNodeProvider`` is the real-process provider: create_node spawns an
+actual ``ray_tpu.cluster.agent`` subprocess that registers with a live
+head — the local/fake_multi_node pattern, except the nodes are fully
+functional agents with worker pools and object stores. Cloud SDK
+providers implement the same three methods against their APIs.
+
+``InstanceManager`` wraps any provider with declarative instance records:
+a launch is REQUESTED until the node appears in the head's membership,
+RUNNING afterwards; launches that never materialize within the timeout
+are retried (the v2 reconciler loop collapsed to its core).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .autoscaler import NODE_TYPE_LABEL, NodeTypeConfig
+
+
+class LocalNodeProvider:
+    """Real agent subprocesses against a live head."""
+
+    def __init__(self, head_address: str, num_workers: int = 2):
+        self.head_address = head_address
+        self.num_workers = num_workers
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._head = None
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def _head_client(self):
+        if self._head is None:
+            from ray_tpu.cluster.rpc import RpcClient
+
+            self._head = RpcClient(self.head_address)
+        return self._head
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        from ray_tpu.cluster.common import new_id
+
+        node_id = new_id()
+        resources = dict(node_type.resources)
+        resources.setdefault("memory", float(4 << 30))
+        resources.setdefault("object_store_memory", float(1 << 30))
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.cluster.agent",
+                "--head",
+                self.head_address,
+                "--resources",
+                json.dumps(resources),
+                "--labels",
+                json.dumps({NODE_TYPE_LABEL: node_type.name}),
+                "--num-workers",
+                str(self.num_workers),
+                "--node-id",
+                node_id,
+            ],
+        )
+        with self._lock:
+            self._procs[node_id] = proc
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        # graceful: tell the agent to shut down (releases arena/ports);
+        # the process handle is the backstop
+        try:
+            for n in self.non_terminated_nodes():
+                if n["NodeID"] == node_id:
+                    from ray_tpu.cluster.rpc import RpcClient
+
+                    RpcClient(n["Address"]).call(
+                        "Shutdown", timeout=5.0
+                    )
+                    break
+        except Exception:  # noqa: BLE001 - hard kill below
+            pass
+        with self._lock:
+            proc = self._procs.pop(node_id, None)
+        if proc is not None:
+            try:
+                proc.terminate()
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+    def non_terminated_nodes(self) -> List[dict]:
+        reply = self._head_client().call("ClusterInfo", timeout=15.0)
+        return [n for n in reply["nodes"] if n["Alive"]]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+
+
+@dataclass
+class _Instance:
+    instance_id: str
+    node_type: str
+    state: str  # REQUESTED | RUNNING | TERMINATED
+    node_id: Optional[str] = None
+    requested_at: float = field(default_factory=time.monotonic)
+    retries: int = 0
+
+
+class InstanceManager:
+    """Declarative reconcile over a provider (v2 instance_manager core):
+    tracks every launch from REQUESTED to RUNNING, retries launches the
+    provider lost, and exposes the same provider interface so the
+    Autoscaler composes with it transparently."""
+
+    def __init__(
+        self,
+        provider,
+        *,
+        launch_timeout_s: float = 60.0,
+        max_retries: int = 2,
+    ):
+        self.provider = provider
+        self.launch_timeout_s = launch_timeout_s
+        self.max_retries = max_retries
+        self.instances: Dict[str, _Instance] = {}
+        self._counter = 0
+        self._lock = threading.Lock()
+        self._types: Dict[str, NodeTypeConfig] = {}
+
+    # -- provider interface (delegated + recorded) ----------------------
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        node_id = self.provider.create_node(node_type)
+        with self._lock:
+            self._counter += 1
+            iid = f"inst-{self._counter}"
+            self._types[node_type.name] = node_type
+            self.instances[iid] = _Instance(
+                instance_id=iid,
+                node_type=node_type.name,
+                state="REQUESTED",
+                node_id=node_id,
+            )
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self.provider.terminate_node(node_id)
+        with self._lock:
+            for inst in self.instances.values():
+                if inst.node_id == node_id:
+                    inst.state = "TERMINATED"
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return self.provider.non_terminated_nodes()
+
+    # -- reconcile ------------------------------------------------------
+    def reconcile(self) -> None:
+        """REQUESTED instances whose node registered become RUNNING;
+        launches that never materialized within the timeout are retried
+        (up to max_retries) or marked TERMINATED."""
+        alive = {n["NodeID"] for n in self.provider.non_terminated_nodes()}
+        now = time.monotonic()
+        relaunch: List[_Instance] = []
+        with self._lock:
+            for inst in self.instances.values():
+                if inst.state == "REQUESTED":
+                    if inst.node_id in alive:
+                        inst.state = "RUNNING"
+                    elif now - inst.requested_at > self.launch_timeout_s:
+                        inst.state = "TERMINATED"
+                        if inst.retries < self.max_retries:
+                            relaunch.append(inst)
+                elif inst.state == "RUNNING" and inst.node_id not in alive:
+                    # node died underneath us; record it (the autoscaler's
+                    # demand loop decides whether replacement is needed)
+                    inst.state = "TERMINATED"
+        for inst in relaunch:
+            cfg = self._types.get(inst.node_type)
+            if cfg is None:
+                continue
+            # reap the original launch FIRST: a slow-spawning agent that
+            # registers after its replacement would over-provision the
+            # cluster past max_workers
+            if inst.node_id is not None:
+                try:
+                    self.provider.terminate_node(inst.node_id)
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
+            node_id = self.provider.create_node(cfg)
+            with self._lock:
+                self._counter += 1
+                iid = f"inst-{self._counter}"
+                self.instances[iid] = _Instance(
+                    instance_id=iid,
+                    node_type=cfg.name,
+                    state="REQUESTED",
+                    node_id=node_id,
+                    retries=inst.retries + 1,
+                )
+
+    def summary(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for inst in self.instances.values():
+                out[inst.state] = out.get(inst.state, 0) + 1
+            return out
+
+    def shutdown(self) -> None:
+        if hasattr(self.provider, "shutdown"):
+            self.provider.shutdown()

@@ -1121,12 +1121,17 @@ class NodeAgent:
             if status == "inline":
                 return {"status": "inline", "data": reply["data"]}
             if status == "located":
-                out = self._pull_located(oid, reply["locations"])
+                remaining = None
+                if deadline is not None:
+                    remaining = max(0.1, deadline - time.monotonic())
+                out = self._pull_located(oid, reply["locations"], remaining)
                 if out is not None:
                     return out
         return {"status": "timeout"}
 
-    def _pull_located(self, oid: str, locations) -> Optional[dict]:
+    def _pull_located(
+        self, oid: str, locations, wait_s: Optional[float] = None
+    ) -> Optional[dict]:
         """Admission-controlled peer pull: concurrent requests for the same
         object coalesce behind one leader fetch, and total in-flight
         transfers are bounded by the pull semaphore."""
@@ -1136,7 +1141,8 @@ class NodeAgent:
             if leader:
                 ev = self._pull_waiters[oid] = threading.Event()
         if not leader:
-            ev.wait(timeout=120.0)
+            # followers honor the CALLER's deadline, not a fixed park
+            ev.wait(timeout=120.0 if wait_s is None else min(wait_s, 120.0))
             if self.store.contains(oid):
                 return self._local_reply(oid)
             return None  # leader failed; retry via the locate loop

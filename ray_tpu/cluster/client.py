@@ -1013,6 +1013,10 @@ class RemoteRuntime:
     def nodes_info(self) -> List[Dict[str, Any]]:
         return self._read("ClusterInfo")["nodes"]
 
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        """Autoscaler demand feed (queued/infeasible leases + PG bundles)."""
+        return self._read("PendingDemands")
+
     def cluster_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
         for n in self.nodes_info():

@@ -179,6 +179,9 @@ class HeadServer:
             from .persistence import FilePersistence
 
             self._backend = FilePersistence(persist_path)
+        if self._backend is not None:
+            # lock/owner registration guards EVERY backend (a custom one
+            # too), or _wal_flush/_persist_now KeyError on first use
             with _PERSIST_REG_LOCK:
                 _PERSIST_LOCKS.setdefault(persist_path, threading.Lock())
                 _PERSIST_OWNER[persist_path] = id(self)
@@ -212,6 +215,7 @@ class HeadServer:
             "CreateActor": self._h_create_actor,
             "GetActor": self._h_get_actor,
             "WaitActor": self._h_wait_actor,
+            "PendingDemands": self._h_pending_demands,
             "KillActor": self._h_kill_actor,
             "CreatePlacementGroup": self._h_create_pg,
             "WaitPlacementGroup": self._h_wait_pg,
@@ -1361,14 +1365,23 @@ class HeadServer:
         order = np.arange(n)
         for i, spec in enumerate(specs):
             feasible = (avail >= demands[i]).all(axis=1) & alive
-            rot = np.roll(order, -self._spread_rr)
-            cand = rot[feasible[rot]]
-            if cand.size == 0:
-                with self._cond:
-                    self._infeasible.append(spec)
-                continue
-            row = int(cand[0])
-            self._spread_rr = (row + 1) % n
+            if spec.strategy == "RANDOM":
+                # random_scheduling_policy.cc analog: uniform over feasible
+                cand = np.flatnonzero(feasible)
+                if cand.size == 0:
+                    with self._cond:
+                        self._infeasible.append(spec)
+                    continue
+                row = int(self._rng.choice(cand))
+            else:
+                rot = np.roll(order, -self._spread_rr)
+                cand = rot[feasible[rot]]
+                if cand.size == 0:
+                    with self._cond:
+                        self._infeasible.append(spec)
+                    continue
+                row = int(cand[0])
+                self._spread_rr = (row + 1) % n
             avail[row] -= demands[i]
             with self._lock:
                 self.view.subtract(row, demands[i])
@@ -1452,8 +1465,8 @@ class HeadServer:
             self._dispatch(spec, info.node_id)
             return "done"
         strat = spec.strategy
-        if strat == "SPREAD":
-            return "spread"
+        if strat in ("SPREAD", "RANDOM"):
+            return "spread"  # both use the vectorized round-robin pass
         if isinstance(strat, NodeLabelSchedulingStrategy):
             node_id = self._pick_labeled_node(strat, spec.resources)
             if node_id is None:
@@ -1670,6 +1683,20 @@ class HeadServer:
             self._cond.notify_all()
         self.mark_dirty()
 
+    def _h_pending_demands(self, req=None) -> List[Dict[str, float]]:
+        """Queued + infeasible lease shapes and unplaced PG bundles — the
+        autoscaler's demand source (GcsAutoscalerStateManager
+        ClusterResourceState analog)."""
+        with self._cond:
+            out = [dict(s.resources) for s in self._pending if s.resources]
+            out += [
+                dict(s.resources) for s in self._infeasible if s.resources
+            ]
+            for pg in self._pending_pgs:
+                if not pg.ready.is_set() and not pg.removed:
+                    out.extend(dict(b) for b in pg.bundles)
+        return out
+
     def _h_wait_actor(self, req: dict) -> ActorInfo:
         """Long-poll an actor's state: blocks server-side until it leaves
         PENDING/RESTARTING or the window closes (publisher.h actor-state
@@ -1865,6 +1892,10 @@ class HeadServer:
     def _h_cluster_info(self, req) -> dict:
         with self._lock:
             totals, avail, _ = self.view.active_arrays()
+            busy_nodes = {nid for _, nid in self._in_flight.values()}
+            for info in self._actors.values():
+                if info.state == "ALIVE" and info.node_id:
+                    busy_nodes.add(info.node_id)
             nodes = []
             for nid, n in self.nodes.items():
                 row = self.view.row_of(nid) if n.alive else None
@@ -1878,6 +1909,9 @@ class HeadServer:
                         if row is not None
                         else {},
                         "Labels": dict(n.labels),
+                        # zero-resource work keeps Available==Resources: the
+                        # autoscaler needs a liveness signal beyond arithmetic
+                        "Busy": nid in busy_nodes,
                     }
                 )
         return {"nodes": nodes, "metrics": dict(self.metrics)}

@@ -586,9 +586,12 @@ class Runtime:
 
     _SENTINEL = object()
 
-    def _pick_spread_node(self, spec: TaskSpec) -> Optional[str]:
-        """Distinct SPREAD: round-robin over feasible alive nodes
-        (spread_scheduling_policy.cc:26 analog)."""
+    def _pick_spread_node(
+        self, spec: TaskSpec, random: bool = False
+    ) -> Optional[str]:
+        """Distinct SPREAD (round-robin) / RANDOM (uniform) over feasible
+        alive nodes (spread_scheduling_policy.cc:26 /
+        random_scheduling_policy.cc analogs)."""
         req = ResourceRequest.from_map(self.vocab, spec.resources)
         with self._lock:
             avail, alive = self.view.active_arrays()[1:]
@@ -600,6 +603,11 @@ class Runtime:
                 return None  # no nodes / unknown resource: park infeasible
             d = req.dense(r)
             feasible = (avail >= d).all(axis=1) & alive
+            if random:
+                cand = np.flatnonzero(feasible)
+                if cand.size == 0:
+                    return None
+                return self.view.node_id(int(self._rng.choice(cand)))
             order = np.roll(np.arange(n), -self._spread_rr)
             cand = order[feasible[order]]
             if cand.size == 0:
@@ -646,8 +654,8 @@ class Runtime:
         strat = spec.strategy
         if strat is None or strat == "DEFAULT":
             return _HYBRID
-        if strat == "SPREAD":
-            target = self._pick_spread_node(spec)
+        if strat in ("SPREAD", "RANDOM"):
+            target = self._pick_spread_node(spec, random=strat == "RANDOM")
             return None if target is None else (target, None)
         if isinstance(strat, NodeLabelSchedulingStrategy):
             target = self._pick_labeled_node(strat, spec.resources)

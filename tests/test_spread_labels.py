@@ -133,3 +133,20 @@ def test_cluster_spread_and_labels():
         set_runtime(None)
         client.shutdown()
         c.shutdown()
+
+
+def test_random_strategy_places_feasibly():
+    """RANDOM policy (random_scheduling_policy.cc analog): places on a
+    uniformly chosen FEASIBLE node; distribution covers several nodes."""
+    rt = ray_tpu.init(num_nodes=4, resources_per_node={"CPU": 8})
+    try:
+        f = ray_tpu.remote(_node_of).options(
+            scheduling_strategy="RANDOM", num_cpus=0.1
+        )
+        seen = collections.Counter(
+            ray_tpu.get([f.remote() for _ in range(30)], timeout=120)
+        )
+        assert len(seen) >= 2  # randomness spreads across nodes
+        assert sum(seen.values()) == 30
+    finally:
+        ray_tpu.shutdown()
