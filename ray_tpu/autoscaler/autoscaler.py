@@ -134,6 +134,15 @@ class Autoscaler:
             t = n["Labels"].get(NODE_TYPE_LABEL)
             if t in counts:
                 counts[t] += 1
+        # launches still in flight (REQUESTED at the instance manager)
+        # count as existing capacity — otherwise every tick re-launches
+        # the same demand until the first agents finish registering
+        pending_launches: Dict[str, int] = {}
+        if hasattr(self.provider, "pending_launches"):
+            pending_launches = self.provider.pending_launches()
+            for t, c in pending_launches.items():
+                if t in counts:
+                    counts[t] += c
 
         # 1. min_workers fill (_add_min_workers_nodes)
         for t in self.node_types.values():
@@ -152,9 +161,15 @@ class Autoscaler:
             avail_rows = [
                 self.vocab.pack(n["Available"])[:width] for n in nodes
             ]
-            # nodes already queued for launch (min_workers fill) count as
-            # capacity — otherwise demand double-provisions on cold start
-            for type_name, count in decision.launch.items():
+            # nodes already queued for launch (min_workers fill) AND
+            # launches in flight count as capacity — otherwise demand
+            # double-provisions on cold start
+            hypothetical = dict(decision.launch)
+            for t, c in pending_launches.items():
+                hypothetical[t] = hypothetical.get(t, 0) + c
+            for type_name, count in hypothetical.items():
+                if type_name not in self.node_types:
+                    continue
                 row = self.vocab.pack(
                     self.node_types[type_name].resources
                 )[:width]
@@ -202,10 +217,16 @@ class Autoscaler:
             nid = n["NodeID"]
             # Available==Resources alone is NOT idle: zero-resource actors
             # and tasks hold nothing — consult the Busy flag (cluster mode)
-            # or the node's running-task set (in-process mode)
+            # or running tasks + hosted alive actors (in-process mode)
             idle = n["Available"] == n["Resources"] and not n.get("Busy")
             if idle and local_nodes is not None and nid in local_nodes:
                 idle = not local_nodes[nid].running_tasks
+                if idle:
+                    actors = getattr(self.runtime, "_actors", {})
+                    idle = not any(
+                        st.alive and st.node_id == nid
+                        for st in actors.values()
+                    )
             if idle:
                 self._idle_since.setdefault(nid, now)
                 t = n["Labels"].get(NODE_TYPE_LABEL)

@@ -38,7 +38,6 @@ class LocalNodeProvider:
         self.num_workers = num_workers
         self._procs: Dict[str, subprocess.Popen] = {}
         self._head = None
-        self._counter = 0
         self._lock = threading.Lock()
 
     def _head_client(self):
@@ -84,9 +83,11 @@ class LocalNodeProvider:
                 if n["NodeID"] == node_id:
                     from ray_tpu.cluster.rpc import RpcClient
 
-                    RpcClient(n["Address"]).call(
-                        "Shutdown", timeout=5.0
-                    )
+                    cli = RpcClient(n["Address"])
+                    try:
+                        cli.call("Shutdown", timeout=5.0)
+                    finally:
+                        cli.close()
                     break
         except Exception:  # noqa: BLE001 - hard kill below
             pass
@@ -99,7 +100,8 @@ class LocalNodeProvider:
             except Exception:  # noqa: BLE001
                 try:
                     proc.kill()
-                except OSError:
+                    proc.wait(timeout=2)  # reap: no zombie accumulation
+                except Exception:  # noqa: BLE001
                     pass
 
     def non_terminated_nodes(self) -> List[dict]:
@@ -113,8 +115,12 @@ class LocalNodeProvider:
         for p in procs:
             try:
                 p.terminate()
-            except OSError:
+                p.wait(timeout=2)
+            except Exception:  # noqa: BLE001
                 pass
+        if self._head is not None:
+            self._head.close()
+            self._head = None
 
 
 @dataclass
@@ -181,6 +187,7 @@ class InstanceManager:
         alive = {n["NodeID"] for n in self.provider.non_terminated_nodes()}
         now = time.monotonic()
         relaunch: List[_Instance] = []
+        reap: List[str] = []
         with self._lock:
             for inst in self.instances.values():
                 if inst.state == "REQUESTED":
@@ -190,10 +197,20 @@ class InstanceManager:
                         inst.state = "TERMINATED"
                         if inst.retries < self.max_retries:
                             relaunch.append(inst)
+                        else:
+                            # retries exhausted: still reap the straggling
+                            # process or it registers later as an untracked
+                            # node (relaunch reaps its own below)
+                            reap.append(inst.node_id)
                 elif inst.state == "RUNNING" and inst.node_id not in alive:
                     # node died underneath us; record it (the autoscaler's
                     # demand loop decides whether replacement is needed)
                     inst.state = "TERMINATED"
+        for node_id in reap:
+            try:
+                self.provider.terminate_node(node_id)
+            except Exception:  # noqa: BLE001 - already gone
+                pass
         for inst in relaunch:
             cfg = self._types.get(inst.node_type)
             if cfg is None:
@@ -217,6 +234,17 @@ class InstanceManager:
                     node_id=node_id,
                     retries=inst.retries + 1,
                 )
+
+    def pending_launches(self) -> Dict[str, int]:
+        """REQUESTED instances per node type — capacity the autoscaler
+        must count as already on its way (or every tick re-launches the
+        same demand until the first agents register)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for inst in self.instances.values():
+                if inst.state == "REQUESTED":
+                    out[inst.node_type] = out.get(inst.node_type, 0) + 1
+            return out
 
     def summary(self) -> Dict[str, int]:
         with self._lock:

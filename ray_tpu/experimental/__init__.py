@@ -1,0 +1,7 @@
+"""ray_tpu.experimental — channels (mutable objects) and pre-GA surfaces."""
+from .channel import (  # noqa: F401
+    Channel,
+    ChannelClosed,
+    ChannelReader,
+    ChannelWriter,
+)

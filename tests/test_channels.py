@@ -1,0 +1,72 @@
+"""Mutable-object channels (ray_tpu.experimental.Channel): repeated
+writes into one shared slot pipe, cross-process via picklable handles."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import Channel, ChannelClosed
+
+
+def test_in_process_stream_and_close():
+    ch = Channel(buffer_size_bytes=1 << 16)
+    try:
+        for i in range(100):
+            ch.writer.write({"i": i})
+        got = [ch.reader.read(timeout=5) for _ in range(100)]
+        assert [g["i"] for g in got] == list(range(100))
+        ch.writer.close_channel()
+        with pytest.raises(ChannelClosed):
+            ch.reader.read(timeout=5)
+    finally:
+        ch.destroy()
+
+
+def test_read_timeout():
+    ch = Channel(buffer_size_bytes=1 << 14)
+    try:
+        with pytest.raises(TimeoutError):
+            ch.reader.read(timeout=0.2)
+    finally:
+        ch.destroy()
+
+
+def test_tensor_payloads_use_raw_codec():
+    ch = Channel(buffer_size_bytes=1 << 20)
+    try:
+        arr = np.arange(1024, dtype=np.float32).reshape(32, 32)
+        ch.writer.write(arr)
+        out = ch.reader.read(timeout=5)
+        assert out.dtype == np.float32 and np.array_equal(out, arr)
+    finally:
+        ch.destroy()
+
+
+def test_cross_process_streaming():
+    """Writer handle pickled into a cluster task; driver-side reader
+    consumes the stream concurrently (same-host mutable object)."""
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    client = c.client()
+    set_runtime(client)
+    ch = Channel(buffer_size_bytes=1 << 18)
+    try:
+
+        def produce(writer, n):
+            for i in range(n):
+                writer.write(i * i)
+            writer.close_channel()
+            return n
+
+        f = ray_tpu.remote(produce).options(num_cpus=0.5, max_retries=0)
+        ref = f.remote(ch.writer, 500)
+        got = list(ch.reader)
+        assert got == [i * i for i in range(500)]
+        assert ray_tpu.get(ref, timeout=60) == 500
+    finally:
+        set_runtime(None)
+        ch.destroy()
+        client.shutdown()
+        c.shutdown()
