@@ -82,11 +82,22 @@ class RefTracker:
                 return
             self._counts.pop(hex_id, None)
             self._zeros.append(hex_id)
-        self.zero_event.set()
+        # debounced wake: set() on an Event takes its condition lock even
+        # when already set — under a release storm that is thousands of
+        # redundant lock round-trips on the hot __del__ path
+        if not self.zero_event.is_set():
+            self.zero_event.set()
 
     def count(self, hex_id: str) -> int:
         with self._lock:
             return self._counts.get(hex_id, 0)
+
+    def all_zero(self, hex_ids) -> List[str]:
+        """Subset of ``hex_ids`` with count 0, under ONE lock acquisition
+        (the flusher's re-check; per-id count() calls serialize against
+        the incref/decref hot path)."""
+        with self._lock:
+            return [h for h in hex_ids if self._counts.get(h, 0) == 0]
 
     def drain_zeros(self) -> List[str]:
         """Ids whose count hit zero since the last drain and is STILL zero
@@ -264,9 +275,10 @@ class RefFlusher:
 
     def flush(self) -> None:
         zeros = TRACKER.drain_zeros()
+        still_zero = set(TRACKER.all_zero(zeros))
         with self._held_lock:
             for h in zeros:
-                if h in self._held_at_head and TRACKER.count(h) == 0:
+                if h in self._held_at_head and h in still_zero:
                     self._held_at_head.discard(h)
                     self._owed.add(h)
             # a re-borrow between flushes cancels the owed release
