@@ -113,6 +113,9 @@ class Worker:
         self._batch_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="task-batch"
         )
+        # asyncio loops being torn down by KillActor: batch task creation
+        # must not slip new tasks past drain_and_stop's cancellation sweep
+        self._stopping_loops: set = set()
         # compiled-DAG programs resident in this worker:
         # dag_id -> {"stop": Event, "threads": [...], "channels": [...]}
         self._dag_programs: Dict[str, dict] = {}
@@ -700,12 +703,24 @@ class Worker:
         self._done_pool.submit(resolve_then_schedule)
         return None
 
-    @staticmethod
-    def _schedule_coro_batch(loop, pairs) -> None:
+    def _schedule_coro_batch(self, loop, pairs) -> None:
         """Create all of a batch's tasks on the loop in one hop, bridging
         each asyncio task to its concurrent Future."""
 
         def create_all() -> None:
+            if id(loop) in self._stopping_loops:
+                # KillActor is draining this loop: creating tasks now
+                # would slip them past the cancellation sweep and leave
+                # their futures unresolved forever
+                import concurrent.futures as cf
+
+                for coro, cfut in pairs:
+                    coro.close()
+                    if cfut.set_running_or_notify_cancel():
+                        cfut.set_exception(
+                            RuntimeError("actor is being killed")
+                        )
+                return
             for coro, cfut in pairs:
                 task = loop.create_task(coro)
 
@@ -1060,6 +1075,7 @@ class Worker:
         entry = self._actor_loops.pop(req["actor_id"], None)
         if entry is not None:
             loop, _ = entry
+            self._stopping_loops.add(id(loop))
 
             def begin_shutdown() -> None:
                 import asyncio
@@ -1068,12 +1084,18 @@ class Worker:
                     # cancel in-flight methods and WAIT for the cancellations
                     # to land: their futures resolve with CancelledError →
                     # TaskDone(error) → callers unblock, instead of freezing
-                    # forever on a stopped loop
+                    # forever on a stopped loop. Repeat until quiescent:
+                    # a queued create_all can add tasks after one sweep.
                     me = asyncio.current_task()
-                    tasks = [t for t in asyncio.all_tasks() if t is not me]
-                    for t in tasks:
-                        t.cancel()
-                    await asyncio.gather(*tasks, return_exceptions=True)
+                    for _ in range(10):
+                        tasks = [
+                            t for t in asyncio.all_tasks() if t is not me
+                        ]
+                        if not tasks:
+                            break
+                        for t in tasks:
+                            t.cancel()
+                        await asyncio.gather(*tasks, return_exceptions=True)
                     loop.stop()
 
                 loop.create_task(drain_and_stop())

@@ -275,8 +275,12 @@ class RefFlusher:
 
     def flush(self) -> None:
         zeros = TRACKER.drain_zeros()
-        still_zero = set(TRACKER.all_zero(zeros))
         with self._held_lock:
+            # the zero re-check MUST happen under _held_lock: sync_incref
+            # (a re-borrow) holds it while deciding an id is already
+            # registered — a snapshot taken before would race it and
+            # release a ref the borrower still holds
+            still_zero = set(TRACKER.all_zero(zeros))
             for h in zeros:
                 if h in self._held_at_head and h in still_zero:
                     self._held_at_head.discard(h)

@@ -103,6 +103,8 @@ class ContinuousBatchingEngine:
         n_pages: int = 256,
         max_pages_per_seq: Optional[int] = None,
         tokenizer: Optional[Any] = None,
+        use_pallas_attention: bool = False,
+        pallas_interpret: bool = False,
     ):
         if cfg.n_experts > 0:
             raise NotImplementedError(
@@ -119,6 +121,14 @@ class ContinuousBatchingEngine:
             self.pool.usable_pages,
         )
         self.tokenizer = tokenizer or ByteTokenizer()
+        # opt-in Pallas paged-attention decode (ops/paged_attention.py);
+        # the XLA gather formulation stays the default. NOTE: the pool is
+        # stored page-major, so this path pays a per-layer head-major
+        # transpose each step — the kernel is validated infrastructure;
+        # flipping the pool layout (and both write scatters) to head-major
+        # is the planned follow-up once real-TPU profiling can guide it
+        self.use_pallas_attention = use_pallas_attention
+        self.pallas_interpret = pallas_interpret
         self.params = (
             params
             if params is not None
@@ -212,9 +222,30 @@ class ContinuousBatchingEngine:
                 pv = pv.at[li, page_ids, offsets].set(
                     jnp.where(active[:, None, None], v.astype(pv.dtype), pv[li, page_ids, offsets])
                 )
-                k_pages = pk[li][tables]  # [B, P, page, KH, hd]
-                v_pages = pv[li][tables]
-                attn = _attention_pages(q, k_pages, v_pages, positions)
+                if self.use_pallas_attention:
+                    from ray_tpu.ops.paged_attention import (
+                        paged_attention_decode,
+                    )
+
+                    kh = cfg.n_kv_heads
+                    groups = cfg.n_heads // kh
+                    qh = q.reshape(b, kh, groups, cfg.head_dim)
+                    # head-major pool slice for the kernel's per-head grid
+                    kp = jnp.transpose(pk[li], (2, 0, 1, 3))
+                    vp = jnp.transpose(pv[li], (2, 0, 1, 3))
+                    attn = paged_attention_decode(
+                        qh,
+                        kp,
+                        vp,
+                        tables,
+                        positions + 1,
+                        page_size=page,
+                        interpret=self.pallas_interpret,
+                    ).reshape(b, cfg.n_heads * cfg.head_dim)
+                else:
+                    k_pages = pk[li][tables]  # [B, P, page, KH, hd]
+                    v_pages = pv[li][tables]
+                    attn = _attention_pages(q, k_pages, v_pages, positions)
                 h = h + (attn.astype(cfg.dtype) @ p["wo"])
                 x2 = tfm.rms_norm(h, p["ln2"])
                 y = tfm.swiglu(x2, p["w_gate"], p["w_up"], p["w_down"])

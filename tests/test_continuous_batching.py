@@ -112,3 +112,26 @@ def test_long_prompt_multiple_pages(small):
     assert paged.generate_ids(prompts, gen) == dense.generate_ids(
         prompts, gen
     )
+
+
+def test_pallas_attention_matches_gather_path(small):
+    """The Pallas paged-attention decode (interpret mode) is a drop-in for
+    the XLA gather path: identical greedy tokens."""
+    cfg, params = small
+    base = ContinuousBatchingEngine(
+        cfg, params, max_batch=3, page_size=8, n_pages=48
+    )
+    pallas = ContinuousBatchingEngine(
+        cfg,
+        params,
+        max_batch=3,
+        page_size=8,
+        n_pages=48,
+        use_pallas_attention=True,
+        pallas_interpret=True,
+    )
+    prompts = [[2, 4, 6, 8], [1, 3, 5], [7]]
+    gen = GenerationConfig(max_new_tokens=10, temperature=0.0)
+    assert pallas.generate_ids(prompts, gen) == base.generate_ids(
+        prompts, gen
+    )
