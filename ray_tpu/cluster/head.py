@@ -1229,6 +1229,16 @@ class HeadServer:
         # wedged) backend bring-up must stall only the scheduler thread,
         # never every RPC handler that needs the lock
         device_state = self.device_state
+        # crossover: tiny rounds pay more in device dispatch than the
+        # kernel saves — below the threshold use the host golden model
+        # (same math; scheduler/hybrid.py golden tests pin equivalence)
+        from ray_tpu.config import cfg as _cfg
+
+        if (
+            device_state is not None
+            and len(kernel_batch) < _cfg.sched_device_min_batch
+        ):
+            device_state = None
         with self._lock:
             n = self.view.num_nodes
             r = self.view.totals.shape[1]

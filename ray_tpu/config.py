@@ -132,6 +132,13 @@ define(
 )
 define("xla_cache", "/tmp/ray_tpu_xla_cache", "JAX compilation cache dir.")
 define(
+    "sched_device_min_batch",
+    0,
+    "Batches smaller than this schedule on the host golden model even "
+    "when the XLA device scheduler is up (per-dispatch overhead beats "
+    "kernel gains for tiny rounds; 0 = always use the device kernels).",
+)
+define(
     "native_ledger",
     True,
     "Use the C++ fixed-point resource ledger (vs pure-Python fallback).",

@@ -490,6 +490,34 @@ class ContinuousBatchingEngine:
             self.step()
         return [self.results.pop(i) for i in ids]
 
+    def stream_ids(
+        self,
+        prompt: List[int],
+        gen: GenerationConfig = GenerationConfig(),
+    ):
+        """Incremental generation: yields token ids as decode steps produce
+        them (the engine keeps serving any other in-flight requests in the
+        same steps). The serving tier pipes this through a
+        ray_tpu.experimental Channel for cross-process token streaming."""
+        rid = self.submit(prompt, gen)
+        yielded = 0
+        while rid not in self.results:
+            self.step()
+            slot = next(
+                (s for s in self.slots if s.req_id == rid and s.active), None
+            )
+            if slot is not None:
+                out = slot.out
+                if slot.eos is not None and slot.eos in out:
+                    out = out[: out.index(slot.eos)]
+                while yielded < len(out):
+                    yield out[yielded]
+                    yielded += 1
+        final = self.results.pop(rid)
+        while yielded < len(final):
+            yield final[yielded]
+            yielded += 1
+
     def generate(
         self, prompts: List[str], gen: GenerationConfig = GenerationConfig()
     ) -> List[str]:
