@@ -18,6 +18,9 @@ Three tiers, one JSON line:
    `vs_baseline`.
 3. **Async actors n:n**: concurrent async actor calls/s vs the reference's
    22,974.9 `n_n_actor_calls_async` (release/perf_metrics/microbenchmark.json).
+4. **Compiled DAG**: a 3-actor chain through shm ring channels vs the eager
+   .remote() path (measured before tier 3 in code; its actors are killed
+   so the async tier runs on an otherwise-idle cluster).
 """
 import json
 import os
@@ -270,6 +273,13 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
             "eager_chain_ms_per_exec": round(eager_per * 1e3, 2),
             "compiled_dag_speedup_vs_eager": round(eager_per / dag_per, 1),
         }
+        # release the chain actors (and their 0.75 CPU) so the async-actor
+        # tier below measures an otherwise-idle cluster
+        for h_ in (sa, sb, sc):
+            try:
+                ray_tpu.kill(h_)
+            except Exception:  # noqa: BLE001
+                pass
 
         # tier 3: n:n async actor calls (n_n_actor_calls_async analog)
         @ray_tpu.remote

@@ -1225,20 +1225,18 @@ class HeadServer:
         if not kernel_batch:
             return
         totals = avail = alive = None
-        # lazy XLA/backend init happens OUTSIDE the view lock: a slow (or
-        # wedged) backend bring-up must stall only the scheduler thread,
-        # never every RPC handler that needs the lock
-        device_state = self.device_state
         # crossover: tiny rounds pay more in device dispatch than the
         # kernel saves — below the threshold use the host golden model
-        # (same math; scheduler/hybrid.py golden tests pin equivalence)
-        from ray_tpu.config import cfg as _cfg
-
-        if (
-            device_state is not None
-            and len(kernel_batch) < _cfg.sched_device_min_batch
-        ):
+        # (same math; scheduler/hybrid.py golden tests pin equivalence).
+        # Checked BEFORE the device_state property so a tiny round never
+        # triggers the lazy XLA backend bring-up it would then discard.
+        if len(kernel_batch) < cfg.sched_device_min_batch:
             device_state = None
+        else:
+            # lazy XLA/backend init happens OUTSIDE the view lock: a slow
+            # (or wedged) backend bring-up must stall only the scheduler
+            # thread, never every RPC handler that needs the lock
+            device_state = self.device_state
         with self._lock:
             n = self.view.num_nodes
             r = self.view.totals.shape[1]

@@ -501,22 +501,42 @@ class ContinuousBatchingEngine:
         ray_tpu.experimental Channel for cross-process token streaming."""
         rid = self.submit(prompt, gen)
         yielded = 0
-        while rid not in self.results:
-            self.step()
-            slot = next(
-                (s for s in self.slots if s.req_id == rid and s.active), None
-            )
-            if slot is not None:
-                out = slot.out
-                if slot.eos is not None and slot.eos in out:
-                    out = out[: out.index(slot.eos)]
-                while yielded < len(out):
-                    yield out[yielded]
-                    yielded += 1
-        final = self.results.pop(rid)
-        while yielded < len(final):
-            yield final[yielded]
-            yielded += 1
+        try:
+            while rid not in self.results:
+                self.step()
+                slot = next(
+                    (s for s in self.slots if s.req_id == rid and s.active),
+                    None,
+                )
+                if slot is not None:
+                    out = slot.out
+                    if slot.eos is not None and slot.eos in out:
+                        out = out[: out.index(slot.eos)]
+                    while yielded < len(out):
+                        yield out[yielded]
+                        yielded += 1
+            final = self.results.pop(rid)
+            while yielded < len(final):
+                yield final[yielded]
+                yielded += 1
+        finally:
+            # consumer abandoned mid-stream: reclaim the slot's pages and
+            # stop burning decode steps on a dead client
+            self._cancel(rid)
+
+    def _cancel(self, rid: int) -> None:
+        """Drop a request wherever it is: queued, active, or finished."""
+        self.results.pop(rid, None)
+        for i, req in enumerate(self.queue):
+            if req.req_id == rid:
+                del self.queue[i]
+                return
+        for si, slot in enumerate(self.slots):
+            if slot.active and slot.req_id == rid:
+                self.pool.free(slot.pages)
+                self.slots[si] = _Slot()
+                self.active_mask = self.active_mask.at[si].set(False)
+                return
 
     def generate(
         self, prompts: List[str], gen: GenerationConfig = GenerationConfig()
