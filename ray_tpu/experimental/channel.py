@@ -114,6 +114,13 @@ class Channel:
         self.reader = ChannelReader(self._path, self._capacity)
 
     def destroy(self) -> None:
+        # set the ring's closed flag FIRST: a producer in another process
+        # parked on a full ring only wakes when the flag is set — closing
+        # our mapping alone would wedge it forever
+        try:
+            self.writer._chan().close_write()
+        except OSError:
+            pass
         self.writer.close()
         self.reader.close()
         try:

@@ -309,9 +309,17 @@ def shutdown() -> None:
 
 
 def start_http_proxy(port: int = 8000) -> int:
-    """Minimal HTTP ingress: POST /<deployment> with a JSON body calls the
-    deployment's __call__ with the parsed payload (proxy.py analog)."""
+    """HTTP ingress (proxy.py analog): the async aiohttp proxy with SSE
+    streaming (ray_tpu/serve/proxy.py) when aiohttp is available; a
+    minimal stdlib fallback otherwise."""
     global _http_server
+    import importlib.util
+
+    if importlib.util.find_spec("aiohttp") is not None:
+        from .proxy import ServeProxy
+
+        _http_server = ServeProxy(_apps, port=port)
+        return _http_server.port
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):

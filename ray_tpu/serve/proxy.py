@@ -1,0 +1,214 @@
+"""Async HTTP ingress for Serve deployments.
+
+The analog of the reference's proxy tier
+(/root/reference/python/ray/serve/_private/proxy.py — an ASGI/aiohttp
+server routing HTTP to replica handles): an aiohttp application that
+
+- routes ``POST /<deployment>`` to the deployment's ``__call__`` through
+  the same replica-set balancing as handle calls (blocking object-plane
+  waits run in an executor so the event loop keeps multiplexing),
+- streams ``POST /<deployment>/stream`` as Server-Sent Events: the
+  replica writes values into a mutable-object Channel
+  (ray_tpu.experimental) via its ``stream_to(writer, payload)`` method
+  and the proxy relays them as they arrive — token streaming for the
+  LLM tier rides this end to end,
+- serves ``GET /-/healthz`` and ``GET /-/routes`` for probes/discovery.
+
+Runs on a dedicated thread with its own event loop; the stdlib fallback
+in deployment.py remains for environments without aiohttp.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import ray_tpu
+
+
+class ServeProxy:
+    def __init__(self, apps: dict, port: int = 0):
+        self._apps = apps
+        self._port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="proxy-wait"
+        )
+        self._started = threading.Event()
+        self.port: Optional[int] = None
+        self._startup_error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="serve-proxy", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30) or self.port is None:
+            raise RuntimeError(
+                f"serve proxy failed to start: {self._startup_error!r}"
+            )
+
+    # -- handlers -------------------------------------------------------
+    async def _call(self, request):
+        from aiohttp import web
+
+        name = request.match_info["deployment"]
+        rs = self._apps.get(name)
+        if rs is None:
+            return web.json_response(
+                {"error": "no such deployment"}, status=404
+            )
+        payload = None
+        if request.can_read_body:
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "body must be JSON"}, status=400
+                )
+        loop = asyncio.get_running_loop()
+        try:
+            ref = rs.submit("__call__", (payload,), {})
+            result = await loop.run_in_executor(
+                self._pool, lambda: ray_tpu.get(ref, timeout=60)
+            )
+            return web.json_response({"result": result})
+        except Exception as exc:  # noqa: BLE001 - errors are responses
+            return web.json_response({"error": repr(exc)}, status=500)
+
+    async def _stream(self, request):
+        from aiohttp import web
+
+        from ray_tpu.experimental import Channel, ChannelClosed
+
+        name = request.match_info["deployment"]
+        rs = self._apps.get(name)
+        if rs is None:
+            return web.json_response(
+                {"error": "no such deployment"}, status=404
+            )
+        payload = None
+        if request.can_read_body:
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "body must be JSON"}, status=400
+                )
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        ch = Channel(buffer_size_bytes=1 << 18)
+        loop = asyncio.get_running_loop()
+        try:
+            ref = rs.submit("stream_to", (ch.writer, payload), {})
+            while True:
+                try:
+                    value = await loop.run_in_executor(
+                        self._pool, lambda: ch.reader.read(timeout=5)
+                    )
+                except ChannelClosed:
+                    break
+                except TimeoutError:
+                    # nothing streamed for a while: did the replica die or
+                    # return without closing? Probe the call's ref so the
+                    # REAL error reaches the client instead of a stall.
+                    try:
+                        await loop.run_in_executor(
+                            self._pool,
+                            lambda: ray_tpu.get(ref, timeout=0.1),
+                        )
+                        # method returned but never closed the channel
+                        raise RuntimeError(
+                            "stream_to returned without close_channel()"
+                        )
+                    except ray_tpu.GetTimeoutError:
+                        continue  # still running; keep waiting
+                await resp.write(
+                    f"data: {json.dumps(value)}\n\n".encode()
+                )
+            await resp.write(b"event: end\ndata: {}\n\n")
+        except Exception as exc:  # noqa: BLE001
+            await resp.write(
+                f"event: error\ndata: {json.dumps(repr(exc))}\n\n".encode()
+            )
+        finally:
+            ch.destroy()
+        await resp.write_eof()
+        return resp
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            {
+                "status": "ok",
+                "deployments": {
+                    name: len(rs.replicas)
+                    for name, rs in self._apps.items()
+                },
+            }
+        )
+
+    async def _routes(self, request):
+        from aiohttp import web
+
+        return web.json_response(sorted(self._apps))
+
+    # -- lifecycle ------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            from aiohttp import web
+        except BaseException as exc:  # noqa: BLE001 - surfaced to __init__
+            self._startup_error = exc
+            self._started.set()
+            return
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        app = web.Application()
+        app.router.add_get("/-/healthz", self._healthz)
+        app.router.add_get("/-/routes", self._routes)
+        app.router.add_post("/{deployment}/stream", self._stream)
+        app.router.add_post("/{deployment}", self._call)
+
+        async def start():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", self._port)
+            await site.start()
+            self._runner = runner
+            self.port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        try:
+            loop.run_until_complete(start())
+        except BaseException as exc:  # noqa: BLE001 - bind failure etc.
+            self._startup_error = exc
+            self._started.set()
+            return
+        loop.run_forever()
+
+    def shutdown(self) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+
+        async def stop():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(stop(), loop)
+            self._thread.join(timeout=5)
+        except RuntimeError:
+            pass
+        self._pool.shutdown(wait=False)

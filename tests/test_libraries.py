@@ -318,3 +318,48 @@ def test_autoscaler_respects_min_workers_and_idle_termination(rt):
     time.sleep(0.05)
     d2 = asc.plan()  # both new nodes idle; min_workers=2 keeps them
     assert len(d2.terminate) == 0
+
+
+def test_serve_async_proxy_health_routes_and_sse(rt):
+    """The aiohttp proxy tier: health/routes endpoints and Server-Sent
+    Event streaming through a deployment's Channel-writing method."""
+    import json
+    import urllib.request
+
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, payload):
+            return {"ok": True}
+
+        def stream_to(self, writer, payload):
+            n = int(payload["n"])
+            for i in range(n):
+                writer.write({"tok": i})
+            writer.close_channel()
+            return n
+
+    serve.run(Streamer.bind())
+    port = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{port}"
+    with urllib.request.urlopen(f"{base}/-/healthz", timeout=30) as r:
+        health = json.loads(r.read())
+    assert health["status"] == "ok" and "Streamer" in health["deployments"]
+    with urllib.request.urlopen(f"{base}/-/routes", timeout=30) as r:
+        assert "Streamer" in json.loads(r.read())
+    req = urllib.request.Request(
+        f"{base}/Streamer/stream",
+        data=json.dumps({"n": 5}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        body = resp.read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in body.splitlines()
+        if line.startswith("data: ") and "tok" in line
+    ]
+    assert events == [{"tok": i} for i in range(5)]
+    assert "event: end" in body
