@@ -9,7 +9,10 @@ The cluster scheduler itself is a set of batched JAX programs
 from ray_tpu._version import __version__  # noqa: F401
 
 from ray_tpu.core.api import (  # noqa: F401
+    GetTimeoutError,
+    ObjectLostError,
     ObjectRef,
+    TaskError,
     actor_exited,
     available_resources,
     cancel,

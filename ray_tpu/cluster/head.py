@@ -154,6 +154,7 @@ class HeadServer:
         self._lease_live_returns: Dict[str, int] = {}  # lease -> unfreed outs
         self._pending: deque = deque()
         self._infeasible: List[LeaseRequest] = []
+        self._scheduling_batch: List[LeaseRequest] = []
         self._in_flight: Dict[str, Tuple[LeaseRequest, str]] = {}
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
@@ -1157,6 +1158,10 @@ class HeadServer:
                 if self._shutdown:
                     return
                 batch = self._pop_fair_batch()
+                # demand visibility: the popped batch is mid-schedule, not
+                # gone — the autoscaler must still see it (the first round
+                # can stall for seconds in XLA backend bring-up)
+                self._scheduling_batch = batch
             try:
                 self._try_schedule_pgs()
                 if batch:
@@ -1165,6 +1170,8 @@ class HeadServer:
                 logger.exception("scheduler round failed; requeueing")
                 with self._cond:
                     self._pending.extend(batch)
+            finally:
+                self._scheduling_batch = []
             time.sleep(SCHED_TICK_S)
 
     def _pop_fair_batch(self) -> List[LeaseRequest]:
@@ -1699,6 +1706,16 @@ class HeadServer:
             out = [dict(s.resources) for s in self._pending if s.resources]
             out += [
                 dict(s.resources) for s in self._infeasible if s.resources
+            ]
+            # mid-schedule leases count too, but a round can move a spec
+            # into _infeasible/_pending before its finally clears the
+            # batch — dedupe by identity or the autoscaler sees 2x demand
+            seen = {id(s) for s in self._pending}
+            seen |= {id(s) for s in self._infeasible}
+            out += [
+                dict(s.resources)
+                for s in self._scheduling_batch
+                if s.resources and id(s) not in seen
             ]
             for pg in self._pending_pgs:
                 if not pg.ready.is_set() and not pg.removed:

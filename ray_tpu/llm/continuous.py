@@ -5,8 +5,10 @@ The serving tier the reference delegates to vLLM-class engines
 rebuilt TPU-first in the JetStream/PagedAttention mold:
 
 - **Paged KV pool**: one device buffer of fixed-size pages
-  ``[n_layers, n_pages, page, kv_heads, head_dim]`` shared by every
-  sequence; a per-slot block table maps logical positions to pages. All
+  ``[n_layers, kv_heads, n_pages, page, head_dim]`` (head-major, so the
+  Pallas decode kernel slices a head's pool without any transpose)
+  shared by every sequence; a per-slot block table maps logical
+  positions to pages. All
   shapes static — XLA compiles exactly two programs (per prefill bucket):
   one prefill, one decode step.
 - **Continuous batching**: B decode slots; requests admit into free slots
@@ -63,7 +65,9 @@ class PagedKVPool:
     def __init__(self, cfg: tfm.ModelConfig, n_pages: int, page: int):
         self.page = page
         self.n_pages = n_pages
-        shape = (cfg.n_layers, n_pages, page, cfg.n_kv_heads, cfg.head_dim)
+        # head-major: [L, KH, N, page, hd] — the Pallas decode kernel and
+        # the gather path both read per-head slices without a transpose
+        shape = (cfg.n_layers, cfg.n_kv_heads, n_pages, page, cfg.head_dim)
         self.k = jnp.zeros(shape, cfg.dtype)
         self.v = jnp.zeros(shape, cfg.dtype)
         # page 0 is the SCRATCH page: inactive decode slots are redirected
@@ -122,11 +126,9 @@ class ContinuousBatchingEngine:
         )
         self.tokenizer = tokenizer or ByteTokenizer()
         # opt-in Pallas paged-attention decode (ops/paged_attention.py);
-        # the XLA gather formulation stays the default. NOTE: the pool is
-        # stored page-major, so this path pays a per-layer head-major
-        # transpose each step — the kernel is validated infrastructure;
-        # flipping the pool layout (and both write scatters) to head-major
-        # is the planned follow-up once real-TPU profiling can guide it
+        # the XLA gather formulation stays the default. The pool is
+        # head-major, so the kernel slices per-head pool views with zero
+        # data movement (real-TPU profiling decides the default flip)
         self.use_pallas_attention = use_pallas_attention
         self.pallas_interpret = pallas_interpret
         self.params = (
@@ -160,16 +162,17 @@ class ContinuousBatchingEngine:
         S_max = P_max * page
 
         def _attention_pages(q, k_pages, v_pages, q_pos):
-            """q: [B,H,hd] one token per slot; k/v_pages: [B,P,page,KH,hd];
-            q_pos: [B] absolute position of the query token."""
+            """q: [B,H,hd] one token per slot; k/v_pages head-major
+            [KH,B,P,page,hd]; q_pos: [B] query position. The einsums index
+            the head-major layout directly — no materialized transpose."""
             b = q.shape[0]
             kh = cfg.n_kv_heads
             groups = cfg.n_heads // kh
-            ks = k_pages.reshape(b, S_max, kh, cfg.head_dim)
-            vs = v_pages.reshape(b, S_max, kh, cfg.head_dim)
+            ks = k_pages.reshape(kh, b, S_max, cfg.head_dim)
+            vs = v_pages.reshape(kh, b, S_max, cfg.head_dim)
             qh = q.reshape(b, kh, groups, cfg.head_dim)
             scores = jnp.einsum(
-                "bhgd,bshd->bhgs",
+                "bhgd,hbsd->bhgs",
                 qh.astype(jnp.float32),
                 ks.astype(jnp.float32),
             ) / jnp.sqrt(cfg.head_dim)
@@ -177,7 +180,7 @@ class ContinuousBatchingEngine:
             scores = jnp.where(valid[:, None, None, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             attn = jnp.einsum(
-                "bhgs,bshd->bhgd", probs, vs.astype(jnp.float32)
+                "bhgs,hbsd->bhgd", probs, vs.astype(jnp.float32)
             )
             return attn.reshape(b, cfg.n_heads * cfg.head_dim)
 
@@ -216,11 +219,23 @@ class ContinuousBatchingEngine:
                 q = _rope1(q, ang)
                 k = _rope1(k, ang)
                 li = carry[3]
-                pk = pk.at[li, page_ids, offsets].set(
-                    jnp.where(active[:, None, None], k.astype(pk.dtype), pk[li, page_ids, offsets])
+                # head-major scatter: index arrays broadcast to [B, KH]
+                hidx = jnp.arange(cfg.n_kv_heads)[None, :]
+                pg_b = page_ids[:, None]
+                off_b = offsets[:, None]
+                pk = pk.at[li, hidx, pg_b, off_b].set(
+                    jnp.where(
+                        active[:, None, None],
+                        k.astype(pk.dtype),
+                        pk[li, hidx, pg_b, off_b],
+                    )
                 )
-                pv = pv.at[li, page_ids, offsets].set(
-                    jnp.where(active[:, None, None], v.astype(pv.dtype), pv[li, page_ids, offsets])
+                pv = pv.at[li, hidx, pg_b, off_b].set(
+                    jnp.where(
+                        active[:, None, None],
+                        v.astype(pv.dtype),
+                        pv[li, hidx, pg_b, off_b],
+                    )
                 )
                 if self.use_pallas_attention:
                     from ray_tpu.ops.paged_attention import (
@@ -230,21 +245,20 @@ class ContinuousBatchingEngine:
                     kh = cfg.n_kv_heads
                     groups = cfg.n_heads // kh
                     qh = q.reshape(b, kh, groups, cfg.head_dim)
-                    # head-major pool slice for the kernel's per-head grid
-                    kp = jnp.transpose(pk[li], (2, 0, 1, 3))
-                    vp = jnp.transpose(pv[li], (2, 0, 1, 3))
+                    # pool is head-major: the kernel slices per head with
+                    # ZERO data movement
                     attn = paged_attention_decode(
                         qh,
-                        kp,
-                        vp,
+                        pk[li],
+                        pv[li],
                         tables,
                         positions + 1,
                         page_size=page,
                         interpret=self.pallas_interpret,
                     ).reshape(b, cfg.n_heads * cfg.head_dim)
                 else:
-                    k_pages = pk[li][tables]  # [B, P, page, KH, hd]
-                    v_pages = pv[li][tables]
+                    k_pages = pk[li][:, tables]  # [KH, B, P, page, hd]
+                    v_pages = pv[li][:, tables]
                     attn = _attention_pages(q, k_pages, v_pages, positions)
                 h = h + (attn.astype(cfg.dtype) @ p["wo"])
                 x2 = tfm.rms_norm(h, p["ln2"])
@@ -326,11 +340,22 @@ class ContinuousBatchingEngine:
                 h = h + (attn.astype(cfg.dtype) @ p["wo"])
                 x2 = tfm.rms_norm(h, p["ln2"])
                 y = tfm.swiglu(x2, p["w_gate"], p["w_up"], p["w_down"])
-                # write pages: [T, KH, hd] -> [n_pages, page, KH, hd]
-                kp = k[0].reshape(-1, page, cfg.n_kv_heads, cfg.head_dim)
-                vp = v[0].reshape(-1, page, cfg.n_kv_heads, cfg.head_dim)
-                pk = pk.at[li, page_ids].set(kp.astype(pk.dtype))
-                pv = pv.at[li, page_ids].set(vp.astype(pv.dtype))
+                # write pages head-major: [T,KH,hd] -> [KH,T,hd] ->
+                # [KH, n_pages, page, hd] (prompt-sized transpose, prefill
+                # only); scatter indexes broadcast to [KH, n_pages]
+                kp = jnp.transpose(k[0], (1, 0, 2)).reshape(
+                    cfg.n_kv_heads, -1, page, cfg.head_dim
+                )
+                vp = jnp.transpose(v[0], (1, 0, 2)).reshape(
+                    cfg.n_kv_heads, -1, page, cfg.head_dim
+                )
+                hidx = jnp.arange(cfg.n_kv_heads)[:, None]
+                pk = pk.at[li, hidx, page_ids[None, :]].set(
+                    kp.astype(pk.dtype)
+                )
+                pv = pv.at[li, hidx, page_ids[None, :]].set(
+                    vp.astype(pv.dtype)
+                )
                 return (h + y, pk, pv, li + 1), None
 
             (h, pool_k, pool_v, _), _ = jax.lax.scan(

@@ -105,34 +105,69 @@ class ServeProxy:
         await resp.prepare(request)
         ch = Channel(buffer_size_bytes=1 << 18)
         loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        _END, _ERR = object(), object()
+
+        def relay(ref) -> None:
+            """Dedicated per-stream thread: blocking channel reads never
+            occupy the shared unary-call pool (32 long streams would
+            otherwise starve every other request)."""
+            from ray_tpu import GetTimeoutError
+
+            def emit(kind, value=None):
+                loop.call_soon_threadsafe(q.put_nowait, (kind, value))
+
+            try:
+                while True:
+                    try:
+                        value = ch.reader.read(timeout=5)
+                    except ChannelClosed:
+                        emit(_END)
+                        return
+                    except TimeoutError:
+                        # stalled: is the replica still running?
+                        try:
+                            ray_tpu.get(ref, timeout=0.1)
+                        except GetTimeoutError:
+                            continue  # still running; keep waiting
+                        except BaseException as exc:  # noqa: BLE001
+                            emit(_ERR, repr(exc))  # replica raised
+                            return
+                        # method returned: drain the tail the replica may
+                        # have written between our timeout and the probe
+                        try:
+                            while True:
+                                emit("data", ch.reader.read(timeout=0.5))
+                        except ChannelClosed:
+                            emit(_END)
+                        except TimeoutError:
+                            emit(
+                                _ERR,
+                                "stream_to returned without "
+                                "close_channel()",
+                            )
+                        return
+                    emit("data", value)
+            except BaseException as exc:  # noqa: BLE001
+                emit(_ERR, repr(exc))
+
         try:
             ref = rs.submit("stream_to", (ch.writer, payload), {})
+            threading.Thread(
+                target=relay, args=(ref,), name="sse-relay", daemon=True
+            ).start()
             while True:
-                try:
-                    value = await loop.run_in_executor(
-                        self._pool, lambda: ch.reader.read(timeout=5)
-                    )
-                except ChannelClosed:
+                kind, value = await q.get()
+                if kind is _END:
+                    await resp.write(b"event: end\ndata: {}\n\n")
                     break
-                except TimeoutError:
-                    # nothing streamed for a while: did the replica die or
-                    # return without closing? Probe the call's ref so the
-                    # REAL error reaches the client instead of a stall.
-                    try:
-                        await loop.run_in_executor(
-                            self._pool,
-                            lambda: ray_tpu.get(ref, timeout=0.1),
-                        )
-                        # method returned but never closed the channel
-                        raise RuntimeError(
-                            "stream_to returned without close_channel()"
-                        )
-                    except ray_tpu.GetTimeoutError:
-                        continue  # still running; keep waiting
-                await resp.write(
-                    f"data: {json.dumps(value)}\n\n".encode()
-                )
-            await resp.write(b"event: end\ndata: {}\n\n")
+                if kind is _ERR:
+                    await resp.write(
+                        f"event: error\ndata: "
+                        f"{json.dumps(value)}\n\n".encode()
+                    )
+                    break
+                await resp.write(f"data: {json.dumps(value)}\n\n".encode())
         except Exception as exc:  # noqa: BLE001
             await resp.write(
                 f"event: error\ndata: {json.dumps(repr(exc))}\n\n".encode()

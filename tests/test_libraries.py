@@ -323,6 +323,7 @@ def test_autoscaler_respects_min_workers_and_idle_termination(rt):
 def test_serve_async_proxy_health_routes_and_sse(rt):
     """The aiohttp proxy tier: health/routes endpoints and Server-Sent
     Event streaming through a deployment's Channel-writing method."""
+    pytest.importorskip("aiohttp")
     import json
     import urllib.request
 
