@@ -82,11 +82,16 @@ def main():
         headers={"Content-Type": "application/json"},
     )
     with urllib.request.urlopen(req, timeout=120) as r:
-        toks = [
-            json.loads(line[len("data: "):])
-            for line in r.read().decode().splitlines()
-            if line.startswith("data: ") and line != "data: {}"
-        ]
+        toks, event = [], "message"
+        for line in r.read().decode().splitlines():
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: "):
+                if event == "error":
+                    raise RuntimeError(f"stream failed: {line[6:]}")
+                if event == "message":
+                    toks.append(json.loads(line[len("data: "):]))
+                event = "message"
     print("streamed tokens:", toks)
     ray_tpu.shutdown()
 

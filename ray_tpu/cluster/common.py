@@ -75,6 +75,12 @@ class LeaseRequest:
     # submitting process's holder id: the initial owner of the return ids
     client_id: str = ""
 
+    def __getstate__(self):
+        # head-side scheduling memos (e.g. _req_cache) never ride the wire
+        return {
+            k: v for k, v in self.__dict__.items() if not k.startswith("_")
+        }
+
 
 @dataclass
 class SealInfo:

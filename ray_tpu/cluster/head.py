@@ -133,6 +133,7 @@ class HeadServer:
 
         self._lazy_device = LazyDeviceState(use_device_scheduler)
         self._parked_at_change = -1
+        self._last_park_retry = 0.0
         self._rng = np.random.default_rng(0)
         self._seed = 0
         self._spread_rr = 0  # SPREAD round-robin cursor
@@ -1151,8 +1152,14 @@ class HeadServer:
                         self._infeasible
                         and not self._pending
                         and self.view.change_counter != self._parked_at_change
+                        and time.monotonic() - self._last_park_retry > 0.02
                     ):
+                        # rate-limited: completions bump the change counter
+                        # continuously under load; re-routing every parked
+                        # spec each 2ms tick multiplies per-spec Python
+                        # work ~10x for no placement gain
                         self._parked_at_change = self.view.change_counter
+                        self._last_park_retry = time.monotonic()
                         self._pending.extend(self._infeasible)
                         self._infeasible.clear()
                 if self._shutdown:
@@ -1217,6 +1224,16 @@ class HeadServer:
             self._pending.extendleft(reversed(by_class[key]))
         return batch
 
+    def _spec_req(self, spec: LeaseRequest) -> "ResourceRequest":
+        """Memoized packed demand: a spec spilled back under contention is
+        re-routed many times; packing its (immutable) resources dict once
+        removes the dominant per-round Python cost."""
+        req = getattr(spec, "_req_cache", None)
+        if req is None:
+            req = ResourceRequest.from_map(self.vocab, spec.resources)
+            spec._req_cache = req
+        return req
+
     def _schedule_batch(self, batch: List[LeaseRequest]) -> None:
         self.metrics["sched_rounds"] += 1
         kernel_batch: List[LeaseRequest] = []
@@ -1261,10 +1278,7 @@ class HeadServer:
             with self._cond:
                 self._infeasible.extend(kernel_batch)
             return
-        reqs = [
-            ResourceRequest.from_map(self.vocab, s.resources)
-            for s in kernel_batch
-        ]
+        reqs = [self._spec_req(s) for s in kernel_batch]
         # a demand column past the view's resource axis names a resource no
         # node has ever reported — unplaceable until the cluster changes
         sched: List[Tuple[LeaseRequest, np.ndarray]] = []
@@ -1360,9 +1374,7 @@ class HeadServer:
                 self._infeasible.extend(specs)
             return
         r = totals.shape[1]
-        reqs = [
-            ResourceRequest.from_map(self.vocab, s.resources) for s in specs
-        ]
+        reqs = [self._spec_req(s) for s in specs]
         # demands naming a resource no node has ever reported are
         # unplaceable until the cluster changes (same guard as the kernel)
         sched: List[Tuple[LeaseRequest, np.ndarray]] = []

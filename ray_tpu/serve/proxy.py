@@ -107,6 +107,11 @@ class ServeProxy:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         _END, _ERR = object(), object()
+        # bounded handoff: a stalled HTTP client must throttle the relay,
+        # which stops draining the ring, which blocks the replica's
+        # writer — end-to-end backpressure instead of unbounded proxy RSS
+        credits = threading.Semaphore(64)
+        dead = threading.Event()
 
         def relay(ref) -> None:
             """Dedicated per-stream thread: blocking channel reads never
@@ -115,6 +120,9 @@ class ServeProxy:
             from ray_tpu import GetTimeoutError
 
             def emit(kind, value=None):
+                while not credits.acquire(timeout=1.0):
+                    if dead.is_set():
+                        raise ChannelClosed("consumer gone")
                 loop.call_soon_threadsafe(q.put_nowait, (kind, value))
 
             try:
@@ -149,7 +157,8 @@ class ServeProxy:
                         return
                     emit("data", value)
             except BaseException as exc:  # noqa: BLE001
-                emit(_ERR, repr(exc))
+                if not dead.is_set():
+                    emit(_ERR, repr(exc))
 
         try:
             ref = rs.submit("stream_to", (ch.writer, payload), {})
@@ -158,6 +167,7 @@ class ServeProxy:
             ).start()
             while True:
                 kind, value = await q.get()
+                credits.release()
                 if kind is _END:
                     await resp.write(b"event: end\ndata: {}\n\n")
                     break
@@ -173,6 +183,7 @@ class ServeProxy:
                 f"event: error\ndata: {json.dumps(repr(exc))}\n\n".encode()
             )
         finally:
+            dead.set()
             ch.destroy()
         await resp.write_eof()
         return resp
