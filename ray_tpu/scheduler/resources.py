@@ -128,9 +128,16 @@ class ResourceRequest:
         return not self.demands
 
     def dense(self, width: int) -> np.ndarray:
+        # memoized per width: schedulers re-densify the same parked request
+        # every retry round under contention (requests are immutable)
+        cache = getattr(self, "_dense_cache", None)
+        if cache is not None and cache[0] == width:
+            return cache[1]
         row = np.zeros(width, dtype=np.float32)
         for col, fp in self.demands.items():
             row[col] = from_fp(fp)
+        row.flags.writeable = False  # shared: accidental mutation raises
+        object.__setattr__(self, "_dense_cache", (width, row))
         return row
 
     def has(self, col: int) -> bool:
