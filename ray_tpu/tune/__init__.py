@@ -8,6 +8,7 @@ exploit/explore) off the metrics stream reported by tune.report().
 from .search import choice, grid_search, loguniform, randint, uniform  # noqa: F401
 from .tuner import (  # noqa: F401
     ASHAScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     ResultGrid,
     TuneConfig,

@@ -156,6 +156,51 @@ class ASHAScheduler:
         return decision
 
 
+class MedianStoppingRule:
+    """Median stopping (median_stopping_rule.py semantics): after the
+    grace period, a trial stops when its best result so far is worse than
+    the median of other trials' RUNNING AVERAGES at the same iteration
+    count (the Vizier rule the reference implements)."""
+
+    def __init__(
+        self,
+        *,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        # trial id -> (sum, count, best) of reported values
+        self._stats: Dict[int, List[float]] = {}
+
+    def on_result(
+        self, state: _TrialState, value: float, it: int, prev_it: int = None
+    ) -> str:
+        sid = id(state)
+        s = self._stats.setdefault(sid, [0.0, 0.0, value])
+        s[0] += value
+        s[1] += 1
+        better = max if self.mode == "max" else min
+        s[2] = better(s[2], value)
+        if it < self.grace:
+            return "CONTINUE"
+        others = [
+            st[0] / st[1]
+            for k, st in self._stats.items()
+            if k != sid and st[1] > 0
+        ]
+        if len(others) < self.min_samples:
+            return "CONTINUE"
+        others.sort()
+        median = others[len(others) // 2]
+        good = s[2] >= median if self.mode == "max" else s[2] <= median
+        return "CONTINUE" if good else "STOP"
+
+
 class PopulationBasedTraining:
     """PBT (pbt.py semantics): every perturbation_interval reports, trials in
     the bottom quartile clone the config+checkpoint of a top-quartile trial
@@ -338,7 +383,7 @@ class Tuner:
                 value = state.last_metric(scheduler.metric)
                 if value is None:
                     continue
-                if isinstance(scheduler, ASHAScheduler):
+                if isinstance(scheduler, (ASHAScheduler, MedianStoppingRule)):
                     if scheduler.on_result(state, value, it, prev_it) == "STOP":
                         state.stop_event.set()
                 elif isinstance(scheduler, PopulationBasedTraining):

@@ -149,6 +149,31 @@ def test_tuner_asha_stops_bad_trials(rt):
     assert best.config["lr"] == 0.1
 
 
+def test_tuner_median_stopping_rule(rt):
+    from ray_tpu import tune
+
+    def trainable(config):
+        for it in range(30):
+            tune.report({"loss": config["lr"] * (30 - it)})
+            time.sleep(0.02)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 0.2, 5.0, 50.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss",
+            mode="min",
+            scheduler=tune.MedianStoppingRule(
+                grace_period=3, min_samples_required=2
+            ),
+        ),
+    )
+    grid = tuner.fit()
+    statuses = [r.status for r in grid]
+    assert "STOPPED" in statuses  # below-median trials stop early
+    assert grid.get_best_result().config["lr"] == 0.1
+
+
 # -- data -------------------------------------------------------------------
 
 
