@@ -301,3 +301,33 @@ class TestClusterCompiledDag:
             f for f in os.listdir(channel_dir()) if f.startswith(dag_id)
         ]
         assert not files, "teardown must unlink ring files"
+
+
+def test_dag_actor_death_fails_cleanly(cluster_client):
+    """Killing a participating actor must surface as an error/timeout on
+    pending executions — never a silent hang past the get timeout — and
+    teardown must still reclaim the channels."""
+    import os as _os
+
+    from ray_tpu.dag.channel import channel_dir
+
+    S = ray_tpu.remote(_ChainStage).options(num_cpus=0.25)
+    a, b = S.remote(1), S.remote(10)
+    with InputNode() as inp:
+        dag = b.f.bind(a.f.bind(inp))
+    compiled = dag.experimental_compile()
+    dag_id = compiled._dag_id
+    try:
+        assert compiled.execute(5).get(timeout=60) == 16
+        ray_tpu.kill(a)
+        time.sleep(0.5)
+        ref = compiled.execute(7)
+        with pytest.raises(Exception):  # error or bounded timeout, no hang
+            ref.get(timeout=15)
+    finally:
+        compiled.teardown()
+        _kill_quietly(a, b)
+    leftover = [
+        f for f in _os.listdir(channel_dir()) if f.startswith(dag_id)
+    ]
+    assert not leftover, "teardown must unlink ring files after a death"
