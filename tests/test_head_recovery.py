@@ -166,3 +166,47 @@ def test_oom_victim_is_newest_plain_task():
 
     a._workers = {"act": w_actor, "idle": w_idle}
     assert a._pick_oom_victim() is None
+
+
+def test_wal_recovered_actor_resubmits_creation(tmp_path, monkeypatch):
+    """An actor REGISTERED but never created when the head crashed (the
+    WAL window) has no hosting agent to re-attach it — recovery must
+    resubmit its creation lease or it parks RESTARTING forever."""
+    from ray_tpu.cluster.common import LeaseRequest, new_id
+    from ray_tpu.cluster.head import HeadServer
+
+    monkeypatch.setattr(HeadServer, "_persist_loop", lambda self: None)
+    path = str(tmp_path / "state.pkl")
+    h1 = HeadServer(port=0, persist_path=path, use_device_scheduler=False)
+    spec = LeaseRequest(
+        task_id=new_id(),
+        name="Ghost.__init__",
+        payload=b"\x80\x04N.",  # pickled None placeholder
+        return_ids=[],
+        resources={"CPU": 1.0},
+        kind="actor_creation",
+        actor_id=new_id(),
+    )
+    h1._h_create_actor(
+        {"spec": spec, "name": "ghost", "class_name": "Ghost"}
+    )
+    # hard crash: no snapshot flush; the registration lives in the WAL
+    h1._server.stop()
+    h1._shutdown = True
+
+    h2 = HeadServer(port=0, persist_path=path, use_device_scheduler=False)
+    try:
+        info = h2._actors[spec.actor_id]
+        assert info.state == "RESTARTING"
+        assert h2._named_actors.get("ghost") == spec.actor_id
+        before = len(h2._pending)
+        h2._recover_orphan_actors(grace_s=0)  # deterministic grace
+        creations = [
+            s
+            for s in h2._pending
+            if s.kind == "actor_creation" and s.actor_id == spec.actor_id
+        ]
+        assert len(creations) == 1, (before, len(h2._pending))
+    finally:
+        h2._server.stop()
+        h2._shutdown = True
