@@ -45,6 +45,8 @@ class Dashboard:
                 web.get("/", self._index),
                 web.get("/api/cluster_status", self._cluster_status),
                 web.get("/api/nodes", self._nodes),
+                web.get("/api/nodes/{node_id}/debug", self._node_debug),
+                web.get("/api/rpc_stats", self._rpc_stats),
                 web.get("/api/actors", self._actors),
                 web.get("/api/objects", self._objects),
                 web.get("/api/placement_groups", self._pgs),
@@ -89,7 +91,8 @@ class Dashboard:
             "<html><head><title>ray_tpu dashboard</title></head><body>"
             "<h1>ray_tpu cluster</h1>"
             f"<pre>{json.dumps(info, indent=2, default=str)}</pre>"
-            "<p>endpoints: /api/cluster_status /api/nodes /api/actors "
+            "<p>endpoints: /api/cluster_status /api/nodes "
+            "/api/nodes/&lt;id&gt;/debug /api/rpc_stats /api/actors "
             "/api/objects /api/placement_groups /api/jobs /metrics</p>"
             "</body></html>"
         )
@@ -97,6 +100,30 @@ class Dashboard:
 
     async def _cluster_status(self, request) -> web.Response:
         return self._json(self.head._h_cluster_info(None))
+
+    async def _node_debug(self, request) -> web.Response:
+        """Proxy one agent's DebugState (node_manager DebugString analog):
+        ledger availability, store stats, OOM kills, in-flight queues,
+        per-RPC-handler timings."""
+        node_id = request.match_info["node_id"]
+        client = self.head._clients.get(node_id)
+        if client is None:
+            return self._json({"error": f"unknown node {node_id}"})
+        loop = asyncio.get_running_loop()
+        try:
+            state = await loop.run_in_executor(
+                None, lambda: client.call("DebugState", timeout=10.0)
+            )
+            return self._json(state)
+        except Exception as exc:  # noqa: BLE001
+            return self._json({"error": repr(exc)})
+
+    async def _rpc_stats(self, request) -> web.Response:
+        """The head's own per-handler timings (instrumented_io_context
+        stats analog)."""
+        from .rpc import HANDLER_STATS
+
+        return self._json(HANDLER_STATS.snapshot())
 
     async def _nodes(self, request) -> web.Response:
         return self._json(self.head._h_cluster_info(None)["nodes"])

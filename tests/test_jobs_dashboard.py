@@ -129,6 +129,18 @@ def _http_json(url):
         return json.loads(r.read())
 
 
+def test_dashboard_node_debug_and_rpc_stats(cluster):
+    port = cluster.head.dashboard.port
+    base = f"http://127.0.0.1:{port}"
+    nodes = _http_json(f"{base}/api/nodes")
+    nid = nodes[0]["NodeID"]
+    debug = _http_json(f"{base}/api/nodes/{nid}/debug")
+    assert "available" in debug and "store" in debug
+    assert "rpc_handlers" in debug and "oom_kills" in debug
+    stats = _http_json(f"{base}/api/rpc_stats")
+    assert isinstance(stats, dict)  # head-side handler timings
+
+
 def test_dashboard_endpoints(cluster):
     port = cluster.head.dashboard.port
     base = f"http://127.0.0.1:{port}"
