@@ -169,6 +169,7 @@ class NodeAgent:
             "ReturnBundles": self._h_return_bundles,
             "KillActor": self._h_kill_actor,
             "ActorWorkerAddress": self._h_actor_worker_address,
+            "CancelLease": self._h_cancel_lease,
             "DagInstall": lambda r: self._forward_to_actor_worker(
                 "DagInstall", r
             ),
@@ -1403,6 +1404,45 @@ class NodeAgent:
         except OSError:
             pass
         # the blocked PushTask RPC fails -> _on_worker_death requeues
+
+    def _h_cancel_lease(self, req: dict) -> dict:
+        """Drop a not-yet-running lease (task batch buffer or dependency
+        wait); its resources release. Running tasks are not preempted
+        (non-force reference semantics)."""
+        lid = req["task_id"]
+        with self._task_cv:
+            for item in list(self._task_buf):
+                spec, alloc = item
+                if spec.task_id == lid:
+                    self._task_buf.remove(item)
+                    self._release(alloc)
+                    return {"cancelled": True}
+        # dep-waiting entries are guarded by _dep_cv everywhere else; the
+        # wrong lock here would race _dep_loop's iteration
+        with self._dep_cv:
+            entry = self._dep_waiting.pop(lid, None)
+        if entry is not None:
+            return {"cancelled": True}
+        if req.get("force"):
+            # force: kill the worker running it (plain tasks only; the
+            # worker-death path reports the failure and the head, having
+            # sealed the cancel, drops it instead of retrying)
+            with self._lock:
+                victim = next(
+                    (
+                        hdl
+                        for hdl in self._workers.values()
+                        if hdl.actor_id is None and lid in hdl.running
+                    ),
+                    None,
+                )
+            if victim is not None:
+                try:
+                    victim.proc.kill()
+                except OSError:
+                    pass
+                return {"cancelled": True}
+        return {"cancelled": False}
 
     def _h_actor_worker_address(self, req: dict) -> dict:
         """Direct actor calls: resolve the worker process hosting an actor

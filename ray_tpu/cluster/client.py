@@ -954,6 +954,12 @@ class RemoteRuntime:
             out.append(v)
         return out
 
+    def cancel_object(self, ref: ObjectRef, force: bool = False) -> bool:
+        reply = self.head.call(
+            "CancelLease", {"object_id": ref.hex, "force": force}
+        )
+        return bool(reply.get("cancelled"))
+
     def free_objects(self, refs: List[ObjectRef]) -> None:
         self.head.call("FreeObjects", {"object_ids": [r.hex for r in refs]})
 

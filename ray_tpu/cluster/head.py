@@ -156,6 +156,9 @@ class HeadServer:
         self._pending: deque = deque()
         self._infeasible: List[LeaseRequest] = []
         self._scheduling_batch: List[LeaseRequest] = []
+        # lease ids cancelled while mid-schedule: dropped at dispatch time
+        # (the round already popped them out of every scannable queue)
+        self._cancelled_leases: set = set()
         self._in_flight: Dict[str, Tuple[LeaseRequest, str]] = {}
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
@@ -218,6 +221,7 @@ class HeadServer:
             "GetActor": self._h_get_actor,
             "WaitActor": self._h_wait_actor,
             "PendingDemands": self._h_pending_demands,
+            "CancelLease": self._h_cancel_lease,
             "KillActor": self._h_kill_actor,
             "CreatePlacementGroup": self._h_create_pg,
             "WaitPlacementGroup": self._h_wait_pg,
@@ -773,6 +777,9 @@ class HeadServer:
             spec = item[0] if item else self._leases.get(fail["task_id"])
             if spec is None:
                 continue
+            if spec.task_id in self._cancelled_leases:
+                self._cancelled_leases.discard(spec.task_id)
+                continue  # force-cancel kill: already sealed cancelled
             if fail.get("requeue"):
                 # contention spillback: back to the queue, no retry burned
                 with self._cond:
@@ -1187,6 +1194,13 @@ class HeadServer:
         of one shape cannot monopolize dispatch for rounds on end
         (local_lease_manager.h per-class throttling analog). Caller holds
         self._cond."""
+        if self._cancelled_leases:
+            drop = self._cancelled_leases
+            kept = [s for s in self._pending if s.task_id not in drop]
+            for s in self._pending:
+                if s.task_id in drop:
+                    drop.discard(s.task_id)
+            self._pending = deque(kept)
         if len(self._pending) <= MAX_BATCH:
             batch = list(self._pending)
             self._pending.clear()
@@ -1416,6 +1430,19 @@ class HeadServer:
         self._send_grants(grants)
 
     def _send_grants(self, grants: Dict[str, List[LeaseRequest]]) -> None:
+        if self._cancelled_leases:
+            with self._cond:
+                filtered: Dict[str, List[LeaseRequest]] = {}
+                for nid, specs in grants.items():
+                    keep = []
+                    for s in specs:
+                        if s.task_id in self._cancelled_leases:
+                            self._cancelled_leases.discard(s.task_id)
+                        else:
+                            keep.append(s)
+                    if keep:
+                        filtered[nid] = keep
+                grants = filtered
         for node_id, specs in grants.items():
             with self._lock:
                 client = self._clients.get(node_id)
@@ -1709,6 +1736,73 @@ class HeadServer:
             self._infeasible.clear()
             self._cond.notify_all()
         self.mark_dirty()
+
+    def _h_cancel_lease(self, req: dict) -> dict:
+        """Best-effort cancel by return-object id (ray.cancel parity):
+        queued work (pending / infeasible / mid-schedule / agent
+        dep-waiting) is dropped and its returns sealed cancelled; running
+        tasks are not preempted unless force=True kills the worker — the
+        reference's non-force semantics."""
+        oid = req["object_id"]
+        force = bool(req.get("force"))
+        with self._cond:
+            entry = self._objects.get(oid)
+            lid = entry.creating_lease if entry is not None else None
+            spec = self._leases.get(lid) if lid else None
+            if spec is None:
+                return {"cancelled": False, "reason": "unknown lease"}
+            dropped = False
+            for q in (self._pending, self._infeasible):
+                for s in list(q):
+                    if s.task_id == lid:
+                        q.remove(s)
+                        dropped = True
+            # mid-schedule: the round popped it out of every queue above
+            # (this window spans the first round's XLA bring-up) — flag it
+            # for the dispatch-time filter
+            if not dropped and any(
+                s.task_id == lid for s in self._scheduling_batch
+            ):
+                self._cancelled_leases.add(lid)
+                dropped = True
+            in_flight = self._in_flight.get(lid)
+        if dropped:
+            self._seal_error_ids(
+                spec.return_ids, RuntimeError("task cancelled")
+            )
+            self._release_lease_pins(lid)
+            return {"cancelled": True}
+        if in_flight is not None:
+            _, node_id = in_flight
+            client = self._clients.get(node_id)
+            if client is not None:
+                if force:
+                    # the kill trips the worker-death report; the flag
+                    # tells the failure handler this was a cancel, not a
+                    # crash to retry
+                    with self._cond:
+                        self._cancelled_leases.add(lid)
+                try:
+                    reply = client.call(
+                        "CancelLease",
+                        {"task_id": lid, "force": force},
+                        timeout=10.0,
+                    )
+                    if reply.get("cancelled"):
+                        with self._cond:
+                            self._in_flight.pop(lid, None)
+                        self._seal_error_ids(
+                            spec.return_ids,
+                            RuntimeError("task cancelled"),
+                        )
+                        self._release_lease_pins(lid)
+                        return {"cancelled": True}
+                except RpcError:
+                    pass
+                if force:
+                    with self._cond:
+                        self._cancelled_leases.discard(lid)
+        return {"cancelled": False, "reason": "not queued"}
 
     def _h_pending_demands(self, req=None) -> List[Dict[str, float]]:
         """Queued + infeasible lease shapes and unplaced PG bundles — the

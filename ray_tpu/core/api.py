@@ -160,7 +160,8 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
     reference)."""
     rt = get_runtime()
     if getattr(rt, "is_remote", False):
-        return  # best-effort: remote cancel not yet supported
+        rt.cancel_object(ref, force=force)
+        return
     with rt._cond:
         for q in (rt._pending, rt._infeasible, rt._dep_waiting):
             for spec in list(q):

@@ -279,3 +279,34 @@ def test_node_death_task_retry_and_actor_restart():
     finally:
         set_runtime(None)
         c.shutdown()
+
+
+def _sleepy(t):
+    import time as _t
+
+    _t.sleep(t)
+    return t
+
+
+def test_cancel_queued_task(client):
+    """ray.cancel parity in cluster mode: a task still queued behind a
+    full cluster is dropped and its get() raises; running tasks are not
+    preempted by a non-force cancel."""
+    from ray_tpu.core.runtime import set_runtime
+
+    set_runtime(client)  # an earlier test may have cleared the global
+    # saturate the CPUs so later submissions stay queued at the head
+    blockers = [
+        ray_tpu.remote(_sleepy).options(num_cpus=4.0, max_retries=0).remote(4)
+        for _ in range(2)
+    ]
+    victim = (
+        ray_tpu.remote(_sleepy).options(num_cpus=4.0, max_retries=0).remote(0)
+    )
+    time.sleep(0.5)  # let the victim reach the head queue
+    ray_tpu.cancel(victim)
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(victim, timeout=30)
+    assert "cancel" in repr(ei.value).lower()
+    # the blockers were running: unaffected, they complete normally
+    assert ray_tpu.get(blockers, timeout=60) == [4, 4]
