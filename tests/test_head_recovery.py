@@ -128,6 +128,7 @@ def test_fair_batch_round_robins_classes():
         _pop_fair_batch = head_mod.HeadServer._pop_fair_batch
 
     h = _H()
+    h._cancelled_leases = set()
     mk = lambda i, res: LeaseRequest(  # noqa: E731
         task_id=f"t{i}", name="x", payload=b"", return_ids=[], resources=res
     )
