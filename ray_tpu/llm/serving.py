@@ -56,4 +56,25 @@ def build_llm_deployment(
             text = self.engine.generate([prompt], gen)[0]
             return {"prompt": prompt, "generated_text": text}
 
+        def stream_to(self, writer, request):
+            """HTTP proxy SSE contract: POST /<name>/stream streams decoded
+            token text through a mutable-object Channel (continuous engine
+            only — the dense engine decodes whole batches)."""
+            if not hasattr(self.engine, "stream_ids"):
+                writer.write("streaming requires engine='continuous'")
+                writer.close_channel()
+                return 0
+            gen = GenerationConfig(
+                max_new_tokens=int(request.get("max_new_tokens", 32)),
+                temperature=float(request.get("temperature", 0.0)),
+                seed=int(request.get("seed", 0)),
+            )
+            prompt = self.engine.tokenizer.encode(request["prompt"])
+            n = 0
+            for tok in self.engine.stream_ids(prompt, gen):
+                writer.write(self.engine.tokenizer.decode([int(tok)]))
+                n += 1
+            writer.close_channel()
+            return n
+
     return LLMServer.bind()

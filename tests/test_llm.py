@@ -93,3 +93,61 @@ def test_llm_serving():
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_llm_deployment_streams_over_http():
+    """build_llm_deployment(engine='continuous') streams decoded token
+    text via POST /<name>/stream with zero user code."""
+    import json
+    import urllib.request
+
+    import pytest as _pytest
+
+    _pytest.importorskip("aiohttp")
+    import jax.numpy as jnp
+
+    import ray_tpu.serve as serve
+    from ray_tpu.llm import build_llm_deployment
+    from ray_tpu.models import transformer as tfm
+
+    cfg = tfm.ModelConfig(
+        vocab_size=258,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=128,
+        dtype=jnp.float32,
+    )
+    import ray_tpu
+
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4})
+    serve.run(
+        build_llm_deployment(
+            cfg, name="sllm", engine="continuous", max_batch=2,
+            page_size=8, n_pages=32,
+        )
+    )
+    port = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/sllm/stream",
+        data=json.dumps({"prompt": "hi", "max_new_tokens": 6}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = r.read().decode()
+    toks, event = [], "message"
+    for line in body.splitlines():
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            if event == "message":
+                toks.append(json.loads(line[len("data: "):]))
+            event = "message"
+    try:
+        assert len(toks) == 6 and all(isinstance(t, str) for t in toks)
+        assert "event: end" in body
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
