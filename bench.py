@@ -228,12 +228,20 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
         # warmup: worker pool spin-up + code-path compile
         ray_tpu.get([f.remote() for _ in range(50)], timeout=60)
 
-        t0 = time.perf_counter()
-        refs = [f.remote() for _ in range(num_tasks)]
-        for i in range(0, num_tasks, 500):
-            ray_tpu.get(refs[i : i + 500], timeout=300)
-        elapsed = time.perf_counter() - t0
-        tasks_per_s = num_tasks / elapsed
+        def one_pass(n: int) -> float:
+            t0 = time.perf_counter()
+            refs = [f.remote() for _ in range(n)]
+            for i in range(0, n, 500):
+                ray_tpu.get(refs[i : i + 500], timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        # pass 1 includes cold code paths cluster-wide; pass 2 is the
+        # steady state a long-running cluster sustains (observed ~1.5x
+        # pass 1 on this host). The HEADLINE stays pass 1 — the same
+        # cold-ish semantics as the reference's many_tasks run — with
+        # steady state published alongside.
+        tasks_per_s = one_pass(num_tasks)
+        steady_tasks_per_s = one_pass(num_tasks)
 
         # tier 4: compiled DAG — 3 actors pipelined through shm ring
         # channels vs the eager .remote() chain (compiled_dag_node.py
@@ -318,6 +326,10 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
         async_calls_per_s = max(one_round() for _ in range(3))
         return {
             "cluster_tasks_per_s": round(tasks_per_s, 1),
+            "cluster_tasks_per_s_steady": round(steady_tasks_per_s, 1),
+            "steady_vs_baseline": round(
+                steady_tasks_per_s / BASELINE_E2E_TASKS_PER_S, 3
+            ),
             "cluster_num_tasks": num_tasks,
             "async_actor_calls_per_s": round(async_calls_per_s, 1),
             "async_vs_baseline": round(
