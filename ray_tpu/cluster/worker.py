@@ -47,7 +47,9 @@ async def _invoke_maybe_async(instance, method: str, args, kwargs, sems):
     sem = sems.get(group) or sems["_default"]
     async with sem:
         out = fn(*args, **kwargs)
-        if inspect.isawaitable(out):
+        from ray_tpu.core.object_store import should_await
+
+        if should_await(out):
             out = await out
         return out
 
@@ -1014,13 +1016,20 @@ class Worker:
                 loop, _sems = entry
 
                 def target(*a, _fn=fn, **kw):
+                    from ray_tpu.core.object_store import should_await
+
                     with dag_lock:
                         out = _fn(*a, **kw)
-                    if inspect.isawaitable(out):
+                    if should_await(out):
                         return asyncio.run_coroutine_threadsafe(
-                            out, loop
+                            _awrap(out), loop
                         ).result()
                     return out
+
+                async def _awrap(aw):
+                    # run_coroutine_threadsafe needs a coroutine, not a
+                    # bare awaitable
+                    return await aw
 
             else:
 

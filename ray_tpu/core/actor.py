@@ -316,7 +316,9 @@ class ActorState:
             args, kwargs = self.runtime._resolve_args(call["args"], call["kwargs"])
             fn = getattr(instance, call["method"])
             result = fn(*args, **kwargs)
-            if inspect.isawaitable(result):
+            from .object_store import should_await
+
+            if should_await(result):
                 result = await result
             self._seal_result(call, result)
         except BaseException as exc:  # noqa: BLE001

@@ -45,6 +45,34 @@ class GetTimeoutError(TimeoutError):
     pass
 
 
+_RESOLVER_POOL = None
+_RESOLVER_LOCK = threading.Lock()
+
+
+def _resolver_pool():
+    """Shared bounded pool for .future()/__await__ resolution — per-call
+    threads would grow without bound on never-sealed refs."""
+    global _RESOLVER_POOL
+    with _RESOLVER_LOCK:
+        if _RESOLVER_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _RESOLVER_POOL = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="ref-await"
+            )
+        return _RESOLVER_POOL
+
+
+def should_await(value) -> bool:
+    """True for awaitables an executor should transparently await on a
+    user function's behalf. ObjectRef is awaitable but EXEMPT: returning
+    a ref hands the ref to the caller (reference semantics) — resolving
+    it here would change the return shape and block the executor."""
+    import inspect
+
+    return inspect.isawaitable(value) and not isinstance(value, ObjectRef)
+
+
 @dataclass(frozen=True)
 class ObjectRef:
     """A future-like handle to a task output or put object.
@@ -75,6 +103,26 @@ class ObjectRef:
     def __reduce__(self):
         refcount.note_serialized(self.hex)
         return (ObjectRef, (self.hex, self.owner))
+
+    def __await__(self):
+        """``await ref`` resolves the object without blocking the event
+        loop (reference: awaitable ObjectRefs, object_ref.pxi _to_future —
+        asyncio actors await refs inside methods). NOTE: executors that
+        auto-await user return values must exempt ObjectRef — a method
+        RETURNING a ref means "hand the ref over", not "resolve it"
+        (see should_await)."""
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def future(self):
+        """concurrent.futures.Future view of this ref (ray parity);
+        resolves on a shared bounded pool."""
+        return _resolver_pool().submit(
+            lambda: __import__(
+                "ray_tpu.core.runtime", fromlist=["get_runtime"]
+            ).get_runtime().get_object(self, None)
+        )
 
     @staticmethod
     def new(owner: str = "") -> "ObjectRef":

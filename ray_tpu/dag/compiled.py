@@ -371,12 +371,19 @@ class CompiledDAG:
         loop = state._loop  # set for asyncio actors
 
         def target(*a, **kw):
+            from ray_tpu.core.object_store import should_await
+
             with lock:
                 out = getattr(instance, method)(*a, **kw)
-            if inspect.isawaitable(out):
+            if should_await(out):
+                async def _awrap(aw=out):
+                    return await aw
+
                 if loop is not None:
-                    return asyncio.run_coroutine_threadsafe(out, loop).result()
-                return asyncio.new_event_loop().run_until_complete(out)
+                    return asyncio.run_coroutine_threadsafe(
+                        _awrap(), loop
+                    ).result()
+                return asyncio.new_event_loop().run_until_complete(_awrap())
             return out
 
         return target

@@ -298,3 +298,76 @@ def test_async_actor_restart(rt):
     rt.kill_node(node)
     # restarted elsewhere with fresh state, still an async actor
     assert ray_tpu.get(c.incr.remote(), timeout=30) == 1
+
+
+def test_await_object_ref_local():
+    """`await ref` inside an async actor method resolves other tasks'
+    outputs without blocking the actor's event loop (awaitable ObjectRef,
+    reference object_ref.pxi semantics)."""
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 4})
+    try:
+
+        @ray_tpu.remote
+        def produce(x):
+            return x * 3
+
+        @ray_tpu.remote
+        class Combiner:
+            async def combine_refs(self, pair):
+                a = await pair[0]
+                b = await pair[1]
+                return a + b
+
+        c = Combiner.remote()
+        r1, r2 = produce.remote(1), produce.remote(2)
+        out = ray_tpu.get(c.combine_refs.remote([r1, r2]), timeout=60)
+        assert out == 9
+
+        # .future() view
+        f = produce.remote(7).future()
+        assert f.result(timeout=30) == 21
+
+        # a method RETURNING a ref hands the ref over (never auto-awaited)
+        @ray_tpu.remote
+        class Maker:
+            async def make(self):
+                return produce.remote(5)
+
+        m = Maker.remote()
+        inner = ray_tpu.get(m.make.remote(), timeout=30)
+        assert isinstance(inner, ray_tpu.ObjectRef)
+        assert ray_tpu.get(inner, timeout=30) == 15
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_await_object_ref_cluster():
+    """Awaitable refs work from inside cluster worker processes too."""
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    client = c.client()
+    set_runtime(client)
+    try:
+
+        @ray_tpu.remote
+        def produce(x):
+            return x + 100
+
+        @ray_tpu.remote(num_cpus=0.25)
+        class Waiter:
+            async def sum_refs(self, refs):
+                total = 0
+                for r in refs:
+                    total += await r
+                return total
+
+        w = Waiter.remote()
+        refs = [produce.remote(i) for i in range(4)]
+        assert ray_tpu.get(w.sum_refs.remote(list(refs)), timeout=120) == 406
+    finally:
+        set_runtime(None)
+        client.shutdown()
+        c.shutdown()
