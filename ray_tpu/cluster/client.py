@@ -673,6 +673,25 @@ class RemoteRuntime:
         for h in unpin:
             TRACKER.decref(h)
 
+    def _direct_note_head_resolved(self, h: str) -> None:
+        """A direct-call ref resolved through the head directory while its
+        push was still pending: the push was lost (worker-side transient
+        RPC failure — the seal reached the head anyway). Drop the pending
+        entry and release its arg pins so later gets of this ref go
+        straight to the head instead of stalling direct_wait_fallback_s,
+        and the entry doesn't leak for the session. Safe: the seal landing
+        at the head proves the worker finished with the args."""
+        if h not in self._direct_pending:
+            return
+        from ray_tpu.core.refcount import TRACKER
+
+        with self._direct_cv:
+            self._direct_pending.pop(h, None)
+            unpin = self._direct_arg_pins.pop(h, ())
+            self._direct_cv.notify_all()
+        for p in unpin:
+            TRACKER.decref(p)
+
     def _drop_direct_channel(self, actor_id: str, chan) -> None:
         with self._lock:
             if self._direct_channels.get(actor_id) is chan:
@@ -798,6 +817,18 @@ class RemoteRuntime:
             "KillActor", {"actor_id": handle._actor_id, "no_restart": no_restart}
         )
 
+    def actor_location(self, actor_id: str):
+        """(node_id, agent_address) of an actor, or (None, None) while it
+        is pending placement. Used for locality-aware dispatch (e.g. the
+        serve proxy pinning shm-streaming calls to same-host replicas)."""
+        try:
+            info = self._read(
+                "WaitActor", {"actor_id": actor_id, "timeout": 0.01}
+            )
+        except Exception:  # noqa: BLE001
+            return None, None
+        return info.node_id, info.address
+
     def wait_actor_alive(self, handle: RemoteActorHandle, timeout: float = 30.0):
         """Event-driven: each round is a server-side long-poll (WaitActor),
         so state changes propagate at RPC latency with no sleep loop."""
@@ -861,6 +892,8 @@ class RemoteRuntime:
                 "WaitObject", {"object_id": ref.hex, "timeout": poll}
             )
             status = reply["status"]
+            if status in ("inline", "error", "located"):
+                self._direct_note_head_resolved(h)
             if status == "inline":
                 return self._loads_tracking(reply["data"])
             if status == "error":
@@ -914,6 +947,8 @@ class RemoteRuntime:
             located: Dict[tuple, List[str]] = {}
             for h, rep in zip(unresolved, replies):
                 status = rep["status"]
+                if status in ("inline", "error", "located"):
+                    self._direct_note_head_resolved(h)
                 if status == "inline":
                     results[h] = ("val", self._loads_tracking(rep["data"]))
                 elif status == "error":

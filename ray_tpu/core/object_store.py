@@ -45,22 +45,95 @@ class GetTimeoutError(TimeoutError):
     pass
 
 
-_RESOLVER_POOL = None
+class _RefWaiter:
+    """One daemon thread multiplexing every pending .future()/__await__
+    resolution. A thread-per-ref (or bounded-pool) design head-of-line
+    blocks: N concurrently awaited unresolved refs starve every later
+    await, including refs whose objects are already sealed (the reference
+    resolves event-driven via _to_future, object_ref.pxi). Here the single
+    waiter asks the runtime's wait primitive for ANY ready ref, resolves
+    those (get_object returns promptly once sealed), and completes their
+    futures — unresolved refs cost a slot in a dict, not a thread."""
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        # hex -> (ref, [futures]); many futures may await one ref
+        self._pending: Dict[str, tuple] = {}
+        self._generation = 0  # bumped per submit: shrinks the poll window
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ref-await"
+        )
+        self._thread.start()
+
+    def submit(self, ref: "ObjectRef"):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        with self._cv:
+            self._pending.setdefault(ref.hex, (ref, []))[1].append(fut)
+            self._generation += 1
+            self._cv.notify()
+        return fut
+
+    def _loop(self) -> None:
+        import time
+
+        window = 0.2
+        last_gen = -1
+        while True:
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+                refs = [r for r, _ in self._pending.values()]
+                gen = self._generation
+            # adaptive window: freshly submitted refs get a short wait (a
+            # just-sealed object resolves fast); an unchanged pending set
+            # backs the window off so one long-running awaited task does
+            # not turn into a 5 Hz head poll in cluster mode
+            window = 0.2 if gen != last_gen else min(2.0, window * 2)
+            last_gen = gen
+            rt = None
+            try:
+                from ray_tpu.core.runtime import get_runtime
+
+                rt = get_runtime()
+                ready, _ = rt.store.wait_many(refs, 1, window)
+            except Exception:  # noqa: BLE001 - runtime mid-swap/teardown
+                ready = []
+                time.sleep(0.05)
+            for r in ready:
+                try:
+                    value, is_err = rt.get_object(r, 5.0), False
+                except GetTimeoutError:
+                    # sealed but the fetch is slow (large cross-node
+                    # object): leave it pending and retry next round
+                    # rather than surfacing a timeout the caller never
+                    # asked for
+                    continue
+                except BaseException as exc:  # noqa: BLE001
+                    value, is_err = exc, True
+                with self._cv:
+                    entry = self._pending.pop(r.hex, None)
+                for fut in entry[1] if entry else ():
+                    try:
+                        if is_err:
+                            fut.set_exception(value)
+                        else:
+                            fut.set_result(value)
+                    except Exception:  # noqa: BLE001 - future cancelled
+                        pass
+
+
+_RESOLVER = None
 _RESOLVER_LOCK = threading.Lock()
 
 
-def _resolver_pool():
-    """Shared bounded pool for .future()/__await__ resolution — per-call
-    threads would grow without bound on never-sealed refs."""
-    global _RESOLVER_POOL
+def _resolver() -> _RefWaiter:
+    global _RESOLVER
     with _RESOLVER_LOCK:
-        if _RESOLVER_POOL is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            _RESOLVER_POOL = ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="ref-await"
-            )
-        return _RESOLVER_POOL
+        if _RESOLVER is None:
+            _RESOLVER = _RefWaiter()
+        return _RESOLVER
 
 
 def should_await(value) -> bool:
@@ -117,12 +190,10 @@ class ObjectRef:
 
     def future(self):
         """concurrent.futures.Future view of this ref (ray parity);
-        resolves on a shared bounded pool."""
-        return _resolver_pool().submit(
-            lambda: __import__(
-                "ray_tpu.core.runtime", fromlist=["get_runtime"]
-            ).get_runtime().get_object(self, None)
-        )
+        resolved event-driven by the shared multiplexing waiter — any
+        number of unresolved refs can be awaited concurrently without
+        head-of-line blocking."""
+        return _resolver().submit(self)
 
     @staticmethod
     def new(owner: str = "") -> "ObjectRef":

@@ -112,6 +112,7 @@ def run_dag_stage(
     full ring would otherwise outlive its channels)."""
 
     def put_checked(ch, tag, value) -> bool:
+        converted = False
         while True:
             try:
                 ch.put(tag, value, timeout=0.5)
@@ -121,6 +122,28 @@ def run_dag_stage(
                     return False
             except (ChannelClosed, OSError):
                 return False
+            except Exception as exc:  # noqa: BLE001
+                # Serialization failure — oversized for the ring capacity,
+                # unpicklable result, codec error. This execution fails but
+                # the stage loop must survive: degrade to an ERR marker
+                # whose cause is a plain string (guaranteed to serialize,
+                # truncated so it always fits the ring) and resend — once.
+                # If even the safe marker won't go through, the channel is
+                # unusable: give up rather than spin.
+                if converted or stop_flag.is_set():
+                    return False
+                converted = True
+                import traceback
+
+                tag = ERR
+                value = TaskError(
+                    RuntimeError(
+                        f"result of {name} could not be sent: "
+                        + repr(exc)[:2048]
+                    ),
+                    name,
+                    traceback_str=traceback.format_exc()[-2048:],
+                )
 
     while not stop_flag.is_set():
         try:

@@ -147,9 +147,12 @@ class ContinuousBatchingEngine:
         self.positions = jnp.zeros((self.B,), jnp.int32)
         self.cur_tokens = jnp.zeros((self.B,), jnp.int32)
         self.active_mask = jnp.zeros((self.B,), bool)
-        # per-slot sampling temperature (0 = greedy) + a per-step key
+        # per-slot sampling temperature (0 = greedy) and per-slot seed:
+        # each slot's key derives from its request's seed + its own
+        # position, so temperature>0 output is per-request deterministic
+        # regardless of which other requests are co-resident in the batch
         self.temps = jnp.zeros((self.B,), jnp.float32)
-        self._step_count = 0
+        self.seeds = jnp.zeros((self.B,), jnp.uint32)
         self._build_fns()
 
     # ------------------------------------------------------------------
@@ -187,7 +190,7 @@ class ContinuousBatchingEngine:
         @jax.jit
         def decode_step(
             params, pool_k, pool_v, tables, positions, tokens, active,
-            temps, key,
+            temps, seeds,
         ):
             """One token for every slot. Inactive slots run the same
             math (one trace) but their KV writes are redirected to the
@@ -273,12 +276,16 @@ class ContinuousBatchingEngine:
             h = tfm.rms_norm(h, params["ln_f"])
             logits = (h @ params["head"]).astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            keys = jax.random.split(key, b)
+            # per-slot key = fold(request seed, absolute position of the
+            # token being produced); prefill samples its first token with
+            # fold(seed, prompt_len), decode continues at prompt_len+1…
+            # — a slot's stream never depends on co-resident requests
             sampled = jax.vmap(
-                lambda kk, lg, tt: jax.random.categorical(
-                    kk, lg / jnp.maximum(tt, 1e-6)
+                lambda sd, pos, lg, tt: jax.random.categorical(
+                    jax.random.fold_in(jax.random.PRNGKey(sd), pos + 1),
+                    lg / jnp.maximum(tt, 1e-6),
                 )
-            )(keys, logits, temps).astype(jnp.int32)
+            )(seeds, positions, logits, temps).astype(jnp.int32)
             nxt = jnp.where(temps > 0.0, sampled, greedy)
             return nxt, pool_k, pool_v
 
@@ -421,8 +428,13 @@ class ContinuousBatchingEngine:
                 jnp.asarray(pages[:prompt_pages], dtype=jnp.int32),
             )
             if req.gen.temperature > 0.0:
+                # same uint32 normalization as the decode path — one key
+                # stream per request across prefill and decode
                 kk = jax.random.fold_in(
-                    jax.random.PRNGKey(req.gen.seed), t
+                    jax.random.PRNGKey(
+                        np.uint32(req.gen.seed & 0xFFFFFFFF)
+                    ),
+                    t,
                 )
                 first = int(
                     jax.random.categorical(
@@ -452,6 +464,9 @@ class ContinuousBatchingEngine:
             self.cur_tokens = self.cur_tokens.at[si].set(first)
             self.active_mask = self.active_mask.at[si].set(True)
             self.temps = self.temps.at[si].set(float(req.gen.temperature))
+            self.seeds = self.seeds.at[si].set(
+                np.uint32(req.gen.seed & 0xFFFFFFFF)
+            )
             self._maybe_finish(si)
 
     def _maybe_finish(self, si: int) -> None:
@@ -475,10 +490,6 @@ class ContinuousBatchingEngine:
         self._admit()
         before = set(self.results)
         if any(s.active for s in self.slots):
-            self._step_count += 1
-            key = jax.random.fold_in(
-                jax.random.PRNGKey(0xC0FFEE), self._step_count
-            )
             nxt, self.pool.k, self.pool.v = self._decode_step(
                 self.params,
                 self.pool.k,
@@ -488,7 +499,7 @@ class ContinuousBatchingEngine:
                 self.cur_tokens,
                 self.active_mask,
                 self.temps,
-                key,
+                self.seeds,
             )
             nxt_h = np.asarray(nxt)
             self.positions = self.positions + jnp.where(self.active_mask, 1, 0)

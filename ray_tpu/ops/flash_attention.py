@@ -1,9 +1,16 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 Blockwise attention with an online-softmax accumulator: Q stays resident in
 VMEM per grid step while K/V blocks stream HBM→VMEM; scores never
 materialize in HBM (the memory win), and the causal grid skips fully-masked
 K blocks (the compute win). Grid: (batch·kv_heads·groups, q_blocks).
+
+The backward pass is the FlashAttention-2 recipe: the forward saves only
+the per-row logsumexp L; the backward recomputes score blocks on the fly
+and accumulates dQ (grid over Q blocks) and dK/dV (grid over K blocks)
+without ever materializing the [T, S] probability matrix. This is what
+makes the flagship model's training step runnable on the TPU — without a
+custom VJP, autodiff cannot see through pallas_call.
 
 Single-chip counterpart of ops/ring_attention.py (which handles the
 sequence-sharded case over ICI); together they are the long-context story
@@ -23,9 +30,10 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
+                causal: bool, scale: float):
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d]; o_ref: [1, block_q, d]
+    # l_ref: [1, block_q] — per-row logsumexp saved for the backward pass
     _, block_q, d = q_ref.shape
     s = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -68,7 +76,196 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     else:
         num_kb_live = num_kb
     m, l, o = jax.lax.fori_loop(0, num_kb_live, body, (m0, l0, o0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    l_ref[0] = m + jnp.log(l_safe)  # logsumexp per row
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, causal: bool, scale: float):
+    # per program: one Q block against all K blocks (same live set as fwd)
+    _, block_q, d = q_ref.shape
+    s = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0] * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+        p = jnp.exp(scores - lse[:, None])  # masked entries underflow to 0
+        dp = jnp.dot(do, v_blk.T.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(
+            ds.astype(k_blk.dtype), k_blk,
+            preferred_element_type=jnp.float32,
+        )
+
+    num_kb = s // block_k
+    if causal:
+        num_kb_live = jnp.minimum(num_kb, (qi + 1) * block_q // block_k + 1)
+    else:
+        num_kb_live = num_kb
+    dq = jax.lax.fori_loop(
+        0, num_kb_live, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
+    # per program: one K block against the Q blocks that can see it
+    _, block_k, d = k_ref.shape
+    t = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :] * scale
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        scores = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+        p = jnp.exp(scores - lse_blk[:, None])  # [bq, bk]
+        dv = dv + jnp.dot(
+            p.T.astype(do_blk.dtype), do_blk,
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.dot(do_blk, v.T.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jnp.dot(
+            ds.T.astype(q_blk.dtype), q_blk,
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    num_qb = t // block_q
+    if causal:
+        qb_start = ki * block_k // block_q  # earlier Q blocks see nothing
+    else:
+        qb_start = 0
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk0, dv0))
+    # q_blk carried the scale into ds already — no second factor here
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fwd_impl(qg, kg, vg, causal, block_q, block_k, interpret):
+    bh, t, d = qg.shape
+    s = kg.shape[1]
+    scale = 1.0 / (d**0.5)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, qi: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, qi: (b, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qg.shape, qg.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qg, kg, vg)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_grouped(qg, kg, vg, causal, block_q, block_k, interpret):
+    """Grouped layout [B·KH·G, T, D]; K/V already repeated per group (the
+    repeat sits OUTSIDE this boundary so autodiff sums dk/dv over groups)."""
+    out, _ = _fwd_impl(qg, kg, vg, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_grouped_fwd(qg, kg, vg, causal, block_q, block_k, interpret):
+    out, lse = _fwd_impl(qg, kg, vg, causal, block_q, block_k, interpret)
+    return out, (qg, kg, vg, out, lse)
+
+
+def _flash_grouped_bwd(causal, block_q, block_k, interpret, res, do):
+    qg, kg, vg, out, lse = res
+    bh, t, d = qg.shape
+    s = kg.shape[1]
+    scale = 1.0 / (d**0.5)
+    # delta_i = rowsum(dO ⊙ O): the softmax-jacobian correction term
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [bh, t]
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, qi: (b, qi)),
+            pl.BlockSpec((1, block_q), lambda b, qi: (b, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qg.shape, qg.dtype),
+        interpret=interpret,
+    )(qg, kg, vg, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, causal=causal, scale=scale
+        ),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((1, t, d), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, t), lambda b, ki: (b, 0)),
+            pl.BlockSpec((1, t), lambda b, ki: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kg.shape, kg.dtype),
+            jax.ShapeDtypeStruct(vg.shape, vg.dtype),
+        ],
+        interpret=interpret,
+    )(qg, kg, vg, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_grouped.defvjp(_flash_grouped_fwd, _flash_grouped_bwd)
 
 
 @functools.partial(
@@ -91,10 +288,10 @@ def flash_attention(
     if t % block_q or s % block_k:
         # ragged tails fall back to the fused-XLA reference path
         return attention_reference(q, k, v, causal=causal)
-    scale = 1.0 / (d**0.5)
 
     # layout: fold (batch, kv_head, group) into the grid's first axis; GQA
-    # shares each K/V head across `groups` Q heads.
+    # shares each K/V head across `groups` Q heads. The repeat stays
+    # outside the custom-vjp boundary so dk/dv sum over groups for free.
     qg = (
         q.reshape(b, t, hkv, groups, d)
         .transpose(0, 2, 3, 1, 4)
@@ -111,20 +308,7 @@ def flash_attention(
         .reshape(b * hkv * groups, s, d)
     )
 
-    out = pl.pallas_call(
-        functools.partial(
-            _flash_kernel, block_k=block_k, causal=causal, scale=scale
-        ),
-        grid=(qg.shape[0], t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
-        interpret=interpret,
-    )(qg, kg, vg)
+    out = _flash_grouped(qg, kg, vg, causal, block_q, block_k, interpret)
     return (
         out.reshape(b, hkv, groups, t, d)
         .transpose(0, 3, 1, 2, 4)

@@ -80,6 +80,11 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None, **opts):
     return wrap
 
 
+class NoPreferredReplica(RuntimeError):
+    """Raised by strict-preference dispatch when no candidate replica
+    satisfies the caller's predicate (e.g. same-host for shm streaming)."""
+
+
 @dataclass
 class _Replica:
     actor: Any
@@ -148,19 +153,32 @@ class _ReplicaSet:
             ray_tpu.kill(victim.actor)
 
     # power-of-two-choices routing (pow_2_router.py:27)
-    def _pick_replica(self) -> _Replica:
+    def _pick_replica(self, prefer=None, strict_prefer=False) -> _Replica:
         # caller holds self.lock
         cands = [r for r in self.replicas if not r.draining]
         if not cands:
             cands = list(self.replicas)
+        if prefer is not None:
+            # affinity (e.g. same-host pinning for shm streaming):
+            # restrict to preferred replicas when any exist. strict means
+            # the caller's transport REQUIRES the predicate (a same-host-
+            # only shm writer must never reach a cross-host replica) —
+            # raise instead of falling through so the caller can switch
+            # transports.
+            preferred = [r for r in cands if prefer(r)]
+            if preferred:
+                cands = preferred
+            elif strict_prefer:
+                raise NoPreferredReplica(self.dep.name)
         if len(cands) == 1:
             return cands[0]
         a, b = random.sample(cands, 2)
         return a if a.ongoing <= b.ongoing else b
 
-    def submit(self, method: str, args, kwargs):
+    def submit(self, method: str, args, kwargs, prefer=None,
+               strict_prefer=False):
         with self.lock:
-            replica = self._pick_replica()
+            replica = self._pick_replica(prefer, strict_prefer)
             replica.ongoing += 1
             self.total_requests += 1
             actor = replica.actor

@@ -28,6 +28,149 @@ from typing import Any, Optional
 import ray_tpu
 
 
+class _StreamRelayActor:
+    """Cross-host bridge for SSE streaming. The shm ring Channel is
+    same-host-only; when every replica of a deployment lives on another
+    host, the replica's writer pushes token batches through this actor as
+    ordinary (cross-node) actor calls and the proxy long-polls ``pop``.
+    Sequence numbers restore order (async actor methods may interleave)."""
+
+    def __init__(self, max_buffer: int = 4096):
+        from collections import deque
+
+        self._stash: dict = {}  # seq -> (items, closed)
+        self._next_seq = 0
+        self._out = deque()
+        self._closed = False
+        self._max = max_buffer
+        self._event = None  # created lazily on the actor's event loop
+
+    def _ev(self):
+        import asyncio
+
+        if self._event is None:
+            self._event = asyncio.Event()
+        return self._event
+
+    async def push(self, seq: int, items: list, closed: bool = False) -> int:
+        """Returns the current queue depth. Backpressure is writer-side
+        (throttle on the returned depth) — parking here would hold the
+        actor's concurrency slots and starve pop()."""
+        self._stash[seq] = (items, closed)
+        while self._next_seq in self._stash:
+            its, cl = self._stash.pop(self._next_seq)
+            self._out.extend(its)
+            if cl:
+                self._closed = True
+            self._next_seq += 1
+        self._ev().set()
+        return len(self._out)
+
+    async def depth(self) -> int:
+        return len(self._out)
+
+    async def pop(self, max_items: int = 256, timeout: float = 5.0):
+        """Returns (items, ended). ended only once the queue is drained."""
+        import asyncio
+
+        if not self._out and not self._closed:
+            self._ev().clear()
+            try:
+                await asyncio.wait_for(self._ev().wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+        items = []
+        while self._out and len(items) < max_items:
+            items.append(self._out.popleft())
+        return items, self._closed and not self._out
+
+
+class _RelayWriter:
+    """ChannelWriter-compatible handle shipped to a cross-host replica:
+    ``write``/``close_channel`` become actor pushes with a bounded
+    in-flight window (ordering restored actor-side by sequence number)."""
+
+    def __init__(self, actor):
+        self._actor = actor
+        self._seq = 0
+        self._pending = []
+
+    def write(self, value, timeout=None) -> None:
+        import time as _time
+
+        ref = self._actor.push.remote(self._seq, [value])
+        self._seq += 1
+        self._pending.append(ref)
+        if len(self._pending) > 32:
+            depth = ray_tpu.get(self._pending.pop(0), timeout=30)
+            # a stalled consumer shows up as queue depth: throttle here
+            # (writer-side) instead of parking inside the actor
+            while depth > 4096:
+                _time.sleep(0.05)
+                depth = ray_tpu.get(self._actor.depth.remote(), timeout=30)
+
+    def close_channel(self) -> None:
+        refs = self._pending + [self._actor.push.remote(self._seq, [], True)]
+        self._seq += 1
+        self._pending = []
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        pass
+
+    def __reduce__(self):
+        return (_RelayWriter, (self._actor,))
+
+
+class _RelayReader:
+    """ChannelReader-compatible proxy-side view of a _StreamRelayActor."""
+
+    def __init__(self, actor):
+        from collections import deque
+
+        self._actor = actor
+        self._buf = deque()
+        self._ended = False
+
+    def read(self, timeout=None):
+        from ray_tpu.experimental import ChannelClosed
+
+        if self._buf:
+            return self._buf.popleft()
+        if self._ended:
+            raise ChannelClosed("stream ended")
+        window = timeout if timeout is not None else 5.0
+        items, ended = ray_tpu.get(
+            self._actor.pop.remote(256, window), timeout=window + 30
+        )
+        self._buf.extend(items)
+        self._ended = ended
+        if self._buf:
+            return self._buf.popleft()
+        if self._ended:
+            raise ChannelClosed("stream ended")
+        raise TimeoutError("no items in window")
+
+
+def _local_hosts() -> set:
+    import socket
+
+    hosts = {"127.0.0.1", "localhost", "::1"}
+    try:
+        hosts.add(socket.gethostname())
+        hosts.add(socket.getfqdn())
+        hosts.update(
+            i[4][0] for i in socket.getaddrinfo(socket.gethostname(), None)
+        )
+    except OSError:
+        pass
+    return hosts
+
+
 class ServeProxy:
     def __init__(self, apps: dict, port: int = 0):
         self._apps = apps
@@ -48,6 +191,36 @@ class ServeProxy:
             raise RuntimeError(
                 f"serve proxy failed to start: {self._startup_error!r}"
             )
+
+    def _same_host_pred(self):
+        """Predicate over _Replica: is its actor on this proxy's host?
+        Local runtime ⇒ always; cluster runtime ⇒ compare the hosting
+        agent's address to our own interfaces. Results are cached per
+        actor id (placement is sticky for a live actor)."""
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            rt = get_runtime()
+        except Exception:  # noqa: BLE001
+            return lambda r: True
+        if not getattr(rt, "is_remote", False):
+            return lambda r: True
+        local = _local_hosts()
+        cache: dict = {}
+
+        def pred(replica) -> bool:
+            aid = getattr(replica.actor, "_actor_id", None)
+            if aid is None:
+                return True
+            if aid not in cache:
+                _, addr = rt.actor_location(aid)
+                host = addr.rsplit(":", 1)[0] if addr else None
+                # unknown location ⇒ NOT local: the relay path works on
+                # every topology, the shm path only works same-host
+                cache[aid] = host is not None and host in local
+            return cache[aid]
+
+        return pred
 
     # -- handlers -------------------------------------------------------
     async def _call(self, request):
@@ -103,7 +276,45 @@ class ServeProxy:
             }
         )
         await resp.prepare(request)
-        ch = Channel(buffer_size_bytes=1 << 18)
+        # transport selection: the shm ring Channel is same-host-only, so
+        # pin the stream_to dispatch to a same-host replica when one
+        # exists; with only cross-host replicas, bridge through a relay
+        # actor instead (ordinary actor calls work across nodes)
+        from ray_tpu.serve.deployment import NoPreferredReplica
+
+        same_host = self._same_host_pred()
+        with rs.lock:
+            cands = [r for r in rs.replicas if not r.draining] or list(
+                rs.replicas
+            )
+        has_local = any(same_host(r) for r in cands)
+        ch = relay_actor = ref = None
+        if has_local:
+            # fast path: shm ring to a same-host replica. strict: if the
+            # preferred replica drains between the snapshot and the
+            # dispatch, fall through to the relay instead of handing a
+            # same-host-only writer to a cross-host replica.
+            ch = Channel(buffer_size_bytes=1 << 18)
+            try:
+                ref = rs.submit(
+                    "stream_to",
+                    (ch.writer, payload),
+                    {},
+                    prefer=same_host,
+                    strict_prefer=True,
+                )
+                reader = ch.reader
+            except NoPreferredReplica:
+                ch.destroy()
+                ch = None
+        if ref is None:
+            relay_actor = ray_tpu.remote(_StreamRelayActor).options(
+                num_cpus=0.0, max_concurrency=16
+            ).remote()
+            writer, reader = _RelayWriter(relay_actor), _RelayReader(
+                relay_actor
+            )
+            ref = rs.submit("stream_to", (writer, payload), {})
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         _END, _ERR = object(), object()
@@ -128,7 +339,7 @@ class ServeProxy:
             try:
                 while True:
                     try:
-                        value = ch.reader.read(timeout=5)
+                        value = reader.read(timeout=5)
                     except ChannelClosed:
                         emit(_END)
                         return
@@ -145,7 +356,7 @@ class ServeProxy:
                         # have written between our timeout and the probe
                         try:
                             while True:
-                                emit("data", ch.reader.read(timeout=0.5))
+                                emit("data", reader.read(timeout=0.5))
                         except ChannelClosed:
                             emit(_END)
                         except TimeoutError:
@@ -161,7 +372,6 @@ class ServeProxy:
                     emit(_ERR, repr(exc))
 
         try:
-            ref = rs.submit("stream_to", (ch.writer, payload), {})
             threading.Thread(
                 target=relay, args=(ref,), name="sse-relay", daemon=True
             ).start()
@@ -184,7 +394,13 @@ class ServeProxy:
             )
         finally:
             dead.set()
-            ch.destroy()
+            if ch is not None:
+                ch.destroy()
+            if relay_actor is not None:
+                try:
+                    ray_tpu.kill(relay_actor)
+                except Exception:  # noqa: BLE001
+                    pass
         await resp.write_eof()
         return resp
 
