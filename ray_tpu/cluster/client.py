@@ -77,9 +77,12 @@ class _RemoteStore:
         blocks until num_returns ids resolve (WaitObjectBatch num_returns),
         so readiness propagates at RPC latency without client sleep
         loops."""
+        from ray_tpu.config import cfg
+
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
+        t_start = time.monotonic()
         while pending and len(ready) < num_returns:
             # direct-call results resolve locally without a head round trip
             if self._rt._direct_enabled:
@@ -95,6 +98,34 @@ class _RemoteStore:
                 pending = still
                 if not pending or len(ready) >= num_returns:
                     break
+                # every remaining ref is a direct call whose result will
+                # arrive by push: park on the push channel's condition
+                # variable instead of a head long-poll — a WaitObjectBatch
+                # RPC would sit blind for its whole window while pushes
+                # land locally. After the fallback grace (push may have
+                # been lost) the head path takes over.
+                if (
+                    all(h.hex in self._rt._direct_pending for h in pending)
+                    and time.monotonic() - t_start
+                    < cfg.direct_wait_fallback_s
+                ):
+                    wait_s = 0.2
+                    if deadline is not None:
+                        wait_s = min(
+                            wait_s, max(0.0, deadline - time.monotonic())
+                        )
+                    with self._rt._direct_cv:
+                        if not any(
+                            h.hex in self._rt._direct_results
+                            for h in pending
+                        ):
+                            self._rt._direct_cv.wait(timeout=wait_s)
+                    if (
+                        deadline is not None
+                        and time.monotonic() >= deadline
+                    ):
+                        break
+                    continue
             window = 5.0
             if deadline is not None:
                 window = min(window, max(0.0, deadline - time.monotonic()))
@@ -872,6 +903,16 @@ class RemoteRuntime:
         from ray_tpu.core.refcount import loads_tracking
 
         return loads_tracking(self._flusher, data)
+
+    def object_locations(self, refs: List[ObjectRef]) -> Dict[str, List[str]]:
+        """hex -> node ids currently holding the object (best-effort,
+        non-blocking; the head's object directory)."""
+        try:
+            return self._read(
+                "LocateObjects", {"object_ids": [r.hex for r in refs]}
+            )
+        except Exception:  # noqa: BLE001
+            return {}
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout

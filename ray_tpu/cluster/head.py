@@ -214,6 +214,7 @@ class HeadServer:
             "ClientBatch": self._h_client_batch,
             "PutObject": self._h_put_object,
             "WaitObject": self._h_wait_object,
+            "LocateObjects": self._h_locate_objects,
             "WaitObjectBatch": self._h_wait_object_batch,
             "FreeObjects": self._h_free_objects,
             "RefUpdate": lambda r: self._h_ref_update(r, src="direct"),
@@ -882,6 +883,17 @@ class HeadServer:
         if not locs:
             return {"status": "pending"}  # recovery in progress
         return {"status": "located", "locations": locs}
+
+    def _h_locate_objects(self, req: dict) -> Dict[str, List[str]]:
+        """Non-blocking batched location lookup from the object directory
+        (ray.experimental.get_object_locations analog) — locality-ranked
+        dispatch in the Data actor pools rides this."""
+        out: Dict[str, List[str]] = {}
+        with self._lock:
+            for oid in req["object_ids"]:
+                e = self._objects.get(oid)
+                out[oid] = sorted(e.locations) if e is not None else []
+        return out
 
     def _h_wait_object(self, req: dict) -> dict:
         """Long-poll for availability (pubsub long-poll analog,

@@ -243,3 +243,26 @@ define(
     16,
     "Default max concurrently admitted executions per compiled DAG.",
 )
+
+# ---------------------------------------------------------------------------
+# data (streaming executor)
+# ---------------------------------------------------------------------------
+define(
+    "data_inflight_budget_bytes",
+    256 << 20,
+    "Per-stage in-flight byte budget for the Data streaming executor "
+    "(resource_manager.py analog); block bytes are estimated from the "
+    "first materialized block of each stage.",
+)
+define(
+    "data_actor_idle_reap_s",
+    10.0,
+    "Actor-pool map workers idle longer than this (above min_size) are "
+    "reaped by the streaming executor.",
+)
+define(
+    "data_max_tasks_in_flight_per_actor",
+    2,
+    "Default per-actor in-flight cap for actor-pool map operators "
+    "(pipelines the next block behind the running one).",
+)

@@ -13,6 +13,7 @@ from .dataset import (  # noqa: F401
     from_numpy,
     range_,
 )
+from .execution import ActorPoolStrategy, actors  # noqa: F401
 from .io import (  # noqa: F401
     from_pandas,
     read_csv,

@@ -13,6 +13,19 @@ import ray_tpu
 # -- block-level task (executed remotely) -----------------------------------
 
 
+def _apply_batches(fn: Callable, block: List[Any], kwargs: dict) -> List[Any]:
+    """One map_batches op over one block: slice into batches, convert to
+    the requested batch_format, apply, convert back to rows."""
+    size = kwargs.get("batch_size") or len(block) or 1
+    fmt = kwargs.get("batch_format") or "numpy"
+    out: List[Any] = []
+    for i in range(0, len(block), size):
+        batch = _rows_to_batch(block[i : i + size], fmt)
+        result = fn(batch)
+        out.extend(_batch_to_rows(result))
+    return out
+
+
 def _apply_chain_local(block: List[Any], ops: List[tuple]) -> List[Any]:
     for kind, fn, kwargs in ops:
         if kind == "map":
@@ -22,21 +35,24 @@ def _apply_chain_local(block: List[Any], ops: List[tuple]) -> List[Any]:
         elif kind == "flat_map":
             block = [out for row in block for out in fn(row)]
         elif kind == "map_batches":
-            size = kwargs.get("batch_size") or len(block) or 1
-            out: List[Any] = []
-            for i in range(0, len(block), size):
-                batch = _rows_to_batch(block[i : i + size])
-                result = fn(batch)
-                out.extend(_batch_to_rows(result))
-            block = out
+            block = _apply_batches(fn, block, kwargs)
     return block
 
 
 _apply_chain = ray_tpu.remote(_apply_chain_local)
 
+_BATCH_FORMATS = ("numpy", "default", "pandas")
 
-def _rows_to_batch(rows: List[Any]) -> Dict[str, np.ndarray]:
-    """numpy batch format (the reference's default batch_format="numpy")."""
+
+def _rows_to_batch(rows: List[Any], batch_format: str = "numpy"):
+    """Batch conversion. "numpy"/"default": dict of numpy arrays (the
+    reference's default); "pandas": a DataFrame."""
+    if batch_format == "pandas":
+        import pandas as pd
+
+        if rows and isinstance(rows[0], dict):
+            return pd.DataFrame(rows)
+        return pd.DataFrame({"data": list(rows)})
     if rows and isinstance(rows[0], dict):
         keys = rows[0].keys()
         return {k: np.asarray([r[k] for r in rows]) for k in keys}
@@ -44,6 +60,8 @@ def _rows_to_batch(rows: List[Any]) -> Dict[str, np.ndarray]:
 
 
 def _batch_to_rows(batch: Any) -> List[Any]:
+    if type(batch).__name__ == "DataFrame":  # pandas without the import
+        return batch.to_dict("records")
     if isinstance(batch, dict):
         keys = list(batch.keys())
         n = len(batch[keys[0]])
@@ -76,12 +94,89 @@ class Dataset:
         return Dataset(self._input_blocks, self._ops + [("flat_map", fn, {})])
 
     def map_batches(
-        self, fn: Callable, *, batch_size: Optional[int] = None, **_ignored
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        compute: Optional[Any] = None,
+        concurrency: Optional[Any] = None,
+        batch_format: str = "numpy",
+        fn_constructor_args: Optional[tuple] = None,
+        fn_constructor_kwargs: Optional[dict] = None,
+        num_cpus: Optional[float] = None,
+        **unknown,
     ) -> "Dataset":
-        return Dataset(
-            self._input_blocks,
-            self._ops + [("map_batches", fn, {"batch_size": batch_size})],
-        )
+        """Batch transform. Stateless callables run as fused block tasks;
+        ``compute=ActorPoolStrategy(...)`` (or a tuple ``concurrency``,
+        or a callable-class ``fn``) runs on an autoscaling actor pool with
+        locality-ranked dispatch (execution.py). Unsupported arguments
+        raise instead of being silently dropped."""
+        if unknown:
+            raise TypeError(
+                f"map_batches got unsupported argument(s) "
+                f"{sorted(unknown)}; supported: batch_size, compute, "
+                "concurrency, batch_format, fn_constructor_args, "
+                "fn_constructor_kwargs, num_cpus"
+            )
+        if batch_format not in _BATCH_FORMATS:
+            raise ValueError(
+                f"batch_format={batch_format!r} not supported "
+                f"(one of {_BATCH_FORMATS})"
+            )
+        from .execution import ActorPoolStrategy
+
+        pool: Optional[ActorPoolStrategy] = None
+        task_cap: Optional[int] = None
+        if isinstance(compute, ActorPoolStrategy):
+            pool = compute
+        elif compute is not None:
+            raise TypeError(
+                "compute must be an ActorPoolStrategy (or use "
+                "concurrency=(min, max) for an autoscaling pool)"
+            )
+        if pool is not None and concurrency is not None:
+            raise ValueError(
+                "pass either compute=ActorPoolStrategy(...) or "
+                "concurrency=, not both"
+            )
+        if isinstance(concurrency, tuple):
+            pool = ActorPoolStrategy(*concurrency)
+        elif isinstance(concurrency, int):
+            if isinstance(fn, type):
+                pool = ActorPoolStrategy(concurrency, concurrency)
+            else:
+                task_cap = concurrency
+        if isinstance(fn, type) and pool is None:
+            raise ValueError(
+                "a callable-class UDF is stateful and must run on an "
+                "actor pool: pass concurrency=n / (min, max) or "
+                "compute=ActorPoolStrategy(...)"
+            )
+        op_kwargs = {"batch_size": batch_size, "batch_format": batch_format}
+        if pool is not None:
+            op = (
+                "map_batches_actors",
+                fn,
+                {
+                    **op_kwargs,
+                    "pool": pool,
+                    "num_cpus": num_cpus,
+                    "fn_constructor_args": tuple(fn_constructor_args or ()),
+                    "fn_constructor_kwargs": dict(fn_constructor_kwargs or {}),
+                },
+            )
+        else:
+            if fn_constructor_args or fn_constructor_kwargs:
+                raise ValueError(
+                    "fn_constructor_args/kwargs require an actor pool "
+                    "(callable-class fn with concurrency/compute)"
+                )
+            op = (
+                "map_batches",
+                fn,
+                {**op_kwargs, "num_cpus": num_cpus, "task_cap": task_cap},
+            )
+        return Dataset(self._input_blocks, self._ops + [op])
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """All-to-all rebalance via the distributed shuffle (round-robin
@@ -253,11 +348,74 @@ class Dataset:
         return float(np.sqrt(builtins.max(var, 0.0)))
 
     def _block_aggregate(self, agg: str, on: Optional[str]) -> List[Any]:
-        refs = [
-            _block_agg.remote(b, self._ops, agg, on)
-            for b in self._input_blocks
-        ]
+        if self._has_actor_stage():
+            # actor stages can't fuse into the aggregate task: run the
+            # pipeline to refs, then aggregate per block
+            refs = [
+                _block_agg.remote(b, [], agg, on)
+                for b in self._executed_blocks()
+            ]
+        else:
+            refs = [
+                _block_agg.remote(b, self._ops, agg, on)
+                for b in self._input_blocks
+            ]
         return ray_tpu.get(refs)
+
+    def _has_actor_stage(self) -> bool:
+        return any(op[0] == "map_batches_actors" for op in self._ops)
+
+    def _build_stages(self) -> List[Any]:
+        """Compile the op list into executor stages: consecutive task ops
+        fuse into one TaskStage; each actor map_batches is its own
+        ActorStage (execution.py topology)."""
+        from .execution import ActorStage, TaskStage
+
+        stages: List[Any] = []
+        cur: List[tuple] = []
+        cur_cpus: Optional[float] = None
+        cur_cap: Optional[int] = None
+
+        def flush():
+            nonlocal cur, cur_cpus, cur_cap
+            if cur:
+                stages.append(
+                    TaskStage(cur, num_cpus=cur_cpus, max_concurrency=cur_cap)
+                )
+                cur, cur_cpus, cur_cap = [], None, None
+
+        for kind, fn, kwargs in self._ops:
+            if kind == "map_batches_actors":
+                flush()
+                stages.append(
+                    ActorStage(
+                        fn=fn,
+                        kwargs={
+                            "batch_size": kwargs.get("batch_size"),
+                            "batch_format": kwargs.get("batch_format"),
+                        },
+                        pool=kwargs["pool"],
+                        num_cpus=kwargs.get("num_cpus"),
+                        fn_constructor_args=kwargs.get(
+                            "fn_constructor_args", ()
+                        ),
+                        fn_constructor_kwargs=kwargs.get(
+                            "fn_constructor_kwargs", {}
+                        ),
+                    )
+                )
+            else:
+                cur.append((kind, fn, kwargs))
+                if kwargs.get("num_cpus") is not None:
+                    cur_cpus = max(cur_cpus or 0.0, kwargs["num_cpus"])
+                if kwargs.get("task_cap") is not None:
+                    cur_cap = (
+                        kwargs["task_cap"]
+                        if cur_cap is None
+                        else min(cur_cap, kwargs["task_cap"])
+                    )
+        flush()
+        return stages
 
     def _executed_blocks(self) -> List[Any]:
         """Apply pending ops, returning blocks as ObjectRefs — blocks stay
@@ -267,7 +425,11 @@ class Dataset:
         driver-resident; shipping them is the consumer's decision)."""
         if not self._ops:
             return list(self._input_blocks)
-        return [_apply_chain.remote(b, self._ops) for b in self._input_blocks]
+        from .execution import StreamingExecutor
+
+        return StreamingExecutor(
+            self._input_blocks, self._build_stages()
+        ).run_refs()
 
     def union(self, other: "Dataset") -> "Dataset":
         """Concatenate block lists — no row materialization; each side's
@@ -297,27 +459,22 @@ class Dataset:
 
     # execution (streaming)
     def iter_blocks(self) -> Iterator[List[Any]]:
-        """Streaming executor: bounded in-flight block tasks (backpressure,
-        resource_manager.py semantics collapsed to a window). Blocks may be
-        host lists or ObjectRefs (shuffle outputs stay in the object store
-        until consumed — the driver only materializes a block at its own
-        consumption point, here)."""
+        """Streaming executor: the op plan compiles to a stage topology
+        (task fusion + actor-pool stages) executed as a pipeline with a
+        byte-budget admission window per stage (execution.py). Blocks may
+        be host lists or ObjectRefs (shuffle outputs stay in the object
+        store until consumed — the driver only materializes a block at
+        its own consumption point, here)."""
         if not self._ops:
             for b in self._input_blocks:
                 yield ray_tpu.get(b) if isinstance(b, ray_tpu.ObjectRef) else b
             return
-        max_in_flight = max(
-            2, int(ray_tpu.cluster_resources().get("CPU", 4))
-        )
-        blocks = list(self._input_blocks)
-        in_flight: List[Any] = []
-        i = 0
-        while i < len(blocks) or in_flight:
-            while i < len(blocks) and len(in_flight) < max_in_flight:
-                in_flight.append(_apply_chain.remote(blocks[i], self._ops))
-                i += 1
-            ready, in_flight = ray_tpu.wait(in_flight, num_returns=1)
-            yield ray_tpu.get(ready[0])
+        from .execution import StreamingExecutor
+
+        for ref in StreamingExecutor(
+            self._input_blocks, self._build_stages()
+        ).run():
+            yield ray_tpu.get(ref)
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():

@@ -55,7 +55,14 @@ class _StreamRelayActor:
     async def push(self, seq: int, items: list, closed: bool = False) -> int:
         """Returns the current queue depth. Backpressure is writer-side
         (throttle on the returned depth) — parking here would hold the
-        actor's concurrency slots and starve pop()."""
+        actor's concurrency slots and starve pop(). A writer that ignores
+        the depth contract hits the hard bound below: the push fails, the
+        stream dies, memory stays bounded."""
+        if len(self._out) > 4 * self._max and not closed:
+            raise BufferError(
+                "stream relay buffer overrun (consumer stalled and the "
+                "writer ignored backpressure)"
+            )
         self._stash[seq] = (items, closed)
         while self._next_seq in self._stash:
             its, cl = self._stash.pop(self._next_seq)
@@ -181,6 +188,8 @@ class ServeProxy:
             max_workers=32, thread_name_prefix="proxy-wait"
         )
         self._started = threading.Event()
+        self._hosts: Optional[set] = None  # lazy _local_hosts()
+        self._host_cache: dict = {}  # actor id -> is-local (sticky)
         self.port: Optional[int] = None
         self._startup_error: Optional[BaseException] = None
         self._thread = threading.Thread(
@@ -195,8 +204,11 @@ class ServeProxy:
     def _same_host_pred(self):
         """Predicate over _Replica: is its actor on this proxy's host?
         Local runtime ⇒ always; cluster runtime ⇒ compare the hosting
-        agent's address to our own interfaces. Results are cached per
-        actor id (placement is sticky for a live actor)."""
+        agent's address to our own interfaces. Locations are cached on
+        the proxy per actor id (placement is sticky for a live actor), so
+        the head RPC happens once per replica, not once per request —
+        and callers run the predicate on the worker pool, never the event
+        loop (rt.actor_location can block on a slow head)."""
         from ray_tpu.core.runtime import get_runtime
 
         try:
@@ -205,8 +217,9 @@ class ServeProxy:
             return lambda r: True
         if not getattr(rt, "is_remote", False):
             return lambda r: True
-        local = _local_hosts()
-        cache: dict = {}
+        if self._hosts is None:
+            self._hosts = _local_hosts()
+        cache = self._host_cache
 
         def pred(replica) -> bool:
             aid = getattr(replica.actor, "_actor_id", None)
@@ -215,12 +228,62 @@ class ServeProxy:
             if aid not in cache:
                 _, addr = rt.actor_location(aid)
                 host = addr.rsplit(":", 1)[0] if addr else None
-                # unknown location ⇒ NOT local: the relay path works on
-                # every topology, the shm path only works same-host
-                cache[aid] = host is not None and host in local
+                if host is None:
+                    # unknown location ⇒ NOT local (the relay path works
+                    # on every topology); don't cache — it may resolve
+                    return False
+                cache[aid] = host in self._hosts
             return cache[aid]
 
         return pred
+
+    def _start_stream(self, rs, payload):
+        """Blocking transport selection + dispatch. Runs on the worker
+        pool — never the event loop. Returns (ch, relay_actor, reader,
+        ref); on error every partially-created resource is cleaned up
+        before the exception propagates."""
+        from ray_tpu.experimental import Channel
+        from ray_tpu.serve.deployment import NoPreferredReplica
+
+        same_host = self._same_host_pred()
+        with rs.lock:
+            cands = [r for r in rs.replicas if not r.draining] or list(
+                rs.replicas
+            )
+        if any(same_host(r) for r in cands):
+            # fast path: shm ring to a same-host replica, strictly pinned
+            # (a same-host-only writer must never reach a cross-host
+            # replica); if the preferred replica drains between snapshot
+            # and dispatch, fall through to the relay
+            ch = Channel(buffer_size_bytes=1 << 18)
+            try:
+                ref = rs.submit(
+                    "stream_to",
+                    (ch.writer, payload),
+                    {},
+                    prefer=same_host,
+                    strict_prefer=True,
+                )
+                return ch, None, ch.reader, ref
+            except NoPreferredReplica:
+                ch.destroy()
+            except BaseException:
+                ch.destroy()
+                raise
+        relay_actor = ray_tpu.remote(_StreamRelayActor).options(
+            num_cpus=0.0, max_concurrency=16
+        ).remote()
+        try:
+            ref = rs.submit(
+                "stream_to", (_RelayWriter(relay_actor), payload), {}
+            )
+        except BaseException:
+            try:
+                ray_tpu.kill(relay_actor)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        return None, relay_actor, _RelayReader(relay_actor), ref
 
     # -- handlers -------------------------------------------------------
     async def _call(self, request):
@@ -253,7 +316,7 @@ class ServeProxy:
     async def _stream(self, request):
         from aiohttp import web
 
-        from ray_tpu.experimental import Channel, ChannelClosed
+        from ray_tpu.experimental import ChannelClosed
 
         name = request.match_info["deployment"]
         rs = self._apps.get(name)
@@ -276,46 +339,20 @@ class ServeProxy:
             }
         )
         await resp.prepare(request)
-        # transport selection: the shm ring Channel is same-host-only, so
-        # pin the stream_to dispatch to a same-host replica when one
-        # exists; with only cross-host replicas, bridge through a relay
-        # actor instead (ordinary actor calls work across nodes)
-        from ray_tpu.serve.deployment import NoPreferredReplica
-
-        same_host = self._same_host_pred()
-        with rs.lock:
-            cands = [r for r in rs.replicas if not r.draining] or list(
-                rs.replicas
-            )
-        has_local = any(same_host(r) for r in cands)
-        ch = relay_actor = ref = None
-        if has_local:
-            # fast path: shm ring to a same-host replica. strict: if the
-            # preferred replica drains between the snapshot and the
-            # dispatch, fall through to the relay instead of handing a
-            # same-host-only writer to a cross-host replica.
-            ch = Channel(buffer_size_bytes=1 << 18)
-            try:
-                ref = rs.submit(
-                    "stream_to",
-                    (ch.writer, payload),
-                    {},
-                    prefer=same_host,
-                    strict_prefer=True,
-                )
-                reader = ch.reader
-            except NoPreferredReplica:
-                ch.destroy()
-                ch = None
-        if ref is None:
-            relay_actor = ray_tpu.remote(_StreamRelayActor).options(
-                num_cpus=0.0, max_concurrency=16
-            ).remote()
-            writer, reader = _RelayWriter(relay_actor), _RelayReader(
-                relay_actor
-            )
-            ref = rs.submit("stream_to", (writer, payload), {})
         loop = asyncio.get_running_loop()
+        # transport selection + dispatch: shm ring when a same-host
+        # replica exists, relay actor otherwise — blocking work, so it
+        # runs on the pool; any failure becomes an SSE error event
+        try:
+            ch, relay_actor, reader, ref = await loop.run_in_executor(
+                self._pool, self._start_stream, rs, payload
+            )
+        except Exception as exc:  # noqa: BLE001 - errors are events
+            await resp.write(
+                f"event: error\ndata: {json.dumps(repr(exc))}\n\n".encode()
+            )
+            await resp.write_eof()
+            return resp
         q: asyncio.Queue = asyncio.Queue()
         _END, _ERR = object(), object()
         # bounded handoff: a stalled HTTP client must throttle the relay,
