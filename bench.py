@@ -476,18 +476,19 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
         # touch each actor once so creation cost is outside the timed region
         ray_tpu.get([a.ping.remote(0) for a in actors], timeout=60)
 
-        def one_round() -> float:
-            results = [None] * N
+        def one_round(n_threads: int = N) -> float:
+            results = [None] * n_threads
 
             def drive(idx):
-                a = actors[idx]
+                a = actors[idx % N]
                 rs = [a.ping.remote(i) for i in range(CALLS)]
                 ray_tpu.get(rs, timeout=300)
                 results[idx] = True
 
             t0 = time.perf_counter()
             threads = [
-                threading.Thread(target=drive, args=(i,)) for i in range(N)
+                threading.Thread(target=drive, args=(i,))
+                for i in range(n_threads)
             ]
             for t in threads:
                 t.start()
@@ -495,11 +496,21 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
                 t.join()
             elapsed = time.perf_counter() - t0
             assert all(results)
-            return N * CALLS / elapsed
+            return n_threads * CALLS / elapsed
 
         # short windows on a contended 1-core host are noisy: report the
         # best of three rounds (peak sustained throughput)
         async_calls_per_s = max(one_round() for _ in range(3))
+        # caller-concurrency scaling points (this host cannot add cores,
+        # so the interpretable comparison is per-core: the reference's
+        # 22,974.9/s came from a 64-vCPU host)
+        async_scaling = {
+            n: round(max(one_round(n) for _ in range(2)), 1)
+            for n in (1, 2)
+        }
+        cores = os.cpu_count() or 1
+        per_core = async_calls_per_s / cores
+        baseline_per_core = BASELINE_NN_ASYNC_CALLS_PER_S / 64.0
 
         # tier 5: Data actor-pool map_batches over many blocks — the
         # BASELINE.json config "map_batches over 50k blocks, actor-pool
@@ -553,6 +564,15 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
             "async_vs_baseline": round(
                 async_calls_per_s / BASELINE_NN_ASYNC_CALLS_PER_S, 3
             ),
+            # normalized: reference ran on 64 vCPUs, this host has `cores`
+            "async_calls_per_s_per_core": round(per_core, 1),
+            "async_per_core_vs_baseline_per_core": round(
+                per_core / baseline_per_core, 2
+            ),
+            "async_calls_per_s_by_driver_threads": {
+                **{str(k): v for k, v in async_scaling.items()},
+                "4": round(async_calls_per_s, 1),
+            },
             **dag_metrics,
         }
     finally:

@@ -72,6 +72,78 @@ class _MemStore:
         self._data.clear()
 
 
+class _ClassedAdmission:
+    """Priority admission over N transfer slots — the object-plane QoS of
+    the reference's PullManager/PushManager (pull_manager.h:40-47 GET >
+    WAIT > TASK_ARGS classes; push_manager.h:28-36 in-flight cap): a
+    waiting higher class always gets the next free slot, so a storm of
+    task-arg transfers cannot starve an interactive get.
+
+    Scope note (push side): the slot covers the store read + reply
+    construction, not the kernel's socket send that happens after the
+    handler returns — the enforced property is priority ORDERING of
+    admissions plus a bound on concurrently materialized replies, an
+    approximation of the reference's chunked in-flight cap.
+
+    ``timeout``: a bounded wait keeps a storm from parking the RPC
+    server's whole thread pool forever — on expiry the transfer errors
+    and the requester retries through its locate loop."""
+
+    PRIO = {"get": 0, "wait": 1, "task_args": 2}
+
+    def __init__(self, slots: int, timeout: Optional[float] = None):
+        self._slots = max(1, int(slots))
+        self._timeout = timeout
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self._waiting = [0, 0, 0]
+
+    def __call__(self, purpose: str):
+        return _AdmissionSlot(self, self.PRIO.get(purpose, 2))
+
+
+class _AdmissionSlot:
+    __slots__ = ("_adm", "_prio")
+
+    def __init__(self, adm: _ClassedAdmission, prio: int):
+        self._adm = adm
+        self._prio = prio
+
+    def __enter__(self):
+        adm, p = self._adm, self._prio
+        deadline = (
+            None
+            if adm._timeout is None
+            else time.monotonic() + adm._timeout
+        )
+        with adm._cv:
+            adm._waiting[p] += 1
+            try:
+                while adm._in_flight >= adm._slots or any(
+                    adm._waiting[q] for q in range(p)
+                ):
+                    if (
+                        deadline is not None
+                        and time.monotonic() >= deadline
+                    ):
+                        raise TimeoutError(
+                            "transfer admission timed out "
+                            f"(class={p}, slots={adm._slots})"
+                        )
+                    adm._cv.wait(timeout=1.0)
+            finally:
+                adm._waiting[p] -= 1
+            adm._in_flight += 1
+        return self
+
+    def __exit__(self, *exc):
+        adm = self._adm
+        with adm._cv:
+            adm._in_flight -= 1
+            adm._cv.notify_all()
+        return False
+
+
 class _WorkerHandle:
     def __init__(self, worker_id: str, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -150,9 +222,7 @@ class NodeAgent:
             "ExecuteLeaseBatch": self._h_execute_lease_batch,
             "StoreObject": self._h_store_object,
             "FetchObject": self._h_fetch_object,
-            "FetchObjectBatch": lambda r: [
-                self.store.get_bytes(oid) for oid in r["object_ids"]
-            ],
+            "FetchObjectBatch": self._h_fetch_object_batch,
             "DeleteObjects": self._h_delete_objects,
             "GetObjectForWorker": self._h_get_object_for_worker,
             "WorkerPut": self._h_worker_put,
@@ -213,8 +283,12 @@ class NodeAgent:
         # concurrent inbound transfers, and coalesce concurrent pulls of
         # ONE object into a single fetch (broadcast of a big object to N
         # workers on this node = one wire transfer, not N)
-        self._pull_sem = threading.Semaphore(
-            max(1, int(cfg.max_concurrent_pulls))
+        self._pull_adm = _ClassedAdmission(cfg.max_concurrent_pulls)
+        # outbound (serving) side: bound concurrent transfers shipped to
+        # peers/clients, same GET > WAIT > TASK_ARGS classes; bounded wait
+        # so a fetch storm can't park the RPC thread pool forever
+        self._push_adm = _ClassedAdmission(
+            cfg.max_concurrent_pushes, timeout=60.0
         )
         self._pull_waiters: Dict[str, threading.Event] = {}
 
@@ -1058,7 +1132,12 @@ class NodeAgent:
         self.store.put_bytes(req["object_id"], req["data"])
 
     def _h_fetch_object(self, req: dict) -> bytes:
-        return self.store.get_bytes(req["object_id"])
+        with self._push_adm(req.get("purpose", "get")):
+            return self.store.get_bytes(req["object_id"])
+
+    def _h_fetch_object_batch(self, req: dict) -> List[bytes]:
+        with self._push_adm(req.get("purpose", "get")):
+            return [self.store.get_bytes(oid) for oid in req["object_ids"]]
 
     def _h_delete_objects(self, req: dict) -> None:
         logger.debug(
@@ -1125,17 +1204,27 @@ class NodeAgent:
                 remaining = None
                 if deadline is not None:
                     remaining = max(0.1, deadline - time.monotonic())
-                out = self._pull_located(oid, reply["locations"], remaining)
+                out = self._pull_located(
+                    oid,
+                    reply["locations"],
+                    remaining,
+                    purpose=req.get("purpose", "task_args"),
+                )
                 if out is not None:
                     return out
         return {"status": "timeout"}
 
     def _pull_located(
-        self, oid: str, locations, wait_s: Optional[float] = None
+        self,
+        oid: str,
+        locations,
+        wait_s: Optional[float] = None,
+        purpose: str = "task_args",
     ) -> Optional[dict]:
         """Admission-controlled peer pull: concurrent requests for the same
-        object coalesce behind one leader fetch, and total in-flight
-        transfers are bounded by the pull semaphore."""
+        object coalesce behind one leader fetch, and in-flight transfers
+        are bounded class-aware (GET > WAIT > TASK_ARGS — an interactive
+        get is never queued behind a storm of task-arg prefetches)."""
         with self._lock:
             ev = self._pull_waiters.get(oid)
             leader = ev is None
@@ -1148,7 +1237,7 @@ class NodeAgent:
                 return self._local_reply(oid)
             return None  # leader failed; retry via the locate loop
         try:
-            with self._pull_sem:
+            with self._pull_adm(purpose):
                 for nid, addr in locations:
                     if nid == self.node_id:
                         if self.store.contains(oid):
@@ -1156,7 +1245,9 @@ class NodeAgent:
                         continue
                     try:
                         data = self._peer(nid, addr).call(
-                            "FetchObject", {"object_id": oid}, timeout=60.0
+                            "FetchObject",
+                            {"object_id": oid, "purpose": purpose},
+                            timeout=60.0,
                         )
                     except (RpcError, KeyError):
                         continue

@@ -17,11 +17,14 @@ import pickle
 import sys
 import threading
 import time
+import logging
 import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
+
+logger = logging.getLogger("ray_tpu.cluster.client")
 
 from ray_tpu.core.object_store import GetTimeoutError, ObjectRef
 from ray_tpu.core.runtime import TaskSpec
@@ -403,7 +406,7 @@ class _PipelinedSender:
             while not delivered:
                 try:
                     attempts += 1
-                    if attempts > 1:
+                    if attempts == 2 or attempts % 60 == 0:
                         log.warning(
                             "ClientBatch re-send #%d (%d items)",
                             attempts,
@@ -422,13 +425,18 @@ class _PipelinedSender:
                     # forever and a dropped release leaks the object —
                     # keep the batch and retry until the head comes back
                     # (or this runtime shuts down)
+                    import sys
+
+                    if sys.is_finalizing():
+                        return  # interpreter exit: nobody to deliver for
                     with self._cv:
                         if self._stop:
                             return
-                    log.warning(
-                        "head unreachable; retrying %d control items",
-                        len(batch),
-                    )
+                    if attempts <= 2 or attempts % 60 == 0:
+                        log.warning(
+                            "head unreachable; retrying %d control items",
+                            len(batch),
+                        )
                     time.sleep(0.5)
             with self._cv:
                 self._acked += len(batch)
@@ -484,6 +492,12 @@ class RemoteRuntime:
         self._direct_results_cap = cfg.direct_results_cap
         self._direct_pending: Dict[str, str] = {}  # hex -> actor_id
         self._direct_arg_pins: Dict[str, List[str]] = {}  # hex -> arg ids
+        # owner-held results (cfg.direct_deferred_seals): hex -> contained
+        # ids; the head learns about these objects only on share/evict
+        self._deferred_seals: Dict[str, List[str]] = {}
+        # refs shared into another submission BEFORE their direct result
+        # arrived: the arrival handler uploads these instead of deferring
+        self._shared_pending: set = set()
         self._direct_cv = threading.Condition()
         self._callback_server: Optional[RpcServer] = None
         # dedicated channel for the pipeline: its traffic during a head
@@ -527,6 +541,7 @@ class RemoteRuntime:
         deps += [
             v.hex for v in spec.kwargs.values() if isinstance(v, ObjectRef)
         ]
+        self._flush_deferred_seals(arg_ids)
         lease = LeaseRequest(
             task_id=spec.task_id,
             name=spec.name,
@@ -554,7 +569,13 @@ class RemoteRuntime:
         ref = ObjectRef.new(owner=actor_id)
         with collect_serialized() as arg_ids:
             payload = cloudpickle.dumps((method, args, kwargs))
-        self._flusher.note_registered([ref.hex])
+        if arg_ids:
+            self._flush_deferred_seals(arg_ids)
+        if not self._direct_enabled:
+            # lease path registers the return holder head-side at
+            # submission; direct-path registration happens at RESULT time
+            # (a deferred-seal result never touches the head at all)
+            self._flusher.note_registered([ref.hex])
         if self._direct_enabled:
             from ray_tpu.core.refcount import TRACKER
 
@@ -640,11 +661,35 @@ class RemoteRuntime:
         from ray_tpu.core.refcount import TRACKER
 
         unpin: List[str] = []
+        uploads: List[tuple] = []  # evicted owner-held objects → head
+        register: List[str] = []  # head-sealed results: holder is on books
         with self._direct_cv:
             for r in results:
                 h = r["ref"]
+                if "deferred_seal" not in r:
+                    # the worker sealed this one to the head (error, big
+                    # value, ref-containing result, or deferred seals
+                    # off): the seal registered us as holder, so a local
+                    # release is owed — and any share-while-pending flag
+                    # is moot (the head knows the object)
+                    register.append(h)
+                    self._shared_pending.discard(h)
                 if r["status"] == "ok":
                     self._direct_results[h] = ("val", r["value"])
+                    if "deferred_seal" in r:
+                        contained = list(r["deferred_seal"] or ())
+                        if h in self._shared_pending:
+                            # the ref was already shared into another
+                            # submission while the call ran: a consumer
+                            # somewhere is dep-waiting on the head —
+                            # upload now, don't defer
+                            self._shared_pending.discard(h)
+                            uploads.append((h, r["value"], contained))
+                        else:
+                            # ownership model: we (the caller) hold the
+                            # only record of this object; the head learns
+                            # about it on share or eviction
+                            self._deferred_seals[h] = contained
                 elif r["status"] == "error":
                     self._direct_results[h] = ("err", r["error"])
                 else:
@@ -655,11 +700,21 @@ class RemoteRuntime:
                 while self._direct_results_order:
                     head = self._direct_results_order[0]
                     if head not in self._direct_results:
+                        self._deferred_seals.pop(head, None)
                         self._direct_results_order.popleft()
                     elif len(self._direct_results) > self._direct_results_cap:
-                        self._direct_results.pop(
-                            self._direct_results_order.popleft(), None
-                        )
+                        ev = self._direct_results_order.popleft()
+                        entry = self._direct_results.pop(ev, None)
+                        contained = self._deferred_seals.pop(ev, None)
+                        if (
+                            contained is not None
+                            and entry is not None
+                            and entry[0] == "val"
+                            and TRACKER.count(ev) > 0
+                        ):
+                            # evicting an owner-held object someone still
+                            # references: persist it to the head first
+                            uploads.append((ev, entry[1], contained))
                     else:
                         break
                 # a live never-consumed entry at the front blocks the lazy
@@ -677,10 +732,67 @@ class RemoteRuntime:
                         chan.on_result(h)
                 unpin.extend(self._direct_arg_pins.pop(h, ()))
             self._direct_cv.notify_all()
+        if register:
+            self._flusher.note_registered_live(register)
+        for ev, data, contained in uploads:
+            self._upload_owned(ev, data, contained)
         # release the per-call arg pins (the worker's borrow registrations
         # are on the books before its result reaches us)
         for h in unpin:
             TRACKER.decref(h)
+
+    def _upload_owned(self, h: str, data: bytes, contained: List[str]) -> bool:
+        """Persist an owner-held direct-call result into the head's object
+        table (holder = this client) — called when the ref is shared into
+        another submission or evicted from the local cache while still
+        referenced. After this the normal head-directory lifecycle owns
+        the object. Returns False (and logs) if the head stayed
+        unreachable through the retry budget — the caller must keep its
+        record so a later share can try again."""
+        try:
+            self.head.call(
+                "PutObject",
+                {
+                    "object_id": h,
+                    "data": data,
+                    "holder": self.client_id,
+                    "contained_ids": sorted(contained),
+                },
+                retries=8,
+                retry_interval=0.25,
+            )
+            self._flusher.note_registered_live([h])
+            return True
+        except Exception:  # noqa: BLE001 - head gone; value stays local
+            logger.warning("owner-held object upload failed", exc_info=True)
+            return False
+
+    def _flush_deferred_seals(self, ids) -> None:
+        """Before a submission whose payload references owner-held objects
+        leaves this process, upload those objects so any other node can
+        resolve them through the head directory."""
+        if not self._deferred_seals and not self._direct_pending:
+            return
+        todo = []
+        with self._direct_cv:
+            for h in ids:
+                contained = self._deferred_seals.pop(h, None)
+                if contained is None:
+                    if h in self._direct_pending:
+                        # result not here yet: flag so the arrival
+                        # handler uploads instead of deferring (the
+                        # consumer will dep-wait on the head directory)
+                        self._shared_pending.add(h)
+                    continue
+                entry = self._direct_results.get(h)
+                if entry is not None and entry[0] == "val":
+                    todo.append((h, entry[1], contained))
+        for h, data, contained in todo:
+            if not self._upload_owned(h, data, contained):
+                # keep the record: the dependent submission will dep-wait,
+                # and the next share (or eviction) retries the upload
+                with self._direct_cv:
+                    self._deferred_seals.setdefault(h, contained)
 
     def _fallback_submit(self, item: dict) -> None:
         """Route a direct-call item through the head-scheduled path (actor
@@ -689,6 +801,7 @@ class RemoteRuntime:
 
         with self._direct_cv:
             self._direct_pending.pop(item["ref"], None)
+            self._shared_pending.discard(item["ref"])
             unpin = self._direct_arg_pins.pop(item["ref"], ())
             self._direct_cv.notify_all()
         self._submit_actor_lease(
@@ -699,6 +812,10 @@ class RemoteRuntime:
             return_id=item["ref"],
             arg_ids=item["arg_ids"],
         )
+        # the lease registers us as the return's holder head-side — the
+        # local release is owed from now on (zero-safe: the caller may
+        # have dropped the ref already)
+        self._flusher.note_registered_live([item["ref"]])
         # the lease (queued before this release can flush) pins the args
         # head-side for the task's lifetime
         for h in unpin:
@@ -770,7 +887,11 @@ class RemoteRuntime:
         if kind == "val":
             value = self._loads_tracking(payload)
             with self._direct_cv:
-                self._direct_results.pop(h, None)
+                if h not in self._deferred_seals:
+                    # owner-held entries stay cached (we are the only
+                    # record of the object until share/evict uploads it);
+                    # head-sealed entries drop — later gets use the head
+                    self._direct_results.pop(h, None)
             return True, value
         # sealed to the actor's node store: fetch from that agent directly
         seal = payload
@@ -779,7 +900,7 @@ class RemoteRuntime:
         if client is not None:
             try:
                 data = client.call(
-                    "FetchObject", {"object_id": h}, timeout=120.0
+                    "FetchObject", {"object_id": h, "purpose": "get"}, timeout=120.0
                 )
                 value = self._loads_tracking(data)
                 with self._direct_cv:
@@ -812,6 +933,7 @@ class RemoteRuntime:
         actor_id = new_id()
         with collect_serialized() as arg_ids:
             payload = cloudpickle.dumps((cls, args, kwargs))
+        self._flush_deferred_seals(arg_ids)
         lease = LeaseRequest(
             task_id=new_id(),
             name=f"{cls.__name__}.__init__",
@@ -887,6 +1009,7 @@ class RemoteRuntime:
         ref = ObjectRef.new(owner="driver")
         with collect_serialized() as contained:
             data = cloudpickle.dumps(value)
+        self._flush_deferred_seals(contained)
         self.head.call(
             "PutObject",
             {
@@ -926,6 +1049,15 @@ class RemoteRuntime:
                 if resolved:
                     return value
         while True:
+            # a deferred (owner-held) result can land locally while we're
+            # polling a head that will never hear of the object
+            if self._direct_enabled:
+                with self._direct_cv:
+                    entry = self._direct_results.get(h)
+                if entry is not None:
+                    resolved, value = self._consume_direct(h, entry)
+                    if resolved:
+                        return value
             poll = 2.0
             if deadline is not None:
                 poll = min(poll, max(0.0, deadline - time.monotonic()))
@@ -943,7 +1075,9 @@ class RemoteRuntime:
                 for nid, addr in reply["locations"]:
                     try:
                         data = self._agent(nid, addr).call(
-                            "FetchObject", {"object_id": ref.hex}, timeout=120.0
+                            "FetchObject",
+                            {"object_id": ref.hex, "purpose": "get"},
+                            timeout=120.0,
                         )
                         return self._loads_tracking(data)
                     except (RpcError, KeyError):
@@ -977,6 +1111,21 @@ class RemoteRuntime:
             unresolved = list(dict.fromkeys(h for h in order if h not in results))
             if not unresolved:
                 break
+            if self._direct_enabled:
+                # late-arriving owner-held results resolve locally; the
+                # head may never hear of those objects
+                for h in unresolved:
+                    entry = self._direct_results.get(h)
+                    if entry is not None:
+                        try:
+                            ok, value = self._consume_direct(h, entry)
+                            if ok:
+                                results[h] = ("val", value)
+                        except BaseException as exc:  # noqa: BLE001
+                            results[h] = ("err", exc)
+                unresolved = [h for h in unresolved if h not in results]
+                if not unresolved:
+                    break
             poll = 2.0
             if deadline is not None:
                 poll = min(poll, max(0.0, deadline - time.monotonic()))
@@ -999,7 +1148,9 @@ class RemoteRuntime:
             for (nid, addr), hs in located.items():
                 try:
                     datas = self._agent(nid, addr).call(
-                        "FetchObjectBatch", {"object_ids": hs}, timeout=120.0
+                        "FetchObjectBatch",
+                        {"object_ids": hs, "purpose": "get"},
+                        timeout=120.0,
                     )
                     for h, d in zip(hs, datas):
                         results[h] = ("val", self._loads_tracking(d))

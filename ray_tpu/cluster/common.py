@@ -6,6 +6,7 @@ node_manager.proto) — dataclasses shipped over the generic gRPC layer.
 """
 from __future__ import annotations
 
+import os
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -23,7 +24,7 @@ HEALTH_TIMEOUT_S = cfg.health_timeout_s
 
 
 def new_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return os.urandom(8).hex()  # cheaper than uuid4 on the submit path
 
 
 @dataclass

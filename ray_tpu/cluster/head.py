@@ -822,7 +822,12 @@ class HeadServer:
         e = self._entry(object_id)
         holder = req.get("holder")
         with self._lock:
-            if holder:
+            # owner registration is once-only: an owner-held direct result
+            # uploaded here may race a worker's fallback seal (push timed
+            # out but actually delivered) — counting the owner twice would
+            # leak the object forever
+            if holder and not e.owner_registered:
+                e.owner_registered = True
                 self._add_holder(object_id, holder)
             for inner in req.get("contained_ids", ()):
                 if inner not in e.contained:

@@ -54,6 +54,23 @@ async def _invoke_maybe_async(instance, method: str, args, kwargs, sems):
         return out
 
 
+def _flush_nested_deferred(ids) -> None:
+    """A result carrying refs to objects OWNED by this process's nested
+    client runtime (direct-call returns it received and never shared) must
+    upload them to the head before the result leaves — the consumer may be
+    on any node and resolves contained refs through the directory."""
+    if not ids:
+        return
+    from ray_tpu.core import runtime as core_runtime
+
+    flush = getattr(core_runtime._runtime, "_flush_deferred_seals", None)
+    if flush is not None:
+        try:
+            flush(ids)
+        except Exception:  # noqa: BLE001 - best-effort
+            logger.warning("nested deferred-seal flush failed", exc_info=True)
+
+
 class Worker:
     def __init__(self, agent_address: str, worker_id: str, store_path: str):
         self.worker_id = worker_id
@@ -173,7 +190,12 @@ class Worker:
 
         return loads_tracking(self._flusher, data)
 
-    def get_object(self, hex_id: str, timeout: Optional[float] = None) -> Any:
+    def get_object(
+        self,
+        hex_id: str,
+        timeout: Optional[float] = None,
+        purpose: str = "task_args",
+    ) -> Any:
         if self.store is not None:
             try:
                 return self._loads_tracking(self.store.get_bytes(hex_id))
@@ -181,7 +203,7 @@ class Worker:
                 pass
         reply = self.agent.call(
             "GetObjectForWorker",
-            {"object_id": hex_id, "timeout": timeout},
+            {"object_id": hex_id, "timeout": timeout, "purpose": purpose},
             timeout=None,
         )
         status = reply["status"]
@@ -208,6 +230,7 @@ class Worker:
         with collect_serialized() as contained:
             data = cloudpickle.dumps(value)
         contained_ids = sorted(contained)
+        _flush_nested_deferred(contained_ids)
         if len(data) <= INLINE_OBJECT_MAX:
             return SealInfo(
                 object_id=object_id,
@@ -650,9 +673,10 @@ class Worker:
                     )
                 except BaseException as exc:  # noqa: BLE001
                     result, seal = self._build_direct_error(item, exc)
-                with self._direct_seal_cv:
-                    self._direct_seals.append(seal)
-                    self._direct_seal_cv.notify()
+                if seal is not None:  # deferred: caller owns bookkeeping
+                    with self._direct_seal_cv:
+                        self._direct_seals.append(seal)
+                        self._direct_seal_cv.notify()
                 accepts[i] = {"done": result}
             else:
                 # still running: results go via the pushed DirectResults
@@ -840,6 +864,7 @@ class Worker:
         with collect_serialized() as contained:
             data = cloudpickle.dumps(value)
         contained_ids = sorted(contained)
+        _flush_nested_deferred(contained_ids)
         if len(data) <= INLINE_OBJECT_MAX:
             seal = SealInfo(
                 object_id=oid,
@@ -850,6 +875,20 @@ class Worker:
                 owner=owner,
             )
             result = {"ref": oid, "status": "ok", "value": data}
+            from ray_tpu.config import cfg as _cfg
+
+            if _cfg.direct_deferred_seals and not contained_ids:
+                # ownership model: the caller (owner) keeps value + seal;
+                # the head learns about this object only if the ref is
+                # shared or evicted (reference: small direct-call returns
+                # never touch the GCS). The sender loop re-materializes
+                # this seal worker-side if the result push fails.
+                # Results CONTAINING refs keep the seal path — the seal is
+                # what pins the inner objects head-side, and no caller-side
+                # registration could close that race window.
+                result["deferred_seal"] = contained_ids
+                result["owner"] = owner
+                seal = None
             if "_t_accept" in item:
                 result["_t_accept"] = item["_t_accept"]
                 result["_t_emit"] = time.perf_counter()
@@ -907,6 +946,8 @@ class Worker:
         with self._direct_out_cv:
             self._direct_out.setdefault(client_addr, []).append(result)
             self._direct_out_cv.notify()
+        if seal is None:  # deferred: caller owns the bookkeeping
+            return
         with self._direct_seal_cv:
             self._direct_seals.append(seal)
             self._direct_seal_cv.notify()
@@ -929,8 +970,27 @@ class Worker:
                 try:
                     client.call("DirectResults", results, timeout=30.0)
                 except RpcError:
-                    # caller is gone; the head-side seals still record the
-                    # outcomes for any other holder
+                    # caller is gone. Results with deferred seals were
+                    # counting on the caller for head bookkeeping — seal
+                    # them worker-side now so any other holder can still
+                    # resolve through the directory.
+                    fallback = [
+                        SealInfo(
+                            object_id=r["ref"],
+                            node_id=self.node_id,
+                            size=len(r["value"]),
+                            inline_value=r["value"],
+                            contained_ids=list(r["deferred_seal"] or ()),
+                            owner=r.get("owner"),
+                        )
+                        for r in results
+                        if r.get("status") == "ok"
+                        and "deferred_seal" in r
+                    ]
+                    if fallback:
+                        with self._direct_seal_cv:
+                            self._direct_seals.extend(fallback)
+                            self._direct_seal_cv.notify()
                     logger.warning(
                         "direct caller %s unreachable; dropping %d results",
                         addr,
@@ -1147,6 +1207,21 @@ def main() -> None:
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
     worker = Worker(args.agent, args.worker_id, args.store)
+    prof_dir = os.environ.get("RAY_TPU_PROFILE_WORKER")
+    if prof_dir:
+        # perf diagnosis: dump per-worker cProfile stats on SIGUSR2
+        import cProfile
+        import signal as _sig
+
+        _pr = cProfile.Profile()
+        _pr.enable()
+
+        def _dump(_sig_no, _frm):
+            _pr.dump_stats(
+                os.path.join(prof_dir, f"worker-{args.worker_id}.prof")
+            )
+
+        _sig.signal(_sig.SIGUSR2, _dump)
     worker.serve_forever()
 
 

@@ -187,6 +187,12 @@ define(
 )
 define("refcount_debug", False, "Record per-ref count history (diagnostics).")
 define(
+    "max_concurrent_pushes",
+    4,
+    "Outbound object-transfer slots per agent (push_manager.h in-flight "
+    "cap analog); requests are admitted GET > WAIT > TASK_ARGS.",
+)
+define(
     "max_concurrent_pulls",
     4,
     "Bound on concurrent inbound peer object transfers per node "
@@ -229,6 +235,17 @@ define(
     "Driver-side FIFO bound on cached direct-call results.",
 )
 define("direct_trace", False, "Stamp direct-call results with timing marks.")
+define(
+    "direct_deferred_seals",
+    True,
+    "Owner-based object bookkeeping for direct actor calls (the "
+    "reference's ownership model): a small result delivered to its "
+    "caller does NOT seal to the head — the caller holds value + seal "
+    "and uploads to the head only when the ref is shared into another "
+    "submission or evicted from the local cache. Cuts the per-call "
+    "worker->agent->head seal chain off the hot path; a failed result "
+    "push falls back to worker-side sealing.",
+)
 
 # ---------------------------------------------------------------------------
 # compiled DAG

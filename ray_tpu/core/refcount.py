@@ -255,6 +255,23 @@ class RefFlusher:
         with self._held_lock:
             self._held_at_head.update(hex_ids)
 
+    def note_registered_live(self, hex_ids) -> None:
+        """Like note_registered, but safe for registrations that land
+        AFTER submission (e.g. at direct-call result time): an id whose
+        local count already hit zero — the caller dropped the ref before
+        the head-side registration existed, so its zero event was drained
+        unregistered — immediately owes the head a release."""
+        fire = False
+        with self._held_lock:
+            for h in hex_ids:
+                if TRACKER.count(h) == 0:
+                    self._owed.add(h)
+                    fire = True
+                else:
+                    self._held_at_head.add(h)
+        if fire:
+            TRACKER.zero_event.set()
+
     def is_registered(self, hex_id: str) -> bool:
         with self._held_lock:
             return hex_id in self._held_at_head

@@ -181,3 +181,80 @@ def test_direct_path_survives_chaos(tmp_path):
     )
     assert out.returncode == 0, out.stderr[-3000:]
     assert "DIRECT_CHAOS_OK" in out.stdout
+
+
+_QOS_SCRIPT = """
+import os, threading, time
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.runtime import set_runtime
+
+c = Cluster()
+c.add_node({"CPU": 4.0}, num_workers=1)   # node A: holds the big objects
+c.add_node({"CPU": 4.0}, num_workers=2)   # node B: runs the arg-storm tasks
+client = c.client()
+set_runtime(client)
+try:
+    infos = ray_tpu.nodes()
+    node_b = sorted(n["NodeID"] for n in infos)[1]
+    # 12 MiB objects, stored via node A's agent (head forwards big puts)
+    big = [ray_tpu.put(np.zeros(12 << 20, np.uint8)) for _ in range(7)]
+    probe = ray_tpu.put(np.ones(12 << 20, np.uint8))
+
+    @ray_tpu.remote(num_cpus=1.0)
+    def consume(x):
+        return int(x[0])
+
+    # storm: task-arg pulls of 6 distinct big objects into node B
+    from ray_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+    tasks = [
+        consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_b)
+        ).remote(r)
+        for r in big[:6]
+    ]
+    time.sleep(0.3)  # let the storm hit the serving agent's slots
+    t0 = time.perf_counter()
+    val = ray_tpu.get(probe, timeout=60)  # interactive GET, same server
+    get_s = time.perf_counter() - t0
+    assert val[0] == 1
+    storm_t0 = time.perf_counter()
+    assert ray_tpu.get(tasks, timeout=180) == [0] * 6
+    storm_rest = time.perf_counter() - storm_t0
+    print(f"QOS get_s={get_s:.2f} storm_rest={storm_rest:.2f}")
+    # the GET must not queue behind the whole storm: it waits at most the
+    # transfer in flight, never the full backlog
+    assert get_s < 10.0, f"interactive get starved: {get_s:.1f}s"
+    print("QOS_OK")
+finally:
+    set_runtime(None)
+    client.shutdown()
+    c.shutdown()
+"""
+
+
+def test_interactive_get_preempts_task_arg_storm(tmp_path):
+    """Object-plane QoS (pull_manager.h:40-47 / push_manager.h:28-36
+    analog): with ONE outbound transfer slot on the serving agent and a
+    storm of task-arg pulls queued, an interactive driver get is admitted
+    ahead of the task-arg class instead of queueing behind the backlog."""
+    script = tmp_path / "qos.py"
+    script.write_text(_QOS_SCRIPT)
+    env = dict(os.environ)
+    env["RAY_TPU_MAX_CONCURRENT_PUSHES"] = "1"
+    env["RAY_TPU_MAX_CONCURRENT_PULLS"] = "2"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=400,
+        env=env,
+    )
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
+    assert "QOS_OK" in out.stdout

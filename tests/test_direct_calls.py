@@ -133,3 +133,46 @@ def test_direct_fallback_on_actor_death(client):
     time.sleep(0.5)
     with pytest.raises(Exception):
         ray_tpu.get(d.f.remote(2), timeout=30)
+
+
+def test_deferred_seal_share_after_consume(client):
+    """Owner-held direct results (cfg.direct_deferred_seals): the head
+    never hears about a small result until its ref is shared — then the
+    owner uploads it and any node can resolve it."""
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class P:
+        def make(self, v):
+            return {"v": v}
+
+    @ray_tpu.remote
+    def consume(x):
+        return x["v"] + 1
+
+    p = P.remote()
+    ref = p.make.remote(10)
+    # consume locally first (entry must stay cached for the later share)
+    assert ray_tpu.get(ref, timeout=60) == {"v": 10}
+    assert ray_tpu.get(ref, timeout=60) == {"v": 10}  # repeat get works
+    # now share into a scheduled task: triggers the owner upload
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 11
+
+
+def test_deferred_seal_nested_in_put(client):
+    """A put() whose value CONTAINS an owner-held ref uploads that object
+    first, so a task receiving the outer ref can resolve the inner one."""
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class P:
+        def make(self, v):
+            return v * 3
+
+    @ray_tpu.remote
+    def consume(box):
+        return ray_tpu.get(box["inner"]) + 1
+
+    p = P.remote()
+    inner = p.make.remote(5)
+    ray_tpu.get(inner, timeout=60)
+    outer = ray_tpu.put({"inner": inner})
+    assert ray_tpu.get(consume.remote(outer), timeout=60) == 16
