@@ -1,3 +1,8 @@
 """ray_tpu.autoscaler — demand-driven cluster scaling on the binpack kernels."""
 from .autoscaler import Autoscaler, NodeTypeConfig, SimNodeProvider  # noqa: F401
-from .providers import InstanceManager, LocalNodeProvider  # noqa: F401
+from .providers import (  # noqa: F401
+    CloudAPIError,
+    InstanceManager,
+    LocalNodeProvider,
+    MockCloudProvider,
+)

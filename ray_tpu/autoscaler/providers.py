@@ -47,10 +47,12 @@ class LocalNodeProvider:
             self._head = RpcClient(self.head_address)
         return self._head
 
-    def create_node(self, node_type: NodeTypeConfig) -> str:
+    def create_node(
+        self, node_type: NodeTypeConfig, node_id: Optional[str] = None
+    ) -> str:
         from ray_tpu.cluster.common import new_id
 
-        node_id = new_id()
+        node_id = node_id or new_id()
         resources = dict(node_type.resources)
         resources.setdefault("memory", float(4 << 30))
         resources.setdefault("object_store_memory", float(1 << 30))
@@ -121,6 +123,105 @@ class LocalNodeProvider:
         if self._head is not None:
             self._head.close()
             self._head = None
+
+
+class CloudAPIError(RuntimeError):
+    """Transient cloud-API rejection (rate limit, capacity)."""
+
+
+class MockCloudProvider:
+    """Flaky asynchronous cloud in front of LocalNodeProvider — the
+    test double the reference shapes with batching_node_provider.py: a
+    provider whose API is eventually-consistent and unreliable, driving
+    REAL agent subprocesses underneath so the reconciler is proven
+    against genuine registration/membership dynamics.
+
+    - ``create_node`` returns a cloud-assigned node id immediately; the
+      instance materializes later on a background thread after a random
+      provisioning delay — or, with ``create_failure_rate`` probability,
+      NEVER (request accepted, instance silently lost: the classic cloud
+      failure the v2 reconciler's launch timeout + retry exists for).
+    - ``terminate_node`` is also async (delayed on a background thread).
+    - a token-bucket rate limit rejects API bursts with CloudAPIError.
+    """
+
+    def __init__(
+        self,
+        head_address: str,
+        *,
+        num_workers: int = 1,
+        create_delay_s: tuple = (0.2, 1.5),
+        create_failure_rate: float = 0.2,
+        terminate_delay_s: float = 0.5,
+        max_requests_per_s: float = 20.0,
+        seed: int = 0,
+    ):
+        import random
+
+        self._local = LocalNodeProvider(head_address, num_workers)
+        self._rng = random.Random(seed)
+        self._delay = create_delay_s
+        self._fail = create_failure_rate
+        self._term_delay = terminate_delay_s
+        self._rate = max_requests_per_s
+        self._tokens = max_requests_per_s
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+        self.created = 0
+        self.lost = 0
+
+    def _take_token(self) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self._rate, self._tokens + (now - self._t_last) * self._rate
+            )
+            self._t_last = now
+            if self._tokens < 1.0:
+                raise CloudAPIError("RequestLimitExceeded")
+            self._tokens -= 1.0
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        from ray_tpu.cluster.common import new_id
+
+        self._take_token()
+        node_id = new_id()  # cloud id exists before the instance does
+        with self._lock:
+            self.created += 1
+            fail = self._rng.random() < self._fail
+            delay = self._rng.uniform(*self._delay)
+            if fail:
+                self.lost += 1
+
+        def materialize():
+            time.sleep(delay)
+            if fail:
+                return  # silently lost launch
+            try:
+                self._local.create_node(node_type, node_id=node_id)
+            except Exception:  # noqa: BLE001 - treat as lost
+                pass
+
+        threading.Thread(target=materialize, daemon=True).start()
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self._take_token()
+
+        def do_terminate():
+            time.sleep(self._term_delay)
+            try:
+                self._local.terminate_node(node_id)
+            except Exception:  # noqa: BLE001 - already gone
+                pass
+
+        threading.Thread(target=do_terminate, daemon=True).start()
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return self._local.non_terminated_nodes()
+
+    def shutdown(self) -> None:
+        self._local.shutdown()
 
 
 @dataclass
@@ -197,7 +298,7 @@ class InstanceManager:
                         inst.state = "TERMINATED"
                         if inst.retries < self.max_retries:
                             relaunch.append(inst)
-                        else:
+                        elif inst.node_id is not None:
                             # retries exhausted: still reap the straggling
                             # process or it registers later as an untracked
                             # node (relaunch reaps its own below)
@@ -223,7 +324,14 @@ class InstanceManager:
                     self.provider.terminate_node(inst.node_id)
                 except Exception:  # noqa: BLE001 - already gone
                     pass
-            node_id = self.provider.create_node(cfg)
+            try:
+                node_id = self.provider.create_node(cfg)
+            except Exception:  # noqa: BLE001 - API rejection (rate limit)
+                # cloud-API failure: record a REQUESTED launch with no
+                # node so a later tick retries — but still burn a retry,
+                # or a PERSISTENTLY failing API (bad credentials) would
+                # relaunch forever and report phantom pending capacity
+                node_id = None
             with self._lock:
                 self._counter += 1
                 iid = f"inst-{self._counter}"

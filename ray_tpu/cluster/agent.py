@@ -21,7 +21,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.scheduler import ResourceRequest, ResourceVocab
 from ray_tpu.scheduler.instances import NodeAcceleratorState
@@ -151,6 +151,8 @@ class _WorkerHandle:
         self.client: Optional[RpcClient] = None
         self.ready = threading.Event()
         self.actor_id: Optional[str] = None  # pinned for an actor
+        self.pip_key: Optional[str] = None  # bound to a pip runtime env
+        self.idle_since: float = 0.0  # env workers: reap when idle long
         self.lock = threading.Lock()  # serializes pushes (actor ordering)
         # task_id -> dispatch time of in-flight plain tasks (OOM victim
         # selection: the memory monitor kills the NEWEST task first)
@@ -323,6 +325,25 @@ class NodeAgent:
         threading.Thread(
             target=self._task_drain_loop, name="agent-task-drain", daemon=True
         ).start()
+        # pip runtime environments (reference runtime_env pip/uv builders):
+        # dedicated workers per env key, reaped after idle timeout
+        from .pip_env import PipEnvManager
+
+        # per-agent base dir: GC liveness is tracked by THIS agent's
+        # refcounts, so the directory must not be shared with other
+        # agents on the host (each simulated node is its own "machine")
+        self._pip_mgr = PipEnvManager(
+            os.path.join(
+                os.environ.get("RAY_TPU_PIP_ENV_BASE", "")
+                or os.path.join(tempfile.gettempdir(), "ray_tpu_pip_envs"),
+                self.node_id,
+            )
+        )
+        self._pip_idle: Dict[str, List[str]] = {}
+        threading.Thread(
+            target=self._pip_gc_loop, name="agent-pipgc", daemon=True
+        ).start()
+
         # dependency-waiting leases (see _dep_loop)
         self._dep_waiting: Dict[str, tuple] = {}  # task_id -> (spec, missing)
         self._dep_cv = threading.Condition()
@@ -348,11 +369,17 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # worker pool
     # ------------------------------------------------------------------
-    def _spawn_worker(self) -> _WorkerHandle:
+    def _spawn_worker(
+        self, pip_env: Optional[Tuple[str, str]] = None
+    ) -> _WorkerHandle:
         worker_id = new_id()
         env = dict(os.environ)
         env["RAY_TPU_HEAD_ADDRESS"] = self.head_address
         env["RAY_TPU_NODE_ID"] = self.node_id
+        if pip_env is not None:
+            # pip runtime env: the worker prepends this dir to sys.path at
+            # startup, shadowing base site-packages (pip_env.py)
+            env["RAY_TPU_PIP_ENV_DIR"] = pip_env[1]
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -368,6 +395,8 @@ class NodeAgent:
             env=env,
         )
         handle = _WorkerHandle(worker_id, proc)
+        if pip_env is not None:
+            handle.pip_key = pip_env[0]
         with self._lock:
             self._workers[worker_id] = handle
         return handle
@@ -379,7 +408,13 @@ class NodeAgent:
                 return {"ok": False}
             handle.client = RpcClient(req["address"])
             handle.ready.set()
-            self._idle.append(handle.worker_id)
+            if handle.pip_key is not None:
+                handle.idle_since = time.monotonic()
+                self._pip_idle.setdefault(handle.pip_key, []).append(
+                    handle.worker_id
+                )
+            else:
+                self._idle.append(handle.worker_id)
             self._idle_cv.notify_all()
         return {"ok": True, "node_id": self.node_id}
 
@@ -396,16 +431,31 @@ class NodeAgent:
     def _return_worker(self, handle: _WorkerHandle) -> None:
         with self._idle_cv:
             if handle.actor_id is None and handle.worker_id in self._workers:
-                self._idle.append(handle.worker_id)
+                if handle.pip_key is not None:
+                    handle.idle_since = time.monotonic()
+                    self._pip_idle.setdefault(handle.pip_key, []).append(
+                        handle.worker_id
+                    )
+                else:
+                    self._idle.append(handle.worker_id)
                 self._idle_cv.notify_all()
 
     def _on_worker_death(self, handle: _WorkerHandle, running: List[LeaseRequest]) -> None:
         """A worker process died (socket/process detection in worker_pool.cc)."""
         running = list(running)
         with self._idle_cv:
-            self._workers.pop(handle.worker_id, None)
+            # death can be observed concurrently (failed RPC + health
+            # sweep): the pop result marks the FIRST observer, which alone
+            # releases once-only state like the pip env refcount
+            first = self._workers.pop(handle.worker_id, None) is not None
             if handle.worker_id in self._idle:
                 self._idle.remove(handle.worker_id)
+            if handle.pip_key is not None:
+                lst = self._pip_idle.get(handle.pip_key)
+                if lst and handle.worker_id in lst:
+                    lst.remove(handle.worker_id)
+                if first:
+                    self._pip_mgr.release(handle.pip_key)
             # async methods awaiting a TaskDone from this worker die with it
             for tid in [
                 t for t, (_, h) in self._async_pending.items() if h is handle
@@ -508,7 +558,12 @@ class NodeAgent:
             self._release(scalar_alloc)
             return {"status": "reject", "available": self.ledger.avail_map()}
         alloc = scalar_alloc + (assign,)
-        if spec.kind == "actor_creation":
+        if (spec.runtime_env or {}).get("pip"):
+            # pip runtime env: needs a worker bound to the built env dir
+            # (dedicated interpreter path); dispatched individually — env
+            # builds can take seconds and must not stall the batch drainer
+            self._exec_pool.submit(self._dispatch_pip_task, spec, alloc)
+        elif spec.kind == "actor_creation":
             # pins its worker for life — dispatched individually
             self._exec_pool.submit(self._dispatch_to_worker, spec, alloc)
         else:
@@ -616,9 +671,11 @@ class NodeAgent:
                         return
                     try:
                         data = self._peer(nid, addr).call(
-                            "FetchObject", {"object_id": oid}, timeout=60.0
+                            "FetchObject",
+                            {"object_id": oid, "purpose": "task_args"},
+                            timeout=60.0,
                         )
-                    except (RpcError, KeyError):
+                    except (RpcError, KeyError, TimeoutError):
                         continue
                     try:
                         self.store.put_bytes(oid, data)
@@ -664,6 +721,11 @@ class NodeAgent:
         if assign is None:
             self._release(scalar_alloc)
             self._spillback(spec, "chips busy after dep wait")
+            return
+        if (spec.runtime_env or {}).get("pip"):
+            self._exec_pool.submit(
+                self._dispatch_pip_task, spec, scalar_alloc + (assign,)
+            )
             return
         with self._task_cv:
             self._task_buf.append((spec, scalar_alloc + (assign,)))
@@ -893,6 +955,122 @@ class NodeAgent:
             if free < self._num_workers:
                 self._spawn_worker()
         self._run_on_worker(spec, handle, alloc)
+
+    def _dispatch_pip_task(self, spec: LeaseRequest, alloc) -> None:
+        """Route a lease carrying a pip runtime env to a worker bound to
+        that env (building it first if needed). Mirrors the reference's
+        agent-side env creation before worker startup
+        (_private/runtime_env/agent/main.py shape)."""
+        # dispatch guard ref taken BEFORE ensure: the GC sweep must never
+        # delete the env between its build and its worker's spawn
+        guard_key = self._pip_mgr.key_of(spec.runtime_env["pip"])
+        self._pip_mgr.acquire(guard_key)
+        try:
+            key, env_dir = self._pip_mgr.ensure(spec.runtime_env["pip"])
+        except Exception as exc:  # noqa: BLE001 - build failure is final
+            self._pip_mgr.release(guard_key)
+            self._release(alloc)
+            self._report_to_head(
+                {
+                    "node_id": self.node_id,
+                    "failed": [
+                        {
+                            "task_id": spec.task_id,
+                            "reason": f"runtime_env build failed: {exc}",
+                            "retryable": False,
+                        }
+                    ],
+                }
+            )
+            return
+        try:
+            handle = self._pop_pip_worker(key, env_dir)
+            if handle is None:
+                self._release(alloc)
+                self._report_to_head(
+                    {
+                        "node_id": self.node_id,
+                        "failed": [
+                            {
+                                "task_id": spec.task_id,
+                                "reason": "pip env worker unavailable",
+                                "retryable": True,
+                            }
+                        ],
+                    }
+                )
+                return
+        finally:
+            # the worker (if obtained) holds its own env ref now
+            self._pip_mgr.release(guard_key)
+        if spec.kind == "actor_creation":
+            with self._lock:
+                handle.actor_id = spec.actor_id
+                self._actor_workers[spec.actor_id] = handle.worker_id
+                self._actor_meta[spec.actor_id] = dict(spec.actor_meta or {})
+        self._run_on_worker(spec, handle, alloc)
+
+    def _pop_pip_worker(
+        self, key: str, env_dir: str, timeout: float = 120.0
+    ) -> Optional[_WorkerHandle]:
+        """Idle env-bound worker, or spawn one (jax import makes worker
+        startup seconds-scale; the deadline covers it)."""
+        deadline = time.monotonic() + timeout
+        with self._idle_cv:
+            lst = self._pip_idle.get(key)
+            if lst:
+                return self._workers[lst.pop()]
+        # the worker's env ref lives exactly as long as its handle: taken
+        # here, released once by _on_worker_death / the GC reaper (a
+        # straggler that registers after our deadline keeps its ref until
+        # the health loop or reaper collects it)
+        self._pip_mgr.acquire(key)
+        self._spawn_worker(pip_env=(key, env_dir))
+        with self._idle_cv:
+            while True:
+                lst = self._pip_idle.get(key)
+                if lst:
+                    return self._workers[lst.pop()]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._shutdown:
+                    return None
+                self._idle_cv.wait(timeout=min(remaining, 0.5))
+
+    def _pip_gc_loop(self) -> None:
+        """Reap env workers idle past the threshold and GC unreferenced
+        env dirs (the reference's runtime-env GC on idle)."""
+        from ray_tpu.config import cfg
+
+        while not self._shutdown:
+            time.sleep(min(10.0, max(1.0, cfg.runtime_env_idle_gc_s / 3)))
+            now = time.monotonic()
+            victims: List[_WorkerHandle] = []
+            with self._idle_cv:
+                for key, lst in list(self._pip_idle.items()):
+                    keep = []
+                    for wid in lst:
+                        h = self._workers.get(wid)
+                        if h is None:
+                            continue
+                        if now - h.idle_since > cfg.runtime_env_idle_gc_s:
+                            victims.append(h)
+                        else:
+                            keep.append(wid)
+                    if keep:
+                        self._pip_idle[key] = keep
+                    else:
+                        self._pip_idle.pop(key, None)
+            for h in victims:
+                with self._idle_cv:
+                    first = self._workers.pop(h.worker_id, None) is not None
+                if first:  # may race a concurrent death observation
+                    self._pip_mgr.release(h.pip_key)
+                try:
+                    h.proc.terminate()
+                except OSError:
+                    pass
+            if victims:
+                self._pip_mgr.gc()
 
     def _push_req(self, spec: LeaseRequest, accel_env=None) -> dict:
         return {
@@ -1132,11 +1310,11 @@ class NodeAgent:
         self.store.put_bytes(req["object_id"], req["data"])
 
     def _h_fetch_object(self, req: dict) -> bytes:
-        with self._push_adm(req.get("purpose", "get")):
+        with self._push_adm(req.get("purpose", "task_args")):
             return self.store.get_bytes(req["object_id"])
 
     def _h_fetch_object_batch(self, req: dict) -> List[bytes]:
-        with self._push_adm(req.get("purpose", "get")):
+        with self._push_adm(req.get("purpose", "task_args")):
             return [self.store.get_bytes(oid) for oid in req["object_ids"]]
 
     def _h_delete_objects(self, req: dict) -> None:
@@ -1249,7 +1427,10 @@ class NodeAgent:
                             {"object_id": oid, "purpose": purpose},
                             timeout=60.0,
                         )
-                    except (RpcError, KeyError):
+                    except (RpcError, KeyError, TimeoutError):
+                        # KeyError: peer dropped it; TimeoutError: its
+                        # push admission saturated — try the next copy,
+                        # then the locate loop
                         continue
                     try:
                         self.store.put_bytes(oid, data)

@@ -552,7 +552,11 @@ class RemoteRuntime:
             max_retries=spec.max_retries,
             retry_exceptions=spec.retry_exceptions,
             strategy=spec.strategy,
-            runtime_env=self.runtime_env,
+            runtime_env=(
+                {**(self.runtime_env or {}), **spec.runtime_env}
+                if spec.runtime_env
+                else self.runtime_env
+            ),
             arg_ids=sorted(arg_ids),
             deps=deps,
             client_id=self.client_id,
@@ -735,7 +739,14 @@ class RemoteRuntime:
         if register:
             self._flusher.note_registered_live(register)
         for ev, data, contained in uploads:
-            self._upload_owned(ev, data, contained)
+            if not self._upload_owned(ev, data, contained):
+                # we are the ONLY copy: losing the record would strand the
+                # ref forever — re-cache (over cap; a later sweep retries)
+                with self._direct_cv:
+                    if ev not in self._direct_results:
+                        self._direct_results[ev] = ("val", data)
+                        self._direct_results_order.append(ev)
+                    self._deferred_seals.setdefault(ev, contained)
         # release the per-call arg pins (the worker's borrow registrations
         # are on the books before its result reaches us)
         for h in unpin:
@@ -906,7 +917,7 @@ class RemoteRuntime:
                 with self._direct_cv:
                     self._direct_results.pop(h, None)
                 return True, value
-            except (RpcError, KeyError):
+            except (RpcError, KeyError, TimeoutError):
                 pass
         return False, None  # fall back to the head-located fetch
 
@@ -1080,7 +1091,7 @@ class RemoteRuntime:
                             timeout=120.0,
                         )
                         return self._loads_tracking(data)
-                    except (RpcError, KeyError):
+                    except (RpcError, KeyError, TimeoutError):
                         continue
             if deadline is not None and time.monotonic() >= deadline:
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
@@ -1154,7 +1165,7 @@ class RemoteRuntime:
                     )
                     for h, d in zip(hs, datas):
                         results[h] = ("val", self._loads_tracking(d))
-                except (RpcError, KeyError):
+                except (RpcError, KeyError, TimeoutError):
                     # stale location/partial store: per-ref fallback path
                     for h in hs:
                         try:

@@ -1,0 +1,167 @@
+"""Per-requirements pip runtime environments, agent-side.
+
+Capability analog of the reference's pip/uv runtime-env builders
+(/root/reference/python/ray/_private/runtime_env/pip.py, uv.py: cache
+keyed by a hash of the resolved config, concurrent builds deduplicated,
+idle environments garbage-collected).
+
+Redesigned for this runtime: instead of full virtualenvs (venv +
+ensurepip cost per env), an environment is a ``pip install --target``
+directory keyed by the hash of its normalized requirements + install
+args + interpreter version. A worker serving the env runs with the
+directory prepended to ``sys.path``, shadowing base site-packages — so
+two workers on one node can hold conflicting versions of the same
+package concurrently, which is the isolation property the builders
+exist for. Builds are serialized per key with a file lock; the winner
+writes a completion marker, losers wait on it.
+
+No-network images: callers pass explicit install args (e.g.
+``--no-index --find-links /wheels``); nothing here reaches for an index
+by itself beyond what pip is told.
+"""
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def normalize_pip(pip) -> Tuple[List[str], List[str]]:
+    """Accepts the reference's shapes: a list of requirement strings, or
+    {"packages": [...], "pip_install_args"/"install_args": [...]}."""
+    if pip is None:
+        return [], []
+    if isinstance(pip, (list, tuple)):
+        return sorted(str(p) for p in pip), []
+    if isinstance(pip, dict):
+        pkgs = sorted(str(p) for p in pip.get("packages", ()))
+        args = list(
+            pip.get("pip_install_args") or pip.get("install_args") or ()
+        )
+        return pkgs, args
+    raise TypeError(f"runtime_env['pip'] must be list or dict, got {pip!r}")
+
+
+class PipEnvManager:
+    """Hash-keyed --target environments with refcounts and LRU GC."""
+
+    BUILD_TIMEOUT_S = 600.0
+
+    def __init__(self, base_dir: str, max_cached: int = 8):
+        self.base_dir = base_dir
+        self.max_cached = max_cached
+        os.makedirs(base_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._refs: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def key_of(self, pip) -> str:
+        pkgs, args = normalize_pip(pip)
+        blob = "\n".join(
+            pkgs + ["--"] + args + [sys.version.split()[0]]
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def env_dir(self, key: str) -> str:
+        return os.path.join(self.base_dir, key)
+
+    def ensure(self, pip) -> Tuple[str, str]:
+        """Return (key, env_dir), building the environment if it doesn't
+        exist yet. Concurrent callers for one key serialize on a file
+        lock; only the winner runs pip."""
+        pkgs, args = normalize_pip(pip)
+        key = self.key_of(pip)
+        env_dir = self.env_dir(key)
+        marker = env_dir + ".built"
+        if os.path.exists(marker):
+            return key, env_dir
+        lock_path = env_dir + ".lock"
+        with open(lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(marker):  # built while we waited
+                    return key, env_dir
+                tmp = env_dir + ".tmp"
+                shutil.rmtree(tmp, ignore_errors=True)
+                cmd = [
+                    sys.executable,
+                    "-m",
+                    "pip",
+                    "install",
+                    "--target",
+                    tmp,
+                    "--disable-pip-version-check",
+                    "--no-input",
+                    *args,
+                    *pkgs,
+                ]
+                proc = subprocess.run(
+                    cmd,
+                    capture_output=True,
+                    text=True,
+                    timeout=self.BUILD_TIMEOUT_S,
+                    env={**os.environ, "PIP_NO_COLOR": "1"},
+                )
+                if proc.returncode != 0:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeError(
+                        f"pip env build failed (key {key}): "
+                        + (proc.stderr or proc.stdout)[-1500:]
+                    )
+                shutil.rmtree(env_dir, ignore_errors=True)
+                os.replace(tmp, env_dir)
+                with open(marker, "w") as mf:
+                    mf.write(" ".join(pkgs))
+                return key, env_dir
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+
+    # ------------------------------------------------------------------
+    def acquire(self, key: str) -> None:
+        with self._lock:
+            self._refs[key] = self._refs.get(key, 0) + 1
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            c = self._refs.get(key, 0) - 1
+            if c <= 0:
+                self._refs.pop(key, None)
+            else:
+                self._refs[key] = c
+
+    def gc(self) -> int:
+        """Remove unreferenced environments beyond max_cached, oldest
+        first (the reference GCs per-env on last-actor-exit; a small LRU
+        cache keeps warm envs for repeat jobs). Returns removed count."""
+        with self._lock:
+            live = set(self._refs)
+        envs = []
+        try:
+            for name in os.listdir(self.base_dir):
+                p = os.path.join(self.base_dir, name)
+                if os.path.isdir(p) and not name.endswith(".tmp"):
+                    envs.append((os.path.getmtime(p), name))
+        except OSError:
+            return 0
+        envs.sort()
+        removed = 0
+        excess = len(envs) - self.max_cached
+        for _, name in envs:
+            if excess <= removed or name in live:
+                continue
+            shutil.rmtree(
+                os.path.join(self.base_dir, name), ignore_errors=True
+            )
+            for suffix in (".built", ".lock"):
+                try:
+                    os.unlink(os.path.join(self.base_dir, name + suffix))
+                except OSError:
+                    pass
+            removed += 1
+        return removed

@@ -1192,6 +1192,11 @@ def main() -> None:
     # their backend during ANY jax call regardless of the env var, and a
     # wedged transport then hangs the worker's first user jax call forever.
     # config.update pins the platform set before any backend comes up.
+    pip_dir = os.environ.get("RAY_TPU_PIP_ENV_DIR")
+    if pip_dir:
+        # pip runtime env: the agent built this --target dir for the env
+        # this worker serves; it shadows base site-packages (pip_env.py)
+        sys.path.insert(0, pip_dir)
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         try:

@@ -187,6 +187,12 @@ define(
 )
 define("refcount_debug", False, "Record per-ref count history (diagnostics).")
 define(
+    "runtime_env_idle_gc_s",
+    300.0,
+    "Reap pip runtime-env workers idle longer than this and GC "
+    "unreferenced env directories.",
+)
+define(
     "max_concurrent_pushes",
     4,
     "Outbound object-transfer slots per agent (push_manager.h in-flight "

@@ -317,6 +317,7 @@ class RemoteFunction:
             strategy=opts.get("scheduling_strategy"),
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=opts.get("runtime_env"),
         )
         rt.submit(spec)
         return refs[0] if num_returns == 1 else refs

@@ -81,6 +81,9 @@ class TaskSpec:
     max_retries: int = 3
     retry_exceptions: bool = False
     attempt: int = 0
+    # per-task runtime env override (merged over the job-level env by the
+    # submitting client); {"pip": ...} entries route to env-bound workers
+    runtime_env: Optional[dict] = None
     # return object ids; a slot is None once that output has been freed
     return_ids: List[Optional[str]] = field(default_factory=list)
 
@@ -373,6 +376,12 @@ class Runtime:
     # submission (NormalTaskSubmitter analog)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        if (spec.runtime_env or {}).get("pip"):
+            raise NotImplementedError(
+                "pip runtime environments need per-env worker processes — "
+                "run against a cluster (ray_tpu.init(address=...) or "
+                "Cluster()); the in-process runtime shares one interpreter"
+            )
         refs = spec.returns
         spec.return_ids = [r.hex for r in refs]
         # the queued/lineage spec keeps only ids: the user's handles are the

@@ -68,3 +68,63 @@ def test_elastic_scale_up_and_down(tmp_path):
         client.shutdown()
         provider.shutdown()
         c.shutdown()
+
+
+def test_reconciler_converges_on_flaky_cloud():
+    """v2 InstanceManager vs an unreliable, eventually-consistent cloud
+    (batching_node_provider shape): 25% of creates are silently lost,
+    provisioning is async (0.2-1.5s), terminations are delayed, and the
+    API rate-limits bursts — the reconciler must still converge to the
+    requested capacity with real agent subprocesses registering."""
+    from ray_tpu.autoscaler import MockCloudProvider
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()  # head only
+    client = c.client()
+    set_runtime(client)
+    cloud = MockCloudProvider(
+        c.address,
+        num_workers=1,
+        create_failure_rate=0.25,
+        create_delay_s=(0.2, 1.5),
+        terminate_delay_s=0.5,
+        seed=42,
+    )
+    im = InstanceManager(cloud, launch_timeout_s=6.0, max_retries=4)
+    cfg = NodeTypeConfig("cpu2", {"CPU": 2.0}, min_workers=0, max_workers=6)
+    try:
+        for _ in range(3):
+            im.create_node(cfg)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            im.reconcile()
+            if im.summary().get("RUNNING", 0) >= 3:
+                break
+            time.sleep(0.5)
+        summary = im.summary()
+        assert summary.get("RUNNING", 0) >= 3, (summary, cloud.lost)
+        # the cluster really has >= 3 alive agents (not just records)
+        alive = cloud.non_terminated_nodes()
+        assert len(alive) >= 3
+        # the run must have actually exercised the flaky path
+        assert cloud.created >= 3
+
+        # delayed termination: reconcile flips RUNNING -> TERMINATED once
+        # membership catches up
+        victim = alive[0]["NodeID"]
+        im.terminate_node(victim)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            im.reconcile()
+            ids = {n["NodeID"] for n in cloud.non_terminated_nodes()}
+            if victim not in ids:
+                break
+            time.sleep(0.5)
+        assert victim not in {
+            n["NodeID"] for n in cloud.non_terminated_nodes()
+        }
+    finally:
+        set_runtime(None)
+        client.shutdown()
+        im.shutdown()
+        c.shutdown()
