@@ -985,24 +985,27 @@ class NodeAgent:
             return
         try:
             handle = self._pop_pip_worker(key, env_dir)
-            if handle is None:
-                self._release(alloc)
-                self._report_to_head(
-                    {
-                        "node_id": self.node_id,
-                        "failed": [
-                            {
-                                "task_id": spec.task_id,
-                                "reason": "pip env worker unavailable",
-                                "retryable": True,
-                            }
-                        ],
-                    }
-                )
-                return
+        except Exception:  # noqa: BLE001 - spawn failure (fork pressure)
+            logger.exception("pip env worker spawn failed")
+            handle = None
         finally:
             # the worker (if obtained) holds its own env ref now
             self._pip_mgr.release(guard_key)
+        if handle is None:
+            self._release(alloc)
+            self._report_to_head(
+                {
+                    "node_id": self.node_id,
+                    "failed": [
+                        {
+                            "task_id": spec.task_id,
+                            "reason": "pip env worker unavailable",
+                            "retryable": True,
+                        }
+                    ],
+                }
+            )
+            return
         if spec.kind == "actor_creation":
             with self._lock:
                 handle.actor_id = spec.actor_id
@@ -1025,7 +1028,11 @@ class NodeAgent:
         # straggler that registers after our deadline keeps its ref until
         # the health loop or reaper collects it)
         self._pip_mgr.acquire(key)
-        self._spawn_worker(pip_env=(key, env_dir))
+        try:
+            self._spawn_worker(pip_env=(key, env_dir))
+        except BaseException:
+            self._pip_mgr.release(key)
+            raise
         with self._idle_cv:
             while True:
                 lst = self._pip_idle.get(key)
@@ -1084,6 +1091,7 @@ class NodeAgent:
             "runtime_env": spec.runtime_env,
             "actor_meta": spec.actor_meta,
             "accel_env": accel_env,
+            "trace": spec.trace,
             "retry_exceptions": (
                 spec.retry_exceptions and spec.attempt < spec.max_retries
             ),

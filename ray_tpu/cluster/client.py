@@ -542,6 +542,9 @@ class RemoteRuntime:
             v.hex for v in spec.kwargs.values() if isinstance(v, ObjectRef)
         ]
         self._flush_deferred_seals(arg_ids)
+        from ray_tpu.util import tracing
+
+        trace = spec.trace or tracing.child_context(spec.task_id)
         lease = LeaseRequest(
             task_id=spec.task_id,
             name=spec.name,
@@ -560,6 +563,7 @@ class RemoteRuntime:
             arg_ids=sorted(arg_ids),
             deps=deps,
             client_id=self.client_id,
+            trace=trace,
         )
         self._sender.enqueue("lease", lease)
         self._flusher.note_registered(lease.return_ids)
@@ -583,15 +587,19 @@ class RemoteRuntime:
         if self._direct_enabled:
             from ray_tpu.core.refcount import TRACKER
 
+            from ray_tpu.util import tracing
+
             ids = sorted(arg_ids)
+            tid = new_id()
             item = {
-                "task_id": new_id(),
+                "task_id": tid,
                 "actor_id": actor_id,
                 "ref": ref.hex,
                 "payload": payload,
                 "client_id": self.client_id,
                 "name": f"{actor_id[:8]}.{method}",
                 "arg_ids": ids,
+                "trace": tracing.child_context(tid),
             }
             # pin every arg (incl. refs nested in containers) until the
             # result lands: the worker registers its borrows synchronously
@@ -936,6 +944,7 @@ class RemoteRuntime:
         max_concurrency: Optional[int] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
         scheduling_strategy: Any = None,
+        runtime_env: Optional[dict] = None,
         **_ignored,
     ) -> RemoteActorHandle:
         from ray_tpu.core.refcount import collect_serialized
@@ -955,7 +964,11 @@ class RemoteRuntime:
             actor_id=actor_id,
             max_retries=0,
             strategy=scheduling_strategy,
-            runtime_env=self.runtime_env,
+            runtime_env=(
+                {**(self.runtime_env or {}), **runtime_env}
+                if runtime_env
+                else self.runtime_env
+            ),
             arg_ids=sorted(arg_ids),
             client_id=self.client_id,
         )

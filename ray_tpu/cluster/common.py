@@ -75,6 +75,9 @@ class LeaseRequest:
     deps: List[str] = field(default_factory=list)
     # submitting process's holder id: the initial owner of the return ids
     client_id: str = ""
+    # distributed trace context (util/tracing.py); rides the wire so every
+    # hop's lifecycle events share one trace id
+    trace: Optional[dict] = None
 
     def __getstate__(self):
         # head-side scheduling memos (e.g. _req_cache) never ride the wire

@@ -53,6 +53,12 @@ from .rpc import RpcClient, RpcError, RpcServer
 
 logger = logging.getLogger("ray_tpu.cluster.head")
 
+
+def _trace_args(spec) -> dict:
+    from ray_tpu.util.tracing import event_args
+
+    return event_args(getattr(spec, "trace", None))
+
 from ray_tpu.config import cfg
 
 SCHED_TICK_S = cfg.sched_tick_s
@@ -743,7 +749,9 @@ class HeadServer:
                 self.metrics["leases_finished"] += 1
                 spec = self._leases.get(lid)
                 if spec is not None:
-                    self.events.record(lid, spec.name, "FINISHED")
+                    self.events.record(
+                        lid, spec.name, "FINISHED", **_trace_args(spec)
+                    )
                 # a restartable actor's ctor args stay pinned for the actor's
                 # lifetime (lineage for restarts); released when it dies
                 if spec is None or spec.kind != "actor_creation":
@@ -1137,7 +1145,9 @@ class HeadServer:
             self.metrics["leases_submitted"] += 1
             self._pending.append(spec)
             self._cond.notify_all()
-        self.events.record(spec.task_id, spec.name, "SUBMITTED")
+        self.events.record(
+            spec.task_id, spec.name, "SUBMITTED", **_trace_args(spec)
+        )
         return {"queued": True}
 
     def _h_client_batch(self, items: List[tuple]) -> None:
@@ -1373,7 +1383,9 @@ class HeadServer:
         rejected = []
         for s, status in zip(specs, reply["statuses"]):
             if status == "granted":
-                self.events.record(s.task_id, s.name, "RUNNING", node_id)
+                self.events.record(
+                    s.task_id, s.name, "RUNNING", node_id, **_trace_args(s)
+                )
             else:
                 rejected.append(s)
         if rejected:
@@ -1662,7 +1674,9 @@ class HeadServer:
             return
         for s, status in zip(specs, reply["statuses"]):
             if status == "granted":
-                self.events.record(s.task_id, s.name, "RUNNING", node_id)
+                self.events.record(
+                    s.task_id, s.name, "RUNNING", node_id, **_trace_args(s)
+                )
             else:
                 # actor gone on that agent: fail/requeue via the normal path
                 with self._cond:
@@ -1680,7 +1694,10 @@ class HeadServer:
             self._retry_or_fail(spec, f"agent {node_id} unreachable")
             return
         if reply.get("status") == "granted":
-            self.events.record(spec.task_id, spec.name, "RUNNING", node_id)
+            self.events.record(
+                spec.task_id, spec.name, "RUNNING", node_id,
+                **_trace_args(spec)
+            )
         if reply.get("status") == "reject":
             # stale view: grant-or-reject → spill back to the queue
             with self._cond:

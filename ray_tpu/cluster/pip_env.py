@@ -79,8 +79,9 @@ class PipEnvManager:
         key = self.key_of(pip)
         env_dir = self.env_dir(key)
         marker = env_dir + ".built"
-        if os.path.exists(marker):
-            return key, env_dir
+        with self._lock:  # serialized vs gc(): marker+dir vanish atomically
+            if os.path.exists(marker):
+                return key, env_dir
         lock_path = env_dir + ".lock"
         with open(lock_path, "w") as lf:
             fcntl.flock(lf, fcntl.LOCK_EX)
@@ -138,30 +139,36 @@ class PipEnvManager:
     def gc(self) -> int:
         """Remove unreferenced environments beyond max_cached, oldest
         first (the reference GCs per-env on last-actor-exit; a small LRU
-        cache keeps warm envs for repeat jobs). Returns removed count."""
+        cache keeps warm envs for repeat jobs). Returns removed count.
+
+        Runs entirely under the refcount lock so an acquire() racing the
+        sweep either lands before the liveness read (env survives) or
+        blocks until the sweep finishes (env gone, the next ensure()
+        rebuilds — the .built marker is removed FIRST, so a partially
+        failed removal reads as not-built rather than present)."""
         with self._lock:
             live = set(self._refs)
-        envs = []
-        try:
-            for name in os.listdir(self.base_dir):
-                p = os.path.join(self.base_dir, name)
-                if os.path.isdir(p) and not name.endswith(".tmp"):
-                    envs.append((os.path.getmtime(p), name))
-        except OSError:
-            return 0
-        envs.sort()
-        removed = 0
-        excess = len(envs) - self.max_cached
-        for _, name in envs:
-            if excess <= removed or name in live:
-                continue
-            shutil.rmtree(
-                os.path.join(self.base_dir, name), ignore_errors=True
-            )
-            for suffix in (".built", ".lock"):
-                try:
-                    os.unlink(os.path.join(self.base_dir, name + suffix))
-                except OSError:
-                    pass
-            removed += 1
-        return removed
+            envs = []
+            try:
+                for name in os.listdir(self.base_dir):
+                    p = os.path.join(self.base_dir, name)
+                    if os.path.isdir(p) and not name.endswith(".tmp"):
+                        envs.append((os.path.getmtime(p), name))
+            except OSError:
+                return 0
+            envs.sort()
+            removed = 0
+            excess = len(envs) - self.max_cached
+            for _, name in envs:
+                if excess <= removed or name in live:
+                    continue
+                for suffix in (".built", ".lock"):
+                    try:
+                        os.unlink(os.path.join(self.base_dir, name + suffix))
+                    except OSError:
+                        pass
+                shutil.rmtree(
+                    os.path.join(self.base_dir, name), ignore_errors=True
+                )
+                removed += 1
+            return removed

@@ -34,11 +34,15 @@ from .rpc import RpcClient, RpcError, RpcServer
 logger = logging.getLogger("ray_tpu.cluster.worker")
 
 
-async def _invoke_maybe_async(instance, method: str, args, kwargs, sems):
+async def _invoke_maybe_async(instance, method: str, args, kwargs, sems,
+                              trace=None):
     """Run one actor method on the actor's event loop; awaits coroutine
     methods, runs sync methods inline (briefly blocking the loop — the
     reference's asyncio-actor semantics for def methods). ``sems`` maps
-    concurrency-group name -> asyncio.Semaphore bounding in-flight starts."""
+    concurrency-group name -> asyncio.Semaphore bounding in-flight starts.
+    ``trace`` is installed around the call so nested submissions from the
+    method inherit the caller's trace id (the coroutine runs in its own
+    contextvars context, so per-task installation is race-free)."""
     import inspect
 
     fn = getattr(instance, method)
@@ -46,12 +50,23 @@ async def _invoke_maybe_async(instance, method: str, args, kwargs, sems):
     group = opts.get("concurrency_group", "_default")
     sem = sems.get(group) or sems["_default"]
     async with sem:
-        out = fn(*args, **kwargs)
-        from ray_tpu.core.object_store import should_await
+        token = None
+        if trace is not None:
+            from ray_tpu.util import tracing
 
-        if should_await(out):
-            out = await out
-        return out
+            token = tracing.install(trace)
+        try:
+            out = fn(*args, **kwargs)
+            from ray_tpu.core.object_store import should_await
+
+            if should_await(out):
+                out = await out
+            return out
+        finally:
+            if token is not None:
+                from ray_tpu.util import tracing
+
+                tracing.uninstall(token)
 
 
 def _flush_nested_deferred(ids) -> None:
@@ -101,6 +116,7 @@ class Worker:
                 logger.warning("worker could not open shm store %s", store_path)
         self._actors: Dict[str, Any] = {}
         self._actor_loops: Dict[str, Any] = {}  # actor_id -> (loop, sems)
+        self._trace_tokens = threading.local()  # per-thread trace token
         # runtime-env gate: tasks sharing ONE env signature run
         # concurrently (refcounted application); a DIFFERENT env waits for
         # the current one to drain. Env-less tasks skip the gate entirely
@@ -405,7 +421,10 @@ class Worker:
 
                     loop, sems = entry
                     fut = asyncio.run_coroutine_threadsafe(
-                        _invoke_maybe_async(instance, method, args, kwargs, sems),
+                        _invoke_maybe_async(
+                            instance, method, args, kwargs, sems,
+                            trace=req.get("trace"),
+                        ),
                         loop,
                     )
                     fut.add_done_callback(
@@ -579,22 +598,34 @@ class Worker:
     def _set_context(self, req: dict) -> None:
         try:
             from ray_tpu.core.runtime import get_context
+            from ray_tpu.util import tracing
 
             ctx = get_context()
             ctx.node_id = self.node_id
             ctx.task_id = req["task_id"]
             ctx.actor_id = req.get("actor_id")
+            # install the received trace context so nested submissions
+            # from this task inherit the SAME trace id with this task as
+            # their parent span (tracing_helper.py propagation). The token
+            # is thread-local: batched pushes run _h_push_task on
+            # concurrent pool threads, each with its own context.
+            self._trace_tokens.token = tracing.install(req.get("trace"))
         except Exception:  # noqa: BLE001
             pass
 
     def _clear_context(self) -> None:
         try:
             from ray_tpu.core.runtime import get_context
+            from ray_tpu.util import tracing
 
             ctx = get_context()
             ctx.node_id = None
             ctx.task_id = None
             ctx.actor_id = None
+            token = getattr(self._trace_tokens, "token", None)
+            if token is not None:
+                self._trace_tokens.token = None
+                tracing.uninstall(token)
         except Exception:  # noqa: BLE001
             pass
 
@@ -705,7 +736,10 @@ class Worker:
         if not has_refs:
             import concurrent.futures as cf
 
-            coro = _invoke_maybe_async(instance, method, args, kwargs, sems)
+            coro = _invoke_maybe_async(
+                instance, method, args, kwargs, sems,
+                trace=item.get("trace"),
+            )
             return coro, cf.Future()
 
         # arg fetches can block: resolve off the event loop AND off the
@@ -717,7 +751,10 @@ class Worker:
                 self._direct_finish_claimed_error(item, exc)
                 return
             fut = asyncio.run_coroutine_threadsafe(
-                _invoke_maybe_async(instance, method, rargs, rkwargs, sems),
+                _invoke_maybe_async(
+                    instance, method, rargs, rkwargs, sems,
+                    trace=item.get("trace"),
+                ),
                 loop,
             )
             fut.add_done_callback(
@@ -829,8 +866,14 @@ class Worker:
                 instance = self._actors[actor_id]
                 method, args, kwargs = cloudpickle.loads(item["payload"])
                 args, kwargs = self._resolve(args, kwargs)
-                with lock:
-                    out = getattr(instance, method)(*args, **kwargs)
+                from ray_tpu.util import tracing
+
+                token = tracing.install(item.get("trace"))
+                try:
+                    with lock:
+                        out = getattr(instance, method)(*args, **kwargs)
+                finally:
+                    tracing.uninstall(token)
                 fut.set_result(out)
             except BaseException as exc:  # noqa: BLE001
                 fut.set_exception(exc)

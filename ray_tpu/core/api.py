@@ -363,6 +363,7 @@ class ActorClass:
                 max_concurrency=opts.get("max_concurrency"),
                 concurrency_groups=opts.get("concurrency_groups"),
                 scheduling_strategy=opts.get("scheduling_strategy"),
+                runtime_env=opts.get("runtime_env"),
             )
         return actor_mod.create_actor(
             rt,

@@ -61,6 +61,12 @@ class TaskEventBuffer:
                 open_running[e.task_id] = e
             elif e.state in ("FINISHED", "FAILED") and e.task_id in open_running:
                 start = open_running.pop(e.task_id)
+                trace_args = {
+                    k: v
+                    for src in (start.extra, e.extra)
+                    for k, v in src.items()
+                    if k in ("trace_id", "parent_id")
+                }
                 spans.append(
                     {
                         "name": e.name,
@@ -70,7 +76,11 @@ class TaskEventBuffer:
                         "dur": (e.timestamp - start.timestamp) * 1e6,
                         "pid": start.node_id or "cluster",
                         "tid": e.extra.get("worker", 0),
-                        "args": {"state": e.state, "task_id": e.task_id},
+                        "args": {
+                            "state": e.state,
+                            "task_id": e.task_id,
+                            **trace_args,
+                        },
                     }
                 )
             elif e.state in ("SUBMITTED", "SCHEDULED"):

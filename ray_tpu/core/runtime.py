@@ -84,6 +84,9 @@ class TaskSpec:
     # per-task runtime env override (merged over the job-level env by the
     # submitting client); {"pip": ...} entries route to env-bound workers
     runtime_env: Optional[dict] = None
+    # distributed trace context {trace_id, span_id, parent_id} — minted at
+    # submission, inherited by nested submissions (util/tracing.py)
+    trace: Optional[dict] = None
     # return object ids; a slot is None once that output has been freed
     return_ids: List[Optional[str]] = field(default_factory=list)
 
@@ -391,7 +394,14 @@ class Runtime:
             self.store.create(ref, creating_task=spec.task_id)
             self._lineage[ref.hex] = spec
         self.metrics["tasks_submitted"] += 1
-        self.events.record(spec.task_id, spec.name, "SUBMITTED")
+        from ray_tpu.util import tracing
+
+        if spec.trace is None:
+            spec.trace = tracing.child_context(spec.task_id)
+        self.events.record(
+            spec.task_id, spec.name, "SUBMITTED",
+            **tracing.event_args(spec.trace)
+        )
         self._enqueue(spec)
         return refs
 
@@ -758,7 +768,13 @@ class Runtime:
         }
         actor_holds_resources = False
         assign_held = False
-        self.events.record(spec.task_id, spec.name, "RUNNING", node.node_id)
+        from ray_tpu.util import tracing
+
+        self.events.record(
+            spec.task_id, spec.name, "RUNNING", node.node_id,
+            **tracing.event_args(spec.trace)
+        )
+        _trace_token = tracing.install(spec.trace)
         try:
             args, kwargs = self._resolve_args(spec.args, spec.kwargs)
             result = spec.func(*args, **kwargs)
@@ -778,7 +794,10 @@ class Runtime:
             else:
                 self._seal_results(spec, node, result)
             self.metrics["tasks_finished"] += 1
-            self.events.record(spec.task_id, spec.name, "FINISHED", node.node_id)
+            self.events.record(
+                spec.task_id, spec.name, "FINISHED", node.node_id,
+                **tracing.event_args(spec.trace)
+            )
         except BaseException as exc:  # noqa: BLE001 - task errors are values
             if spec.retry_exceptions and spec.attempt < spec.max_retries:
                 spec.attempt += 1
@@ -801,6 +820,7 @@ class Runtime:
                     "task %s failed:\n%s", spec.name, traceback.format_exc()
                 )
         finally:
+            tracing.uninstall(_trace_token)
             node.running_tasks.pop(spec.task_id, None)
             if not node.alive or actor_holds_resources:
                 pass  # dropped with the node / held for the actor lifetime

@@ -5,7 +5,14 @@ Analog of Ray Tune (/root/reference/python/ray/tune/): a Tuner runs N trials
 space, and drives trial schedulers (ASHA successive halving, PBT
 exploit/explore) off the metrics stream reported by tune.report().
 """
-from .search import choice, grid_search, loguniform, randint, uniform  # noqa: F401
+from .search import (  # noqa: F401
+    TPESearcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
 from .tuner import (  # noqa: F401
     ASHAScheduler,
     MedianStoppingRule,

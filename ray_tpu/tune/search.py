@@ -75,3 +75,126 @@ def expand_param_space(
                 cfg[k] = v.sample(rng) if isinstance(v, Domain) else v
             configs.append(cfg)
     return configs
+
+
+# ---------------------------------------------------------------------------
+# Model-based search: a native Tree-structured Parzen Estimator
+# ---------------------------------------------------------------------------
+
+
+class TPESearcher:
+    """Native model-based searcher over the Domain types — the in-spirit
+    equivalent of the reference's optuna/hyperopt integrations
+    (python/ray/tune/search/optuna/, hyperopt/) without the external
+    dependency.
+
+    Design (TPE family, tuned for small trial budgets): completed trials
+    are ranked and the best ``gamma`` fraction forms the "good" set; each
+    suggestion samples from a Parzen (kernel-density) model of the good
+    set using a JOINT center — one good configuration anchors every
+    dimension, preserving cross-dimension correlation — with a per-dim
+    Gaussian kernel whose bandwidth shrinks as evidence accumulates
+    (log-space for loguniform). An ``epsilon`` fraction of suggestions
+    stays uniform so the whole domain remains reachable. The classic
+    good/bad density RATIO is deliberately omitted: at <=50-trial budgets
+    it measurably over-explores the frontier of the bad set (validated
+    against random search on seeded quadratic objectives in
+    test_libraries.py). Choice dimensions sample from smoothed
+    good-set frequencies."""
+
+    def __init__(
+        self,
+        metric: str | None = None,
+        mode: str | None = None,
+        *,
+        gamma: float = 0.2,
+        epsilon: float = 0.15,
+        min_observations: int = 6,
+        seed: int = 0,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.gamma = gamma
+        self.epsilon = epsilon
+        self.min_observations = min_observations
+        self.rng = np.random.default_rng(seed)
+        self._space: Dict[str, Any] = {}
+        self._obs: List[tuple] = []  # (config, value)
+
+    def set_space(self, space: Dict[str, Any]) -> None:
+        for k, v in space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    "TPESearcher models Domain axes; use tune.choice(...) "
+                    f"instead of grid_search for {k!r}"
+                )
+        self._space = space
+
+    def report(self, config: Dict[str, Any], value: float) -> None:
+        if value is not None and np.isfinite(value):
+            self._obs.append((config, float(value)))
+
+    # -- internals ------------------------------------------------------
+    def _to_unit(self, dom: Domain, v):
+        if dom.kind == "uniform":
+            lo, hi = dom.args
+            return (v - lo) / (hi - lo)
+        if dom.kind == "loguniform":
+            lo, hi = dom.args
+            return (np.log(v) - np.log(lo)) / (np.log(hi) - np.log(lo))
+        if dom.kind == "randint":
+            lo, hi = dom.args
+            return (v - lo) / max(1, hi - 1 - lo)
+        raise ValueError(dom.kind)
+
+    def _from_unit(self, dom: Domain, u: float):
+        u = float(np.clip(u, 0.0, 1.0))
+        if dom.kind == "uniform":
+            lo, hi = dom.args
+            return lo + u * (hi - lo)
+        if dom.kind == "loguniform":
+            lo, hi = dom.args
+            return float(np.exp(np.log(lo) + u * (np.log(hi) - np.log(lo))))
+        if dom.kind == "randint":
+            lo, hi = dom.args
+            return int(round(lo + u * max(0, hi - 1 - lo)))
+        raise ValueError(dom.kind)
+
+    def _random(self) -> Dict[str, Any]:
+        return {
+            k: (v.sample(self.rng) if isinstance(v, Domain) else v)
+            for k, v in self._space.items()
+        }
+
+    def suggest(self) -> Dict[str, Any]:
+        if (
+            len(self._obs) < self.min_observations
+            or self.rng.random() < self.epsilon
+        ):
+            return self._random()
+        sign = -1.0 if (self.mode or "min") == "max" else 1.0
+        ranked = sorted(self._obs, key=lambda cv: sign * cv[1])
+        n_good = min(
+            len(ranked), max(2, int(np.ceil(self.gamma * len(ranked))))
+        )
+        good = ranked[:n_good]
+        center = good[int(self.rng.integers(len(good)))][0]
+        out: Dict[str, Any] = {}
+        for k, dom in self._space.items():
+            if not isinstance(dom, Domain):
+                out[k] = dom
+                continue
+            if dom.kind == "choice":
+                options = dom.args[0]
+                idx = {repr(o): i for i, o in enumerate(options)}
+                freq = np.ones(len(options))  # Laplace smoothing
+                for cfg, _ in good:
+                    freq[idx[repr(cfg[k])]] += 1
+                p = freq / freq.sum()
+                out[k] = options[int(self.rng.choice(len(options), p=p))]
+                continue
+            g = np.array([self._to_unit(dom, cfg[k]) for cfg, _ in good])
+            bw = max(0.02, float(g.std()) * len(g) ** -0.25)
+            u = self._to_unit(dom, center[k]) + self.rng.normal(0.0, bw)
+            out[k] = self._from_unit(dom, u)
+        return out

@@ -270,6 +270,9 @@ class TuneConfig:
     mode: str = "min"
     num_samples: int = 1
     scheduler: Any = None
+    # model-based searcher (e.g. search.TPESearcher): suggests configs
+    # sequentially from completed results instead of sampling up front
+    search_alg: Any = None
     max_concurrent_trials: Optional[int] = None
     seed: int = 0
 
@@ -338,24 +341,48 @@ class Tuner:
         if scheduler is not None:
             scheduler.metric = scheduler.metric or tc.metric
             scheduler.mode = scheduler.mode or tc.mode
-        configs = expand_param_space(
-            self.param_space, tc.num_samples, tc.seed
-        )
+        searcher = tc.search_alg
         states: List[_TrialState] = []
         pending: List[tuple] = []  # (state, restore_ckpt)
-        for cfg in configs:
-            tid = f"trial_{uuid.uuid4().hex[:8]}"
-            state = _TrialState(trial_id=tid, config=cfg)
-            with _registry_lock:
-                _registry[tid] = state
-            states.append(state)
-            pending.append((state, None))
+        to_suggest = 0
+        if searcher is not None:
+            # sequential model-based search: configs come one at a time,
+            # each informed by every completed result so far
+            searcher.metric = searcher.metric or tc.metric
+            searcher.mode = searcher.mode or tc.mode
+            searcher.set_space(self.param_space)
+            to_suggest = tc.num_samples
+        else:
+            configs = expand_param_space(
+                self.param_space, tc.num_samples, tc.seed
+            )
+            for cfg in configs:
+                tid = f"trial_{uuid.uuid4().hex[:8]}"
+                state = _TrialState(trial_id=tid, config=cfg)
+                with _registry_lock:
+                    _registry[tid] = state
+                states.append(state)
+                pending.append((state, None))
 
         running: Dict[str, Any] = {}  # trial_id -> (actor, ref)
         seen_iters: Dict[str, int] = {}
-        max_conc = tc.max_concurrent_trials or len(states)
+        # model-based search defaults to SEQUENTIAL trials: launching the
+        # whole budget up-front would mean every suggestion is drawn with
+        # zero observations — i.e. silently random
+        max_conc = tc.max_concurrent_trials or (
+            1 if searcher is not None else max(1, len(states))
+        )
 
-        while pending or running:
+        while pending or running or to_suggest > 0:
+            while to_suggest > 0 and len(pending) + len(running) < max_conc:
+                cfg = searcher.suggest()
+                to_suggest -= 1
+                tid = f"trial_{uuid.uuid4().hex[:8]}"
+                state = _TrialState(trial_id=tid, config=cfg)
+                with _registry_lock:
+                    _registry[tid] = state
+                states.append(state)
+                pending.append((state, None))
             while pending and len(running) < max_conc:
                 state, restore = pending.pop(0)
                 state.restore_checkpoint = restore
@@ -411,6 +438,9 @@ class Tuner:
                 except Exception:  # noqa: BLE001 - status captured in state
                     pass
                 ray_tpu.kill(actor)
+                if searcher is not None:
+                    st = _registry[tid]
+                    searcher.report(st.config, st.last_metric(tc.metric))
 
         results = [
             TrialResult(

@@ -1,0 +1,71 @@
+"""Distributed trace-context propagation.
+
+Capability analog of the reference's OpenTelemetry task tracing
+(/root/reference/python/ray/util/tracing/tracing_helper.py: the ambient
+span context is serialized into every task spec at submission and
+re-installed around execution on the worker, so spans from every hop of
+a task tree share one trace id).
+
+Here the context is a small dict ``{"trace_id", "span_id"}`` carried in
+``TaskSpec.trace`` / ``LeaseRequest.trace`` / direct-call items:
+
+- the driver's first submission in a tree mints a trace id;
+- the worker installs the received context (contextvar) around user-code
+  execution, so NESTED submissions inherit the same trace id with the
+  executing task as their parent span;
+- every lifecycle event recorded against the task (head + local runtime
+  timelines) carries ``trace_id``/``parent_id``, and the Chrome-trace
+  export exposes them in ``args`` — one trace is filterable across every
+  node it touched.
+"""
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import Optional
+
+_ctx: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "ray_tpu_trace", default=None
+)
+
+
+def current() -> Optional[dict]:
+    return _ctx.get()
+
+
+def child_context(task_id: str) -> dict:
+    """Trace context for a task being SUBMITTED now: inherits the ambient
+    trace (nested call) or mints a fresh trace id (tree root). The new
+    task's span id is its task id."""
+    amb = _ctx.get()
+    if amb is not None:
+        return {
+            "trace_id": amb["trace_id"],
+            "span_id": task_id,
+            "parent_id": amb["span_id"],
+        }
+    return {
+        "trace_id": os.urandom(8).hex(),
+        "span_id": task_id,
+        "parent_id": None,
+    }
+
+
+def install(trace: Optional[dict]):
+    """Install the received context around task execution; returns a
+    token for ``uninstall``."""
+    return _ctx.set(trace)
+
+
+def uninstall(token) -> None:
+    _ctx.reset(token)
+
+
+def event_args(trace: Optional[dict]) -> dict:
+    """kwargs for TaskEventBuffer.record."""
+    if not trace:
+        return {}
+    out = {"trace_id": trace["trace_id"]}
+    if trace.get("parent_id"):
+        out["parent_id"] = trace["parent_id"]
+    return out

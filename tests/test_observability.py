@@ -155,3 +155,52 @@ def test_rpc_handler_stats_recorded():
     assert snap["EchoX"]["max_ms"] >= snap["EchoX"]["mean_ms"] >= 0
     cli.close()
     srv.stop()
+
+
+def _trace_child(x):
+    return x + 1
+
+
+def _trace_parent():
+    import ray_tpu
+
+    f = ray_tpu.remote(_trace_child).options(num_cpus=0.5, max_retries=0)
+    return ray_tpu.get(f.remote(41), timeout=60)
+
+
+def test_trace_spans_cross_node_cluster():
+    """Distributed tracing (tracing_helper.py capability): a task that
+    submits a nested task on another node shares ONE trace id across both
+    spans in the Chrome-trace timeline, with parent/child span linkage."""
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 2.0}, num_workers=1)
+    c.add_node({"CPU": 2.0}, num_workers=1)
+    client = c.client()
+    set_runtime(client)
+    try:
+        f = ray_tpu.remote(_trace_parent).options(
+            num_cpus=1.0, max_retries=0
+        )
+        assert ray_tpu.get(f.remote(), timeout=120) == 42
+        spans = ray_tpu.timeline()
+        traced = [
+            s
+            for s in spans
+            if s.get("ph") == "X" and s.get("args", {}).get("trace_id")
+        ]
+        parents = [s for s in traced if s["name"] == "_trace_parent"]
+        children = [s for s in traced if s["name"] == "_trace_child"]
+        assert parents and children, [s["name"] for s in traced]
+        p, ch = parents[-1], children[-1]
+        # one trace covers both hops
+        assert ch["args"]["trace_id"] == p["args"]["trace_id"]
+        # the child span points at the parent task's span
+        assert ch["args"]["parent_id"] == p["args"]["task_id"]
+    finally:
+        set_runtime(None)
+        client.shutdown()
+        c.shutdown()

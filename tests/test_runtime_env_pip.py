@@ -174,3 +174,38 @@ def test_local_runtime_rejects_pip_env():
             f.remote()
     finally:
         ray_tpu.shutdown()
+
+
+class _VerActor:
+    def ver(self):
+        import conflictpkg
+
+        return conflictpkg.__version__
+
+
+def test_actor_pip_env(tmp_path, monkeypatch):
+    """Per-ACTOR runtime_env: the actor pins an env-bound worker for life."""
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(str(wheels), "conflictpkg", "3.1.0")
+    monkeypatch.setenv("RAY_TPU_PIP_ENV_BASE", str(tmp_path / "envs"))
+
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=1)
+    client = c.client()
+    set_runtime(client)
+    try:
+        A = ray_tpu.remote(_VerActor).options(
+            num_cpus=0.5,
+            runtime_env=_pip_env(str(wheels), "3.1.0"),
+        )
+        a = A.remote()
+        assert ray_tpu.get(a.ver.remote(), timeout=180) == "3.1.0"
+        assert ray_tpu.get(a.ver.remote(), timeout=60) == "3.1.0"
+    finally:
+        set_runtime(None)
+        client.shutdown()
+        c.shutdown()
