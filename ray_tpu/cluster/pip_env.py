@@ -141,11 +141,13 @@ class PipEnvManager:
         first (the reference GCs per-env on last-actor-exit; a small LRU
         cache keeps warm envs for repeat jobs). Returns removed count.
 
-        Runs entirely under the refcount lock so an acquire() racing the
-        sweep either lands before the liveness read (env survives) or
-        blocks until the sweep finishes (env gone, the next ensure()
-        rebuilds — the .built marker is removed FIRST, so a partially
-        failed removal reads as not-built rather than present)."""
+        The lock covers only the cheap part — liveness read, marker
+        unlink, and an atomic rename of each doomed dir to a .tmp name —
+        so an acquire() racing the sweep either lands before the read
+        (env survives) or sees the env already gone and rebuilds. The
+        slow recursive deletes run after the lock is released (rmtree of
+        a large env must not stall pip dispatch node-wide)."""
+        doomed: List[str] = []
         with self._lock:
             live = set(self._refs)
             envs = []
@@ -167,8 +169,15 @@ class PipEnvManager:
                         os.unlink(os.path.join(self.base_dir, name + suffix))
                     except OSError:
                         pass
-                shutil.rmtree(
-                    os.path.join(self.base_dir, name), ignore_errors=True
+                grave = os.path.join(
+                    self.base_dir, f"{name}.{os.getpid()}.gc.tmp"
                 )
+                try:
+                    os.rename(os.path.join(self.base_dir, name), grave)
+                except OSError:
+                    continue
+                doomed.append(grave)
                 removed += 1
-            return removed
+        for grave in doomed:
+            shutil.rmtree(grave, ignore_errors=True)
+        return removed

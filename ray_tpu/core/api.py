@@ -365,6 +365,12 @@ class ActorClass:
                 scheduling_strategy=opts.get("scheduling_strategy"),
                 runtime_env=opts.get("runtime_env"),
             )
+        if (opts.get("runtime_env") or {}).get("pip"):
+            raise NotImplementedError(
+                "pip runtime environments need per-env worker processes — "
+                "run against a cluster (ray_tpu.init(address=...) or "
+                "Cluster()); the in-process runtime shares one interpreter"
+            )
         return actor_mod.create_actor(
             rt,
             self._cls,

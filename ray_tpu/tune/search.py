@@ -189,12 +189,30 @@ class TPESearcher:
                 idx = {repr(o): i for i, o in enumerate(options)}
                 freq = np.ones(len(options))  # Laplace smoothing
                 for cfg, _ in good:
-                    freq[idx[repr(cfg[k])]] += 1
+                    # observed values outside the domain (e.g. PBT numeric
+                    # perturbations of a choice axis) just don't vote
+                    i = idx.get(repr(cfg.get(k)))
+                    if i is not None:
+                        freq[i] += 1
                 p = freq / freq.sum()
                 out[k] = options[int(self.rng.choice(len(options), p=p))]
                 continue
-            g = np.array([self._to_unit(dom, cfg[k]) for cfg, _ in good])
+            g = np.array(
+                [
+                    np.clip(self._to_unit(dom, cfg[k]), 0.0, 1.0)
+                    for cfg, _ in good
+                    if k in cfg
+                ]
+            )
+            if g.size == 0:
+                out[k] = dom.sample(self.rng)
+                continue
             bw = max(0.02, float(g.std()) * len(g) ** -0.25)
-            u = self._to_unit(dom, center[k]) + self.rng.normal(0.0, bw)
+            if k in center:
+                u = np.clip(
+                    self._to_unit(dom, center[k]), 0.0, 1.0
+                ) + self.rng.normal(0.0, bw)
+            else:
+                u = float(self.rng.choice(g)) + self.rng.normal(0.0, bw)
             out[k] = self._from_unit(dom, u)
         return out

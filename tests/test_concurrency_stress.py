@@ -1,0 +1,133 @@
+"""Seeded concurrency stress soak over the head/agent/worker trio.
+
+The capability analog of the reference's TSAN/ASAN configs over its C++
+tests (SURVEY §4.3, .bazelrc): this runtime's control plane is threaded
+Python, so the race-detection story is a seeded, reproducible
+interleaving chaos soak — concurrent task storms, actor churn (kills
+mid-flight), and object churn run against a live multi-process cluster
+WITH RPC chaos injected, while a faulthandler watchdog dumps every
+thread's stack if anything deadlocks. Failures reproduce by rerunning
+with the same RAY_TPU_STRESS_SEED.
+"""
+import faulthandler
+import os
+import random
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _task_storm(rng: random.Random, errors: list) -> None:
+    try:
+        @ray_tpu.remote
+        def work(x, payload):
+            return x * 2 + len(payload)
+
+        f = work.options(num_cpus=0.25, max_retries=1)
+        for _round in range(6):
+            n = rng.randint(20, 60)
+            sizes = [rng.randint(0, 50_000) for _ in range(n)]
+            refs = [
+                f.remote(i, b"x" * sizes[i]) for i in range(n)
+            ]
+            got = ray_tpu.get(refs, timeout=180)
+            assert got == [i * 2 + sizes[i] for i in range(n)], "task storm"
+    except Exception as exc:  # noqa: BLE001
+        errors.append(("task_storm", repr(exc)))
+
+
+def _actor_churn(rng: random.Random, errors: list) -> None:
+    try:
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        for _round in range(5):
+            a = Counter.options(num_cpus=0.25).remote()
+            total = 0
+            calls = rng.randint(5, 25)
+            refs = []
+            for i in range(calls):
+                total += i
+                refs.append(a.add.remote(i))
+            got = ray_tpu.get(refs, timeout=120)
+            assert got[-1] == total, "actor sum"
+            # kill mid-life: later calls must fail loudly, not hang
+            ray_tpu.kill(a)
+            try:
+                ray_tpu.get(a.add.remote(1), timeout=60)
+            except Exception:  # noqa: BLE001 - expected
+                pass
+    except Exception as exc:  # noqa: BLE001
+        errors.append(("actor_churn", repr(exc)))
+
+
+def _object_churn(rng: random.Random, errors: list) -> None:
+    try:
+        import numpy as np
+
+        live = []
+        for _round in range(40):
+            arr = np.full(rng.randint(1000, 200_000), _round, np.int32)
+            ref = ray_tpu.put(arr)
+            live.append((ref, _round))
+            if len(live) > 8:
+                ref0, tag = live.pop(rng.randrange(len(live)))
+                back = ray_tpu.get(ref0, timeout=120)
+                assert int(back[0]) == tag, "object content"
+        for ref, tag in live:
+            assert int(ray_tpu.get(ref, timeout=120)[0]) == tag
+    except Exception as exc:  # noqa: BLE001
+        errors.append(("object_churn", repr(exc)))
+
+
+def test_seeded_concurrency_soak(monkeypatch):
+    seed = int(os.environ.get("RAY_TPU_STRESS_SEED", "7"))
+    # RPC chaos ON: dropped/delayed control messages must surface as
+    # retries, never as hangs or wrong answers
+    monkeypatch.setenv(
+        "RAY_TPU_RPC_CHAOS",
+        "DirectPushBatch:drop=0.05;DirectResults:drop=0.05",
+    )
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    # watchdog: if the soak deadlocks, dump EVERY thread's stack before
+    # the pytest timeout kills us blind
+    faulthandler.dump_traceback_later(420, exit=False)
+    c = Cluster()
+    c.add_node({"CPU": 8.0}, num_workers=3)
+    c.add_node({"CPU": 8.0}, num_workers=3)
+    client = c.client()
+    set_runtime(client)
+    errors: list = []
+    try:
+        threads = [
+            threading.Thread(
+                target=fn, args=(random.Random(seed + i), errors)
+            )
+            for i, fn in enumerate(
+                (_task_storm, _actor_churn, _object_churn)
+            )
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=400)
+        hung = [t for t in threads if t.is_alive()]
+        assert not hung, f"soak deadlocked after {time.monotonic()-t0:.0f}s"
+        assert not errors, errors
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+        set_runtime(None)
+        client.shutdown()
+        c.shutdown()
