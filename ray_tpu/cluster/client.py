@@ -324,7 +324,10 @@ class RemoteActorHandle:
         self._cls = cls
 
     def __getattr__(self, name: str):
-        if name.startswith("_"):
+        # "__call__" is a legitimate remote method (serve deployments
+        # dispatch it); every other underscore name stays an attribute
+        # error so pickling/introspection behave
+        if name.startswith("_") and name != "__call__":
             raise AttributeError(name)
         return _RemoteMethod(self._runtime, self._actor_id, name)
 

@@ -154,7 +154,13 @@ class PipEnvManager:
             try:
                 for name in os.listdir(self.base_dir):
                     p = os.path.join(self.base_dir, name)
-                    if os.path.isdir(p) and not name.endswith(".tmp"):
+                    if not os.path.isdir(p):
+                        continue
+                    if name.endswith(".gc.tmp"):
+                        # grave from a sweep interrupted by process death:
+                        # always finish the burial
+                        doomed.append(p)
+                    elif not name.endswith(".tmp"):
                         envs.append((os.path.getmtime(p), name))
             except OSError:
                 return 0

@@ -15,5 +15,6 @@ from .deployment import (  # noqa: F401
     get_deployment_handle,
     run,
     shutdown,
+    start_grpc_ingress,
     start_http_proxy,
 )

@@ -311,7 +311,7 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 
 def shutdown() -> None:
-    global _http_server
+    global _http_server, _grpc_server
     _controller_stop.set()
     for rs in _apps.values():
         rs.close()
@@ -324,6 +324,34 @@ def shutdown() -> None:
     if _http_server is not None:
         _http_server.shutdown()
         _http_server = None
+    if _grpc_server is not None:
+        _grpc_server.shutdown()
+        _grpc_server = None
+
+
+_grpc_server = None
+_grpc_lock = threading.Lock()
+
+
+def start_grpc_ingress(port: int = 0) -> str:
+    """gRPC front door (the reference proxies gRPC alongside HTTP,
+    serve/_private/proxy.py gRPCProxy): any cluster RpcClient can call
+    ServeCall / ServeStreamOpen / ServeStreamNext against the returned
+    address. Returns "host:port". Idempotent for the same port; asking
+    for a DIFFERENT specific port while one is live is an error rather
+    than silently handing back the old address."""
+    global _grpc_server
+    from .grpc_ingress import GrpcIngress
+
+    with _grpc_lock:
+        if _grpc_server is None:
+            _grpc_server = GrpcIngress(_apps, port=port)
+        elif port and not _grpc_server.address.endswith(f":{port}"):
+            raise RuntimeError(
+                f"gRPC ingress already listening on {_grpc_server.address}; "
+                f"cannot also bind port {port} (call serve.shutdown() first)"
+            )
+        return _grpc_server.address
 
 
 def start_http_proxy(port: int = 8000) -> int:

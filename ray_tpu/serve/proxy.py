@@ -163,6 +163,80 @@ class _RelayReader:
         raise TimeoutError("no items in window")
 
 
+def start_stream(rs, payload, same_host_pred):
+    """Blocking transport selection + dispatch shared by the HTTP and gRPC
+    ingresses. Returns (ch, relay_actor, reader, ref); on error every
+    partially-created resource is cleaned up before the exception
+    propagates. Same-host replicas get the shm ring (strictly pinned — a
+    same-host-only writer must never reach a cross-host replica); with
+    only cross-host replicas a relay actor bridges the tokens."""
+    from ray_tpu.experimental import Channel
+    from ray_tpu.serve.deployment import NoPreferredReplica
+
+    with rs.lock:
+        cands = [r for r in rs.replicas if not r.draining] or list(
+            rs.replicas
+        )
+    if any(same_host_pred(r) for r in cands):
+        ch = Channel(buffer_size_bytes=1 << 18)
+        try:
+            ref = rs.submit(
+                "stream_to",
+                (ch.writer, payload),
+                {},
+                prefer=same_host_pred,
+                strict_prefer=True,
+            )
+            return ch, None, ch.reader, ref
+        except NoPreferredReplica:
+            ch.destroy()
+        except BaseException:
+            ch.destroy()
+            raise
+    relay_actor = ray_tpu.remote(_StreamRelayActor).options(
+        num_cpus=0.0, max_concurrency=16
+    ).remote()
+    try:
+        ref = rs.submit(
+            "stream_to", (_RelayWriter(relay_actor), payload), {}
+        )
+    except BaseException:
+        try:
+            ray_tpu.kill(relay_actor)
+        except Exception:  # noqa: BLE001
+            pass
+        raise
+    return None, relay_actor, _RelayReader(relay_actor), ref
+
+
+def same_host_predicate(hosts_cache: dict, local_hosts: Optional[set]):
+    """Factory shared by ingresses: predicate over _Replica deciding
+    same-host-ness, with per-actor results cached in ``hosts_cache``."""
+    from ray_tpu.core.runtime import get_runtime
+
+    try:
+        rt = get_runtime()
+    except Exception:  # noqa: BLE001
+        return lambda r: True
+    if not getattr(rt, "is_remote", False):
+        return lambda r: True
+    local = local_hosts if local_hosts is not None else _local_hosts()
+
+    def pred(replica) -> bool:
+        aid = getattr(replica.actor, "_actor_id", None)
+        if aid is None:
+            return True
+        if aid not in hosts_cache:
+            _, addr = rt.actor_location(aid)
+            host = addr.rsplit(":", 1)[0] if addr else None
+            if host is None:
+                return False  # unknown ⇒ not-local; relay path is safe
+            hosts_cache[aid] = host in local
+        return hosts_cache[aid]
+
+    return pred
+
+
 def _local_hosts() -> set:
     import socket
 
@@ -202,88 +276,15 @@ class ServeProxy:
             )
 
     def _same_host_pred(self):
-        """Predicate over _Replica: is its actor on this proxy's host?
-        Local runtime ⇒ always; cluster runtime ⇒ compare the hosting
-        agent's address to our own interfaces. Locations are cached on
-        the proxy per actor id (placement is sticky for a live actor), so
-        the head RPC happens once per replica, not once per request —
-        and callers run the predicate on the worker pool, never the event
-        loop (rt.actor_location can block on a slow head)."""
-        from ray_tpu.core.runtime import get_runtime
-
-        try:
-            rt = get_runtime()
-        except Exception:  # noqa: BLE001
-            return lambda r: True
-        if not getattr(rt, "is_remote", False):
-            return lambda r: True
+        """Replica same-host predicate with proxy-level caching (the head
+        RPC happens once per replica, not once per request); callers run
+        it on the worker pool, never the event loop."""
         if self._hosts is None:
             self._hosts = _local_hosts()
-        cache = self._host_cache
-
-        def pred(replica) -> bool:
-            aid = getattr(replica.actor, "_actor_id", None)
-            if aid is None:
-                return True
-            if aid not in cache:
-                _, addr = rt.actor_location(aid)
-                host = addr.rsplit(":", 1)[0] if addr else None
-                if host is None:
-                    # unknown location ⇒ NOT local (the relay path works
-                    # on every topology); don't cache — it may resolve
-                    return False
-                cache[aid] = host in self._hosts
-            return cache[aid]
-
-        return pred
+        return same_host_predicate(self._host_cache, self._hosts)
 
     def _start_stream(self, rs, payload):
-        """Blocking transport selection + dispatch. Runs on the worker
-        pool — never the event loop. Returns (ch, relay_actor, reader,
-        ref); on error every partially-created resource is cleaned up
-        before the exception propagates."""
-        from ray_tpu.experimental import Channel
-        from ray_tpu.serve.deployment import NoPreferredReplica
-
-        same_host = self._same_host_pred()
-        with rs.lock:
-            cands = [r for r in rs.replicas if not r.draining] or list(
-                rs.replicas
-            )
-        if any(same_host(r) for r in cands):
-            # fast path: shm ring to a same-host replica, strictly pinned
-            # (a same-host-only writer must never reach a cross-host
-            # replica); if the preferred replica drains between snapshot
-            # and dispatch, fall through to the relay
-            ch = Channel(buffer_size_bytes=1 << 18)
-            try:
-                ref = rs.submit(
-                    "stream_to",
-                    (ch.writer, payload),
-                    {},
-                    prefer=same_host,
-                    strict_prefer=True,
-                )
-                return ch, None, ch.reader, ref
-            except NoPreferredReplica:
-                ch.destroy()
-            except BaseException:
-                ch.destroy()
-                raise
-        relay_actor = ray_tpu.remote(_StreamRelayActor).options(
-            num_cpus=0.0, max_concurrency=16
-        ).remote()
-        try:
-            ref = rs.submit(
-                "stream_to", (_RelayWriter(relay_actor), payload), {}
-            )
-        except BaseException:
-            try:
-                ray_tpu.kill(relay_actor)
-            except Exception:  # noqa: BLE001
-                pass
-            raise
-        return None, relay_actor, _RelayReader(relay_actor), ref
+        return start_stream(rs, payload, self._same_host_pred())
 
     # -- handlers -------------------------------------------------------
     async def _call(self, request):

@@ -102,7 +102,7 @@ def test_seeded_concurrency_soak(monkeypatch):
 
     # watchdog: if the soak deadlocks, dump EVERY thread's stack before
     # the pytest timeout kills us blind
-    faulthandler.dump_traceback_later(420, exit=False)
+    faulthandler.dump_traceback_later(360, exit=False)
     c = Cluster()
     c.add_node({"CPU": 8.0}, num_workers=3)
     c.add_node({"CPU": 8.0}, num_workers=3)

@@ -2,7 +2,7 @@
 ON THE CHIP (RAY_TPU_SCHED_PLATFORM=tpu) drives a real 1k-task job.
 
 Skipped when no healthy TPU is reachable (the accelerator tunnel in this
-environment can wedge; a 60s probe decides). Everything runs in
+environment can wedge; a 90s probe decides). Everything runs in
 subprocesses because the test session itself is pinned to CPU
 (tests/conftest.py) and a wedged backend init would hang any in-process
 jax call forever.
@@ -65,13 +65,15 @@ finally:
 """
 
 
-@pytest.mark.skipif(
-    not _tpu_available(), reason="no healthy TPU reachable (probe)"
-)
 def test_live_tpu_device_scheduling(tmp_path):
     """1k tasks through a head whose scheduler kernels run on the real
     chip — the e2e proof the product scheduler works off-host-XLA
-    (VERDICT r3 weak #7: no test ever exercised sched_platform=tpu)."""
+    (VERDICT r3 weak #7: no test ever exercised sched_platform=tpu).
+
+    The probe runs INSIDE the test (not at collection), so suites on
+    hosts without a TPU pay for it only when this test is selected."""
+    if not _tpu_available():
+        pytest.skip("no healthy TPU reachable (90s probe)")
     script = tmp_path / "live.py"
     script.write_text(_LIVE_SCRIPT)
     env = dict(os.environ)
