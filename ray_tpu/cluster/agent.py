@@ -1092,6 +1092,9 @@ class NodeAgent:
             "actor_meta": spec.actor_meta,
             "accel_env": accel_env,
             "trace": spec.trace,
+            "fn_blob": spec.fn_blob,
+            "fn_id": spec.fn_id,
+            "fn_cache": spec.fn_cache,
             "retry_exceptions": (
                 spec.retry_exceptions and spec.attempt < spec.max_retries
             ),

@@ -11,6 +11,7 @@ anywhere with connectivity to the cluster.
 """
 from __future__ import annotations
 
+import hashlib
 import inspect
 import os
 import pickle
@@ -423,11 +424,14 @@ class _PipelinedSender:
                         retry_interval=0.25,
                     )
                     delivered = True
-                except RpcError:
+                except (RpcError, RuntimeError):
                     # a dropped lease would strand its caller's get()
                     # forever and a dropped release leaks the object —
                     # keep the batch and retry until the head comes back
-                    # (or this runtime shuts down)
+                    # (or this runtime shuts down). RuntimeError: the
+                    # channel's executor closed under us (shutdown race) —
+                    # same stop checks apply, never an unhandled thread
+                    # exception.
                     import sys
 
                     if sys.is_finalizing():
@@ -457,6 +461,11 @@ class _PipelinedSender:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+        # join BEFORE the caller closes the rpc channel: an in-flight send
+        # racing the channel's executor shutdown was the
+        # cannot-schedule-new-futures stray-thread exception the full
+        # suite used to end with
+        self._thread.join(timeout=5.0)
 
 
 class RemoteRuntime:
@@ -486,6 +495,13 @@ class RemoteRuntime:
         from ray_tpu.config import cfg
 
         self._direct_enabled = cfg.direct_actor_calls
+        # one cloudpickle of each task function per function OBJECT (weak:
+        # dead lambdas drop their blobs); see _serialize_fn
+        import weakref
+
+        self._fn_blobs: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
         self._direct_channels: Dict[str, _DirectActorChannel] = {}
         self._direct_results: Dict[str, tuple] = {}  # hex -> (kind, payload)
         # FIFO bound on the local result cache: fire-and-forget callers
@@ -534,12 +550,46 @@ class RemoteRuntime:
     # ------------------------------------------------------------------
     # tasks
     # ------------------------------------------------------------------
+    def _serialize_fn(self, fn) -> tuple:
+        """Pickle a task function once per function object.
+
+        Returns ``(blob, fn_id, fn_arg_ids, cacheable)``. Cached only when
+        serialization collected zero ObjectRefs — a closure over a ref
+        keeps per-call (de)serialization so ref lifetimes stay
+        per-execution. Matches the reference's one-time function export
+        (function_manager) vs. our previous per-call re-pickle: closure
+        CELL mutations after first submission are not re-shipped, same as
+        the reference."""
+        from ray_tpu.core.refcount import collect_serialized
+
+        try:
+            ent = self._fn_blobs.get(fn)
+        except TypeError:
+            ent = None  # unhashable/unweakrefable callable
+        if ent is not None:
+            return ent
+        _ship_module_by_value(fn)
+        with collect_serialized() as ids:
+            blob = cloudpickle.dumps(fn)
+        fn_id = hashlib.blake2b(blob, digest_size=8).hexdigest()
+        ent = (blob, fn_id, frozenset(ids), not ids)
+        if not ids:
+            try:
+                self._fn_blobs[fn] = ent
+            except TypeError:
+                pass
+        return ent
+
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
         from ray_tpu.core.refcount import collect_serialized
 
-        _ship_module_by_value(spec.func)
+        fn_blob, fn_id, fn_arg_ids, fn_cacheable = self._serialize_fn(
+            spec.func
+        )
         with collect_serialized() as arg_ids:
-            payload = cloudpickle.dumps((spec.func, spec.args, spec.kwargs))
+            payload = cloudpickle.dumps((spec.args, spec.kwargs))
+        if fn_arg_ids:
+            arg_ids |= fn_arg_ids
         deps = [a.hex for a in spec.args if isinstance(a, ObjectRef)]
         deps += [
             v.hex for v in spec.kwargs.values() if isinstance(v, ObjectRef)
@@ -567,6 +617,9 @@ class RemoteRuntime:
             deps=deps,
             client_id=self.client_id,
             trace=trace,
+            fn_blob=fn_blob,
+            fn_id=fn_id,
+            fn_cache=fn_cacheable,
         )
         self._sender.enqueue("lease", lease)
         self._flusher.note_registered(lease.return_ids)

@@ -24,7 +24,9 @@ HEALTH_TIMEOUT_S = cfg.health_timeout_s
 
 
 def new_id() -> str:
-    return os.urandom(8).hex()  # cheaper than uuid4 on the submit path
+    from ray_tpu._ids import rand_hex
+
+    return rand_hex(8)  # buffered urandom: no syscall per id
 
 
 @dataclass
@@ -47,7 +49,7 @@ class LeaseRequest:
 
     task_id: str
     name: str
-    payload: bytes  # cloudpickled (func, args, kwargs)
+    payload: bytes  # cloudpickled (func, args, kwargs); (args, kwargs) when fn_blob set
     return_ids: List[str]
     resources: Dict[str, float]
     kind: str = "task"  # task | actor_creation | actor_method
@@ -78,6 +80,16 @@ class LeaseRequest:
     # distributed trace context (util/tracing.py); rides the wire so every
     # hop's lifecycle events share one trace id
     trace: Optional[dict] = None
+    # plain tasks only: the function pickled SEPARATELY from (args, kwargs)
+    # so the client pickles it once per function object and executors
+    # deserialize it once per (worker, fn_id) — the reference exports a
+    # remote function's pickle once at first submission for the same
+    # reason (function_manager export path) instead of re-pickling per
+    # call. fn_cache=False (fn closes over ObjectRefs) keeps per-call
+    # deserialization so ref lifetimes stay per-execution.
+    fn_blob: Optional[bytes] = None
+    fn_id: str = ""
+    fn_cache: bool = True
 
     def __getstate__(self):
         # head-side scheduling memos (e.g. _req_cache) never ride the wire

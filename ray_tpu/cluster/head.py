@@ -756,9 +756,12 @@ class HeadServer:
                 # lifetime (lineage for restarts); released when it dies
                 if spec is None or spec.kind != "actor_creation":
                     unpin.append(lid)
-            # completed leases freed resources somewhere: wake parked work
-            self._pending.extend(self._infeasible)
-            self._infeasible.clear()
+            # completed leases freed resources somewhere: notify the
+            # scheduler loop, whose capacity-capped unpark retries parked
+            # work. Draining the WHOLE parked queue here (pre-r5 behavior)
+            # re-scheduled every parked spec on every completion batch —
+            # O(parked²) churn that halved e2e throughput under a deep
+            # backlog (BENCH_r04 654 tasks/s vs r03 1206.7).
             self._pgs_dirty = True
             self._cond.notify_all()
         for lid in unpin:
@@ -1177,27 +1180,16 @@ class HeadServer:
                     and not self._shutdown
                 ):
                     self._cond.wait(timeout=0.5)
-                    # Lost-wakeup backstop: a spec parked after the
-                    # release/report that would have drained it sleeps until
-                    # the next cluster event. Retry parked work only when the
-                    # view actually moved, so truly-infeasible specs don't
-                    # spin the kernel at 2 Hz.
-                    if (
-                        self._infeasible
-                        and not self._pending
-                        and self.view.change_counter != self._parked_at_change
-                        and time.monotonic() - self._last_park_retry > 0.02
-                    ):
-                        # rate-limited: completions bump the change counter
-                        # continuously under load; re-routing every parked
-                        # spec each 2ms tick multiplies per-spec Python
-                        # work ~10x for no placement gain
-                        self._parked_at_change = self.view.change_counter
-                        self._last_park_retry = time.monotonic()
-                        self._pending.extend(self._infeasible)
-                        self._infeasible.clear()
+                    # Retry parked work only when the view actually moved,
+                    # so truly-infeasible specs don't spin the kernel at
+                    # 2 Hz.
+                    self._maybe_unpark_locked()
                 if self._shutdown:
                     return
+                # parked work also retries while NEW submissions keep the
+                # queue hot — without this, a steady submit stream starves
+                # every parked spec (the wait loop above never runs)
+                self._maybe_unpark_locked()
                 batch = self._pop_fair_batch()
                 # demand visibility: the popped batch is mid-schedule, not
                 # gone — the autoscaler must still see it (the first round
@@ -1214,6 +1206,94 @@ class HeadServer:
             finally:
                 self._scheduling_batch = []
             time.sleep(SCHED_TICK_S)
+
+    _UNPARK_SLACK = 32
+
+    def _maybe_unpark_locked(self) -> None:
+        """Rate-limited, change-gated entry to ``_unpark_grantable``:
+        completions bump the change counter continuously under load;
+        re-routing parked specs each 2ms tick multiplies per-spec Python
+        work ~10x for no placement gain. Caller holds ``self._cond``."""
+        if (
+            self._infeasible
+            and self.view.change_counter != self._parked_at_change
+            and time.monotonic() - self._last_park_retry > 0.02
+        ):
+            self._parked_at_change = self.view.change_counter
+            self._last_park_retry = time.monotonic()
+            self._unpark_grantable()
+
+    def _unpark_grantable(self) -> None:
+        """Move parked specs back to pending, capped per resource shape at
+        what the current view could actually grant.
+
+        Re-feeding the ENTIRE parked queue on every capacity-freeing event
+        is O(parked²) aggregate scheduling work under a deep backlog (5k
+        parked specs × ~40 unpark events re-scores ~200k placements to
+        grant 5k) — exactly the storm the reference avoids by leaving
+        unschedulable scheduling classes parked until resources change and
+        retrying them per-class (cluster_lease_manager.cc:298
+        TryScheduleInfeasibleLease + local_lease_manager.h per-class
+        backoff). Here: per shape, estimate grantable slots from the live
+        avail arrays and unpark only that many (+slack for estimate
+        error); the remainder stays parked for the next change event.
+        Constrained specs (strategy / PG / target-node routed) don't fit
+        the shape-capacity math and unpark slack-at-a-time. Caller holds
+        ``self._cond``."""
+        parked = self._infeasible
+        if not parked:
+            return
+        if len(parked) <= self._UNPARK_SLACK:
+            self._pending.extend(parked)
+            self._infeasible = []
+            return
+        with self._lock:
+            _, a0, al0 = self.view.active_arrays()
+            avail = a0.copy()
+            alive = al0.copy()
+        r = avail.shape[1] if avail.ndim == 2 else 0
+        by_shape: Dict[object, List[LeaseRequest]] = {}
+        order: List[object] = []
+        for spec in parked:
+            if (
+                spec.strategy is not None
+                or spec.target_node
+                or spec.pg_reservation
+            ):
+                key: object = None
+            else:
+                key = tuple(sorted(spec.resources.items()))
+            q = by_shape.get(key)
+            if q is None:
+                q = by_shape[key] = []
+                order.append(key)
+            q.append(spec)
+        keep: List[LeaseRequest] = []
+        for key in order:
+            q = by_shape[key]
+            if key is None:
+                cap = self._UNPARK_SLACK
+            else:
+                req = self._spec_req(q[0])
+                if any(c >= r for c in req.demands):
+                    # names a resource no node reported: infeasible until
+                    # the cluster changes shape; slack covers vocab growth
+                    cap = self._UNPARK_SLACK
+                else:
+                    d = req.dense(r)
+                    cols = d > 0
+                    if not cols.any():
+                        cap = len(q)  # zero-demand shape: all grantable
+                    else:
+                        slots = np.floor(
+                            avail[:, cols] / d[cols][None, :]
+                        ).min(axis=1)
+                        slots = np.where(alive, np.maximum(slots, 0.0), 0.0)
+                        cap = int(slots.sum()) + self._UNPARK_SLACK
+            n = min(len(q), cap)
+            self._pending.extend(q[:n])
+            keep.extend(q[n:])
+        self._infeasible = keep
 
     def _pop_fair_batch(self) -> List[LeaseRequest]:
         """Take up to MAX_BATCH leases. When the queue overflows one round,

@@ -106,6 +106,9 @@ class Worker:
             holder=worker_id,
         )
         refcount.install_consumer(self._flusher)
+        # one deserialized fn per fn_id (see _fn_from_blob)
+        self._fn_cache: Dict[str, Any] = {}
+        self._fn_cache_order: deque = deque()
         self.store = None
         if store_path:
             try:
@@ -348,6 +351,27 @@ class Worker:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
+    def _fn_from_blob(self, fn_id: str, blob: bytes, cacheable) -> Any:
+        """Deserialize a task function once per (worker, fn_id).
+
+        Repeated submissions of the same function ship the same blob
+        (client pickles once, _serialize_fn); unpickling it per execution
+        was the executor-side half of that cost. Not cached when the
+        client marked it uncacheable (closure over ObjectRefs: per-call
+        deserialization keeps ref lifetimes per-execution). Small LRU —
+        eviction drops the fn and any refs it holds."""
+        if not cacheable or not fn_id:
+            return cloudpickle.loads(blob)
+        cache = self._fn_cache
+        fn = cache.get(fn_id)
+        if fn is None:
+            fn = cloudpickle.loads(blob)
+            cache[fn_id] = fn
+            self._fn_cache_order.append(fn_id)
+            if len(self._fn_cache_order) > 64:
+                cache.pop(self._fn_cache_order.popleft(), None)
+        return fn
+
     def _resolve(self, args: tuple, kwargs: dict):
         from ray_tpu.core.object_store import ObjectRef
 
@@ -441,7 +465,14 @@ class Worker:
                     out = getattr(instance, method)(*args, **kwargs)
                 result_values = self._split(out, req["return_ids"])
             else:
-                fn, args, kwargs = cloudpickle.loads(req["payload"])
+                fn_blob = req.get("fn_blob")
+                if fn_blob is not None:
+                    fn = self._fn_from_blob(
+                        req.get("fn_id", ""), fn_blob, req.get("fn_cache")
+                    )
+                    args, kwargs = cloudpickle.loads(req["payload"])
+                else:
+                    fn, args, kwargs = cloudpickle.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
                 out = fn(*args, **kwargs)
                 result_values = self._split(out, req["return_ids"])

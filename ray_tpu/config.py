@@ -139,6 +139,15 @@ define(
     "kernel gains for tiny rounds; 0 = always use the device kernels).",
 )
 define(
+    "trace_tasks",
+    True,
+    "Mint a root trace context for every untraced task submission "
+    "(distributed tracing on by default, reference tracing_helper.py "
+    "semantics). Off: only traces opened explicitly via "
+    "util.tracing.start_trace() propagate; untraced submissions pay "
+    "zero minting cost on the hot path.",
+)
+define(
     "native_ledger",
     True,
     "Use the C++ fixed-point resource ledger (vs pure-Python fallback).",

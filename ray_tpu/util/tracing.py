@@ -21,8 +21,10 @@ Here the context is a small dict ``{"trace_id", "span_id"}`` carried in
 from __future__ import annotations
 
 import contextvars
-import os
 from typing import Optional
+
+from ray_tpu._ids import rand_hex
+from ray_tpu.config import cfg
 
 _ctx: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
     "ray_tpu_trace", default=None
@@ -33,10 +35,14 @@ def current() -> Optional[dict]:
     return _ctx.get()
 
 
-def child_context(task_id: str) -> dict:
+def child_context(task_id: str) -> Optional[dict]:
     """Trace context for a task being SUBMITTED now: inherits the ambient
-    trace (nested call) or mints a fresh trace id (tree root). The new
-    task's span id is its task id."""
+    trace (nested call) or — when root minting is enabled
+    (``cfg.trace_tasks``, default on) — mints a fresh trace id (tree
+    root). The new task's span id is its task id. With ``trace_tasks``
+    off, only explicitly-started traces (``start_trace`` or a context
+    installed by an executing traced task) propagate; untraced
+    submissions carry ``None`` and pay zero minting cost."""
     amb = _ctx.get()
     if amb is not None:
         return {
@@ -44,11 +50,22 @@ def child_context(task_id: str) -> dict:
             "span_id": task_id,
             "parent_id": amb["span_id"],
         }
+    if not cfg.trace_tasks:
+        return None
     return {
-        "trace_id": os.urandom(8).hex(),
+        "trace_id": rand_hex(8),
         "span_id": task_id,
         "parent_id": None,
     }
+
+
+def start_trace() -> "object":
+    """Explicitly open a trace at the caller (driver code): submissions
+    made while the returned token is installed share one trace id even
+    when ``cfg.trace_tasks`` is off. Returns a token for ``uninstall``."""
+    return _ctx.set(
+        {"trace_id": rand_hex(8), "span_id": "driver", "parent_id": None}
+    )
 
 
 def install(trace: Optional[dict]):

@@ -1,0 +1,52 @@
+"""Scratch: profile the e2e lease hot path (driver side)."""
+import cProfile
+import pstats
+import sys
+import time
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.runtime import set_runtime
+
+
+def _noop():
+    return None
+
+
+def main(n=3000, profile=True):
+    c = Cluster()
+    c.add_node({"CPU": 16.0}, num_workers=4)
+    c.add_node({"CPU": 16.0}, num_workers=4)
+    client = c.client()
+    set_runtime(client)
+    try:
+        f = ray_tpu.remote(_noop).options(num_cpus=0.25, max_retries=0)
+        ray_tpu.get([f.remote() for _ in range(50)], timeout=60)
+
+        def one_pass(n):
+            t0 = time.perf_counter()
+            refs = [f.remote() for _ in range(n)]
+            for i in range(0, n, 500):
+                ray_tpu.get(refs[i:i + 500], timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        r1 = one_pass(n)
+        if profile:
+            pr = cProfile.Profile()
+            pr.enable()
+            r2 = one_pass(n)
+            pr.disable()
+            st = pstats.Stats(pr)
+            st.sort_stats("cumulative").print_stats(40)
+        else:
+            r2 = one_pass(n)
+        print(f"PASS1 {r1:.1f} tasks/s  PASS2 {r2:.1f} tasks/s")
+        print("HEAD METRICS", dict(c.head.metrics))
+    finally:
+        set_runtime(None)
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 3000,
+         profile="--no-profile" not in sys.argv)

@@ -310,3 +310,35 @@ def test_cancel_queued_task(client):
     assert "cancel" in repr(ei.value).lower()
     # the blockers were running: unaffected, they complete normally
     assert ray_tpu.get(blockers, timeout=60) == [4, 4]
+
+
+def test_repeated_connect_teardown_no_stray_threads(cluster):
+    """Repeated connect/shutdown cycles leave no sender/retry threads
+    behind and raise no unhandled thread exceptions (the r4 suite ended
+    with cannot-schedule-new-futures from the control-item sender racing
+    the channel close; _PipelinedSender.stop now joins first)."""
+    import threading
+
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.cluster.client import connect
+
+    for _ in range(4):
+        rt = connect(cluster.address)
+        set_runtime(rt)
+        try:
+            f = ray_tpu.remote(_square).options(
+                num_cpus=0.5, max_retries=0
+            )
+            assert ray_tpu.get(
+                [f.remote(i) for i in range(8)], timeout=60
+            ) == [i * i for i in range(8)]
+        finally:
+            set_runtime(None)
+            rt.shutdown()
+    time.sleep(0.5)
+    stray = [
+        t.name
+        for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith("lease-pipeline")
+    ]
+    assert not stray, stray
