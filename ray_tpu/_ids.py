@@ -23,6 +23,18 @@ class _Buf(threading.local):
 
 _buf = _Buf()
 
+# fork safety: a forked child inherits the parent's unconsumed buffer and
+# would mint byte-identical ids (os.urandom per call was fork-safe; the
+# pool is not). Discard the inherited bytes in the child.
+
+
+def _reset_after_fork() -> None:
+    _buf.data = b""
+    _buf.pos = 0
+
+
+os.register_at_fork(after_in_child=_reset_after_fork)
+
 
 def rand_hex(nbytes: int) -> str:
     """Hex string of ``nbytes`` random bytes (2*nbytes chars)."""

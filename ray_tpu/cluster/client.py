@@ -111,7 +111,7 @@ class _RemoteStore:
                 if (
                     all(h.hex in self._rt._direct_pending for h in pending)
                     and time.monotonic() - t_start
-                    < cfg.direct_wait_fallback_s
+                    < self._rt._direct_wait_fallback_s
                 ):
                     wait_s = 0.2
                     if deadline is not None:
@@ -495,6 +495,11 @@ class RemoteRuntime:
         from ray_tpu.config import cfg
 
         self._direct_enabled = cfg.direct_actor_calls
+        # hot-path cfg snapshot: these flags are read per submission /
+        # per awaited ref, and cfg reads consult os.environ live. Set the
+        # env before connect() to change them for a runtime.
+        self._trace_autostart = cfg.trace_tasks
+        self._direct_wait_fallback_s = cfg.direct_wait_fallback_s
         # one cloudpickle of each task function per function OBJECT (weak:
         # dead lambdas drop their blobs); see _serialize_fn
         import weakref
@@ -597,7 +602,9 @@ class RemoteRuntime:
         self._flush_deferred_seals(arg_ids)
         from ray_tpu.util import tracing
 
-        trace = spec.trace or tracing.child_context(spec.task_id)
+        trace = spec.trace or tracing.child_context(
+            spec.task_id, self._trace_autostart
+        )
         lease = LeaseRequest(
             task_id=spec.task_id,
             name=spec.name,
@@ -655,7 +662,7 @@ class RemoteRuntime:
                 "client_id": self.client_id,
                 "name": f"{actor_id[:8]}.{method}",
                 "arg_ids": ids,
-                "trace": tracing.child_context(tid),
+                "trace": tracing.child_context(tid, self._trace_autostart),
             }
             # pin every arg (incl. refs nested in containers) until the
             # result lands: the worker registers its borrows synchronously
@@ -929,9 +936,7 @@ class RemoteRuntime:
         # a direct result push can be lost (transient caller-side RPC
         # failure); the seal still reaches the head, so after this long a
         # getter stops trusting the push channel and resolves there
-        from ray_tpu.config import cfg
-
-        give_up = time.monotonic() + cfg.direct_wait_fallback_s
+        give_up = time.monotonic() + self._direct_wait_fallback_s
         with self._direct_cv:
             while True:
                 if h in self._direct_results:

@@ -35,7 +35,7 @@ def current() -> Optional[dict]:
     return _ctx.get()
 
 
-def child_context(task_id: str) -> Optional[dict]:
+def child_context(task_id: str, autostart: Optional[bool] = None) -> Optional[dict]:
     """Trace context for a task being SUBMITTED now: inherits the ambient
     trace (nested call) or — when root minting is enabled
     (``cfg.trace_tasks``, default on) — mints a fresh trace id (tree
@@ -50,7 +50,10 @@ def child_context(task_id: str) -> Optional[dict]:
             "span_id": task_id,
             "parent_id": amb["span_id"],
         }
-    if not cfg.trace_tasks:
+    # ``autostart`` lets hot callers pass a cached copy of the flag: the
+    # cfg read consults os.environ live, measurable per-call at thousands
+    # of submissions per second
+    if not (cfg.trace_tasks if autostart is None else autostart):
         return None
     return {
         "trace_id": rand_hex(8),

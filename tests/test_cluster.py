@@ -322,6 +322,13 @@ def test_repeated_connect_teardown_no_stray_threads(cluster):
     from ray_tpu.core.runtime import set_runtime
     from ray_tpu.cluster.client import connect
 
+    # the module-scoped client fixture legitimately keeps ITS sender
+    # thread alive for the whole module: assert no NEW ones appear
+    before = {
+        id(t)
+        for t in threading.enumerate()
+        if t.name.startswith("lease-pipeline")
+    }
     for _ in range(4):
         rt = connect(cluster.address)
         set_runtime(rt)
@@ -339,6 +346,8 @@ def test_repeated_connect_teardown_no_stray_threads(cluster):
     stray = [
         t.name
         for t in threading.enumerate()
-        if t.is_alive() and t.name.startswith("lease-pipeline")
+        if t.is_alive()
+        and t.name.startswith("lease-pipeline")
+        and id(t) not in before
     ]
     assert not stray, stray
