@@ -229,6 +229,7 @@ class NodeAgent:
             "GetObjectForWorker": self._h_get_object_for_worker,
             "WorkerPut": self._h_worker_put,
             "WorkerSealed": self._h_worker_sealed,
+            "StreamConsumed": self._h_stream_consumed,
             "RegisterWorker": self._h_register_worker,
             "TaskDone": self._h_task_done,
             "TaskDoneBatch": lambda reqs: [
@@ -1095,6 +1096,8 @@ class NodeAgent:
             "fn_blob": spec.fn_blob,
             "fn_id": spec.fn_id,
             "fn_cache": spec.fn_cache,
+            "streaming": spec.streaming,
+            "client_id": spec.client_id,
             "retry_exceptions": (
                 spec.retry_exceptions and spec.attempt < spec.max_retries
             ),
@@ -1361,11 +1364,18 @@ class NodeAgent:
                 self.store.note_external(s.object_id, s.size)
 
     def _h_worker_sealed(self, req: dict) -> None:
-        """Out-of-band seal from a worker (ray_tpu.put inside a task)."""
+        """Out-of-band seal from a worker (ray_tpu.put inside a task,
+        async-actor results, streaming-generator items)."""
         self._note_seals(req["seals"])
-        self._report_to_head(
-            {"node_id": self.node_id, "seals": req["seals"]}
-        )
+        report = {"node_id": self.node_id, "seals": req["seals"]}
+        for k in ("stream", "stream_done"):
+            if req.get(k):
+                report[k] = req[k]
+        self._report_to_head(report)
+
+    def _h_stream_consumed(self, req: dict) -> dict:
+        """Worker backpressure poll, relayed to the head's watermark."""
+        return self.head.call("StreamConsumed", req, timeout=10.0)
 
     def _h_get_object_for_worker(self, req: dict) -> dict:
         """Local miss → pull from a remote node (PullManager analog,

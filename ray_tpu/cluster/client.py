@@ -343,12 +343,31 @@ def _rebuild_actor_handle(actor_id: str, cls: type):
 
 
 class _RemoteMethod:
-    def __init__(self, runtime: "RemoteRuntime", actor_id: str, method: str):
+    def __init__(
+        self,
+        runtime: "RemoteRuntime",
+        actor_id: str,
+        method: str,
+        num_returns=1,
+    ):
         self._runtime = runtime
         self._actor_id = actor_id
         self._method = method
+        self._num_returns = num_returns
 
-    def remote(self, *args, **kwargs) -> ObjectRef:
+    def options(self, num_returns=None, **_ignored) -> "_RemoteMethod":
+        return _RemoteMethod(
+            self._runtime,
+            self._actor_id,
+            self._method,
+            num_returns or self._num_returns,
+        )
+
+    def remote(self, *args, **kwargs):
+        if self._num_returns == "streaming":
+            return self._runtime.submit_actor_method_streaming(
+                self._actor_id, self._method, args, kwargs
+            )
         return self._runtime.submit_actor_method(
             self._actor_id, self._method, args, kwargs
         )
@@ -515,6 +534,8 @@ class RemoteRuntime:
         self._direct_results_order: deque = deque()
         self._direct_results_cap = cfg.direct_results_cap
         self._direct_pending: Dict[str, str] = {}  # hex -> actor_id
+        # streaming generators: task_id -> (base_index, [item ids], done)
+        self._stream_cache: Dict[str, tuple] = {}
         self._direct_arg_pins: Dict[str, List[str]] = {}  # hex -> arg ids
         # owner-held results (cfg.direct_deferred_seals): hex -> contained
         # ids; the head learns about these objects only on share/evict
@@ -627,10 +648,73 @@ class RemoteRuntime:
             fn_blob=fn_blob,
             fn_id=fn_id,
             fn_cache=fn_cacheable,
+            streaming=bool(getattr(spec, "streaming", False)),
         )
         self._sender.enqueue("lease", lease)
         self._flusher.note_registered(lease.return_ids)
         return spec.returns
+
+    def stream_next(
+        self, task_id: str, index: int, timeout: Optional[float]
+    ) -> Optional[ObjectRef]:
+        """Long-poll the head for item ``index`` of a streaming-generator
+        task (ObjectRefGenerator backend). None = stream ended before it.
+        The ``after`` watermark doubles as the consumption ack that frees
+        the executor's backpressure window."""
+        cached = self._stream_cache.get(task_id)
+        if cached is not None:
+            base, ids, done = cached
+            k = index - base
+            if 0 <= k < len(ids):
+                return ObjectRef(ids[k], owner=self.client_id)
+            if done and k >= len(ids):
+                self._stream_cache.pop(task_id, None)
+                return None
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            wait_s = 2.0
+            if deadline is not None:
+                wait_s = min(wait_s, deadline - time.monotonic())
+                if wait_s <= 0:
+                    raise GetTimeoutError(
+                        f"stream {task_id} item {index} not ready"
+                    )
+            reply = self._read(
+                "WaitStream",
+                {
+                    "task_id": task_id,
+                    "after": index,
+                    "timeout": wait_s,
+                    "holder": self.client_id,
+                },
+                timeout=wait_s + 15.0,
+            )
+            items = reply.get("items") or []
+            done = bool(reply.get("done"))
+            if items:
+                # one long-poll returns every ready item; serve the rest
+                # of the burst from this cache instead of an RPC per item.
+                # Bounded: abandoned generators clear their entry via
+                # stream_abandon; the cap catches pathological churn.
+                if len(self._stream_cache) > 256:
+                    self._stream_cache.pop(
+                        next(iter(self._stream_cache)), None
+                    )
+                self._stream_cache[task_id] = (index, items, done)
+                return ObjectRef(items[0], owner=self.client_id)
+            if done:
+                self._stream_cache.pop(task_id, None)
+                return None
+
+    def stream_abandon(self, task_id: str) -> None:
+        """Best-effort consumer-drop notice (ObjectRefGenerator.__del__)."""
+        self._stream_cache.pop(task_id, None)
+        try:
+            self.head.call("StreamAbandon", {"task_id": task_id}, timeout=5.0)
+        except RpcError:
+            pass
 
     def submit_actor_method(
         self, actor_id: str, method: str, args: tuple, kwargs: dict
@@ -701,22 +785,50 @@ class RemoteRuntime:
         actor_id: str,
         name: str,
         payload: bytes,
-        return_id: str,
+        return_id: Optional[str],
         arg_ids: List[str],
+        streaming: bool = False,
     ) -> None:
         lease = LeaseRequest(
             task_id=task_id,
             name=name,
             payload=payload,
-            return_ids=[return_id],
+            return_ids=[return_id] if return_id else [],
             resources={},
             kind="actor_method",
             actor_id=actor_id,
             max_retries=0,
             arg_ids=arg_ids,
             client_id=self.client_id,
+            streaming=streaming,
         )
         self._sender.enqueue("lease", lease)
+
+    def submit_actor_method_streaming(
+        self, actor_id: str, method: str, args: tuple, kwargs: dict
+    ):
+        """num_returns="streaming" actor method: always the head-scheduled
+        lease path (the direct channel replies once per call; a stream
+        needs the per-item seal plumbing), yielding an
+        ObjectRefGenerator like a streaming task."""
+        from ray_tpu.core.object_store import ObjectRefGenerator
+        from ray_tpu.core.refcount import collect_serialized
+
+        with collect_serialized() as arg_ids:
+            payload = cloudpickle.dumps((method, args, kwargs))
+        if arg_ids:
+            self._flush_deferred_seals(arg_ids)
+        tid = new_id()
+        self._submit_actor_lease(
+            task_id=tid,
+            actor_id=actor_id,
+            name=f"{actor_id[:8]}.{method}",
+            payload=payload,
+            return_id=None,
+            arg_ids=sorted(arg_ids),
+            streaming=True,
+        )
+        return ObjectRefGenerator(tid, self)
 
     # ---- direct-call plumbing ----------------------------------------
     def _callback_address(self) -> str:

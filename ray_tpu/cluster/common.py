@@ -29,6 +29,19 @@ def new_id() -> str:
     return rand_hex(8)  # buffered urandom: no syscall per id
 
 
+def stream_item_id(task_id: str, index: int) -> str:
+    """Deterministic object id for item ``index`` of a streaming-generator
+    task. Determinism is the recovery story: a retried generator re-seals
+    the SAME ids, so refs a consumer already iterated resolve to the
+    re-executed copies (the reference derives generator return ids from
+    task id + return index the same way)."""
+    import hashlib
+
+    return hashlib.blake2b(
+        f"{task_id}:{index}".encode(), digest_size=14
+    ).hexdigest()
+
+
 @dataclass
 class NodeInfo:
     node_id: str
@@ -90,6 +103,12 @@ class LeaseRequest:
     fn_blob: Optional[bytes] = None
     fn_id: str = ""
     fn_cache: bool = True
+    # num_returns="streaming": the executor yields N results incrementally;
+    # each is sealed as its own object under a DETERMINISTIC id
+    # (stream_item_id), the head tracks per-stream item order/done state,
+    # and the caller iterates an ObjectRefGenerator
+    # (object_ref_generator.py / _raylet.pyx:246 analog)
+    streaming: bool = False
 
     def __getstate__(self):
         # head-side scheduling memos (e.g. _req_cache) never ride the wire

@@ -48,6 +48,7 @@ from .common import (
     NodeReport,
     SealInfo,
     new_id,
+    stream_item_id,
 )
 from .rpc import RpcClient, RpcError, RpcServer
 
@@ -166,6 +167,16 @@ class HeadServer:
         # (the round already popped them out of every scannable queue)
         self._cancelled_leases: set = set()
         self._in_flight: Dict[str, Tuple[LeaseRequest, str]] = {}
+        # streaming-generator state: task_id -> {"items": [hex...],
+        # "done": bool, "consumed": int, "touched": monotonic}
+        # (object_ref_generator.py analog; items arrive via ReportSeals
+        # "stream" entries, consumers long-poll WaitStream)
+        self._streams: Dict[str, dict] = {}
+        self._stream_cv = threading.Condition()
+        # drained/GC'd stream ids: a late WaitStream reads "done" instead
+        # of parking forever on a stream that will never reappear
+        self._stream_tombstones: set = set()
+        self._stream_tombstone_order: deque = deque()
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
         self._named_actors: Dict[str, str] = {}
@@ -222,6 +233,9 @@ class HeadServer:
             "WaitObject": self._h_wait_object,
             "LocateObjects": self._h_locate_objects,
             "WaitObjectBatch": self._h_wait_object_batch,
+            "WaitStream": self._h_wait_stream,
+            "StreamConsumed": self._h_stream_consumed,
+            "StreamAbandon": self._h_stream_abandon,
             "FreeObjects": self._h_free_objects,
             "RefUpdate": lambda r: self._h_ref_update(r, src="direct"),
             "CreateActor": self._h_create_actor,
@@ -549,6 +563,7 @@ class HeadServer:
             for nid in dead:
                 logger.warning("node %s missed health checks; marking dead", nid)
                 self._on_node_death(nid)
+            self._gc_idle_streams()
 
     def _on_node_death(self, node_id: str) -> None:
         with self._cond:
@@ -590,6 +605,10 @@ class HeadServer:
     def _retry_or_fail(self, spec: LeaseRequest, reason: str) -> None:
         if spec.kind == "actor_method":
             self._seal_error_ids(spec.return_ids, RuntimeError(reason))
+            if spec.streaming:
+                # streaming methods have no return ids; without this the
+                # consumer's WaitStream long-poll would never end
+                self._fail_stream(spec, reason)
             self._release_lease_pins(spec.task_id)
             return
         if spec.attempt < spec.max_retries:
@@ -601,6 +620,8 @@ class HeadServer:
                 self._cond.notify_all()
         else:
             self._seal_error_ids(spec.return_ids, RuntimeError(reason))
+            if spec.streaming:
+                self._fail_stream(spec, reason)
             self._release_lease_pins(spec.task_id)
 
     def _recover_object(
@@ -779,6 +800,12 @@ class HeadServer:
         if req.get("borrows"):
             self._apply_borrows(req["borrows"])
         self._apply_seals(req.get("seals", []))
+        # stream entries AFTER their seals (same report): an item is only
+        # announced once its object is resolvable
+        if req.get("stream"):
+            self._apply_stream_items(req["stream"])
+        if req.get("stream_done"):
+            self._apply_stream_done(req["stream_done"])
         if req.get("finished"):
             self._finish_leases(req["finished"])
         for holder in req.get("holders_gone", []):
@@ -813,6 +840,198 @@ class HeadServer:
             info = self._actors.get(actor_dead["actor_id"])
             if info is not None:
                 self._restart_or_kill_actor(info, actor_dead.get("reason", ""))
+
+    # ------------------------------------------------------------------
+    # streaming generators (object_ref_generator.py analog)
+    # ------------------------------------------------------------------
+    def _stream_state(self, task_id: str) -> dict:
+        """Caller holds self._stream_cv."""
+        st = self._streams.get(task_id)
+        if st is None:
+            st = self._streams[task_id] = {
+                "items": [],
+                "done": False,
+                "consumed": 0,
+                "delivered": 0,  # holder-registration watermark
+                "touched": time.monotonic(),
+            }
+        return st
+
+    def _tombstone_stream(self, task_id: str) -> None:
+        """Caller holds self._stream_cv."""
+        if task_id not in self._stream_tombstones:
+            self._stream_tombstones.add(task_id)
+            self._stream_tombstone_order.append(task_id)
+            while len(self._stream_tombstone_order) > 4096:
+                self._stream_tombstones.discard(
+                    self._stream_tombstone_order.popleft()
+                )
+
+    def _apply_stream_items(self, items: List[dict]) -> None:
+        with self._stream_cv:
+            for it in items:
+                st = self._stream_state(it["task_id"])
+                idx = it["index"]
+                if idx == len(st["items"]):
+                    st["items"].append(it["object_id"])
+                # idx < len: a retried executor re-announced an item —
+                # the re-seal already refreshed its location; nothing to do
+                st["touched"] = time.monotonic()
+            self._stream_cv.notify_all()
+
+    def _apply_stream_done(self, dones: List[dict]) -> None:
+        with self._stream_cv:
+            for d in dones:
+                st = self._stream_state(d["task_id"])
+                err = d.get("error")
+                if err is not None and not st["done"]:
+                    # mid-stream task failure: the next ref raises
+                    oid = stream_item_id(d["task_id"], len(st["items"]))
+                    self._apply_seals(
+                        [
+                            SealInfo(
+                                object_id=oid,
+                                node_id="",
+                                is_error=True,
+                                error=err,
+                            )
+                        ]
+                    )
+                    st["items"].append(oid)
+                st["done"] = True
+                st["touched"] = time.monotonic()
+            self._stream_cv.notify_all()
+
+    def _fail_stream(self, spec: LeaseRequest, reason: str) -> None:
+        """Lease-level failure (worker/node death, retries exhausted)."""
+        import pickle as _pickle
+
+        self._apply_stream_done(
+            [
+                {
+                    "task_id": spec.task_id,
+                    "error": _pickle.dumps(RuntimeError(reason)),
+                }
+            ]
+        )
+
+    def _h_wait_stream(self, req: dict) -> dict:
+        """Consumer long-poll for items past ``after``; ``after`` is also
+        the consumption watermark that frees the executor's backpressure
+        window (StreamConsumed)."""
+        task_id = req["task_id"]
+        after = int(req.get("after", 0))
+        deadline = time.monotonic() + min(float(req.get("timeout", 2.0)), 30.0)
+        with self._stream_cv:
+            if task_id in self._stream_tombstones:
+                # drained or GC'd: definitively over
+                return {"items": [], "done": True}
+            st = self._streams.get(task_id)
+            if st is None:
+                # not yet known: the pipelined lease submission (or the
+                # first item) may still be in flight — wait for it
+                while st is None:
+                    wait_s = deadline - time.monotonic()
+                    if wait_s <= 0:
+                        return {"items": [], "done": False}
+                    self._stream_cv.wait(timeout=min(wait_s, 0.5))
+                    if task_id in self._stream_tombstones:
+                        return {"items": [], "done": True}
+                    st = self._streams.get(task_id)
+            st["consumed"] = max(st["consumed"], after)
+            st["touched"] = time.monotonic()
+            self._stream_cv.notify_all()  # executor credit poll may wait
+            while len(st["items"]) <= after and not st["done"]:
+                wait_s = deadline - time.monotonic()
+                if wait_s <= 0:
+                    return {"items": [], "done": False}
+                self._stream_cv.wait(timeout=min(wait_s, 0.5))
+            items = st["items"][after:]
+            done = st["done"]
+            # holder registration is watermarked so an at-least-once
+            # retried WaitStream can't double-count the consumer
+            holder = req.get("holder")
+            fresh = (
+                st["items"][st["delivered"]:] if holder else []
+            )
+            st["delivered"] = max(st["delivered"], len(st["items"]))
+            if done and st["consumed"] >= len(st["items"]) and not items:
+                # fully drained: the generator saw StopIteration
+                self._streams.pop(task_id, None)
+                self._tombstone_stream(task_id)
+        if fresh:
+            # the consumer holds live refs the moment the reply lands;
+            # count it as holder BEFORE replying so nothing frees the
+            # items in between
+            with self._lock:
+                for oid in fresh:
+                    self._add_holder(oid, holder)
+        return {"items": items, "done": done}
+
+    def _h_stream_consumed(self, req: dict) -> dict:
+        """Executor credit poll. Long-polls until the consumer watermark
+        moves past ``after_consumed`` (or the stream is abandoned) so a
+        backpressured executor parks one request instead of spinning
+        20 RPC/s through its agent."""
+        after = req.get("after_consumed")
+        deadline = time.monotonic() + min(
+            float(req.get("timeout", 0.0) or 0.0), 30.0
+        )
+        with self._stream_cv:
+            while True:
+                st = self._streams.get(req["task_id"])
+                if st is None:
+                    # unknown/GC'd: report infinite credit so the executor
+                    # can finish (its items free through normal GC)
+                    return {"consumed": 1 << 62, "abandoned": True}
+                if st.get("abandoned"):
+                    return {"consumed": 1 << 62, "abandoned": True}
+                if after is None or st["consumed"] > after:
+                    return {"consumed": st["consumed"], "abandoned": False}
+                wait_s = deadline - time.monotonic()
+                if wait_s <= 0:
+                    return {"consumed": st["consumed"], "abandoned": False}
+                self._stream_cv.wait(timeout=min(wait_s, 0.5))
+
+    def _h_stream_abandon(self, req: dict) -> None:
+        """Best-effort consumer-drop notice (ObjectRefGenerator.__del__):
+        opens the executor's window so it can't wedge on backpressure,
+        and makes the stream eligible for idle GC."""
+        with self._stream_cv:
+            st = self._streams.get(req["task_id"])
+            if st is not None:
+                st["abandoned"] = True
+                st["done"] = True  # idle GC reclaims it
+                st["touched"] = time.monotonic() - 0.0
+                self._stream_cv.notify_all()
+
+    def _gc_idle_streams(self) -> None:
+        """Abandoned finished streams: drop state after cfg.stream_idle_gc_s
+        (their sealed items remain normal ref-counted objects; the
+        submitting client's holds release through the usual paths)."""
+        ttl = cfg.stream_idle_gc_s
+        now = time.monotonic()
+        undelivered: List[str] = []
+        with self._stream_cv:
+            dead = [
+                tid
+                for tid, st in self._streams.items()
+                if st["done"] and now - st["touched"] > ttl
+            ]
+            for tid in dead:
+                st = self._streams.pop(tid)
+                self._tombstone_stream(tid)
+                undelivered.extend(st["items"][st["delivered"]:])
+        if undelivered:
+            # never-delivered items have no holder (delivery is what
+            # registers the consumer); mark tracked so the normal free
+            # path reclaims them
+            with self._lock:
+                for oid in undelivered:
+                    e = self._objects.get(oid)
+                    if e is not None:
+                        e.tracked = True
+            self._maybe_free_many(undelivered)
 
     def _seal_error_ids(self, object_ids: List[str], exc: BaseException) -> None:
         blob = pickle.dumps(exc)
@@ -1143,6 +1362,12 @@ class HeadServer:
     # ------------------------------------------------------------------
     def _h_submit_lease(self, spec: LeaseRequest) -> dict:
         self._register_return_holder(spec)
+        if spec.streaming:
+            # the stream exists from submission: a consumer's WaitStream
+            # can land before the first item (or even before dispatch)
+            with self._stream_cv:
+                self._stream_state(spec.task_id)
+                self._stream_cv.notify_all()
         with self._cond:
             self._leases[spec.task_id] = spec
             self.metrics["leases_submitted"] += 1

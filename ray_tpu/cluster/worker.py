@@ -24,6 +24,8 @@ import threading
 import time
 import traceback
 from collections import deque
+
+_STREAM_END = object()  # generator-exhausted sentinel (values can be None)
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -109,6 +111,9 @@ class Worker:
         # one deserialized fn per fn_id (see _fn_from_blob)
         self._fn_cache: Dict[str, Any] = {}
         self._fn_cache_order: deque = deque()
+        # streaming-generator announcements, flushed with direct seals
+        self._stream_reports: list = []
+        self._stream_done_reports: list = []
         self.store = None
         if store_path:
             try:
@@ -351,6 +356,93 @@ class Worker:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
+    def _run_streaming_task(self, req: dict, fn, args, kwargs) -> None:
+        """Drive a ``num_returns="streaming"`` task (_raylet.pyx:246
+        streaming-generator execution analog): each yield seals under
+        stream_item_id(task_id, i) and is announced to the head through
+        the async seal path; the executor pauses once it is
+        cfg.streaming_window items ahead of the consumer's watermark
+        (generator backpressure). ANY user-code exception — in the call
+        itself or mid-iteration — seals an error item so the consumer's
+        next ref raises, then ends the stream."""
+        from ray_tpu.cluster.common import stream_item_id
+        from ray_tpu.config import cfg
+
+        window = max(1, int(cfg.streaming_window))
+        tid = req["task_id"]
+        idx = 0
+        try:
+            gen = fn(*args, **kwargs)
+            if not hasattr(gen, "__next__"):
+                gen = iter(gen)
+        except BaseException as exc:  # noqa: BLE001 - errors are values
+            self._end_stream(req, 0, exc)
+            return
+        consumed = 0
+        while True:
+            try:
+                value = next(gen, _STREAM_END)
+            except BaseException as exc:  # noqa: BLE001 - errors are values
+                self._end_stream(req, idx, exc)
+                return
+            if value is _STREAM_END:
+                self._end_stream(req, idx, None)
+                return
+            while idx - consumed >= window:
+                try:
+                    reply = self.agent.call(
+                        "StreamConsumed",
+                        {
+                            "task_id": tid,
+                            "after_consumed": consumed,
+                            "timeout": 5.0,
+                        },
+                        timeout=20.0,
+                    )
+                except RpcError:
+                    time.sleep(0.5)
+                    continue
+                consumed = reply["consumed"]
+                if reply.get("abandoned"):
+                    # consumer dropped the generator: stop producing
+                    try:
+                        gen.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._end_stream(req, idx, None)
+                    return
+            oid = stream_item_id(tid, idx)
+            seal = self.put_value(oid, value)
+            with self._direct_seal_cv:
+                self._direct_seals.append(seal)
+                self._stream_reports.append(
+                    {"task_id": tid, "index": idx, "object_id": oid}
+                )
+                self._direct_seal_cv.notify()
+            idx += 1
+
+    def _end_stream(self, req: dict, count: int, exc) -> None:
+        done: dict = {"task_id": req["task_id"], "count": count}
+        if exc is not None:
+            from ray_tpu.core.object_store import TaskError
+
+            tb = traceback.format_exc()
+            err = TaskError(exc, req["name"], traceback_str=tb)
+            err.__cause__ = exc
+            try:
+                done["error"] = cloudpickle.dumps(err)
+            except Exception:  # noqa: BLE001 - unpicklable exception
+                done["error"] = cloudpickle.dumps(
+                    TaskError(
+                        RuntimeError(repr(exc)),
+                        req["name"],
+                        traceback_str=tb,
+                    )
+                )
+        with self._direct_seal_cv:
+            self._stream_done_reports.append(done)
+            self._direct_seal_cv.notify()
+
     def _fn_from_blob(self, fn_id: str, blob: bytes, cacheable) -> Any:
         """Deserialize a task function once per (worker, fn_id).
 
@@ -435,7 +527,19 @@ class Worker:
                 aid = req["actor_id"]
                 instance = self._actors[aid]
                 entry = self._actor_loops.get(aid)
-                if entry is not None:
+                if entry is not None and req.get("streaming"):
+                    # async actors reply per-call through their event
+                    # loop; the per-item stream plumbing is sync-only
+                    self._end_stream(
+                        req,
+                        0,
+                        TypeError(
+                            "num_returns='streaming' is not supported on "
+                            "async actors; use a sync actor or a task"
+                        ),
+                    )
+                    result_values = []
+                elif entry is not None:
                     # asyncio actor: schedule on the actor's loop and reply
                     # "async_pending" NOW — the outcome goes back to the
                     # agent via TaskDone when the coroutine finishes. No
@@ -457,13 +561,22 @@ class Worker:
                         )
                     )
                     return {"status": "async_pending"}
-                dag_lock = self._dag_actor_locks.get(aid)
-                if dag_lock is not None:
-                    with dag_lock:
-                        out = getattr(instance, method)(*args, **kwargs)
+                if req.get("streaming"):
+                    # sync actors only (an async actor's loop replies
+                    # async_pending above and never reaches here with
+                    # streaming — guarded by the lease route)
+                    self._run_streaming_task(
+                        req, getattr(instance, method), args, kwargs
+                    )
+                    result_values = []
                 else:
-                    out = getattr(instance, method)(*args, **kwargs)
-                result_values = self._split(out, req["return_ids"])
+                    dag_lock = self._dag_actor_locks.get(aid)
+                    if dag_lock is not None:
+                        with dag_lock:
+                            out = getattr(instance, method)(*args, **kwargs)
+                    else:
+                        out = getattr(instance, method)(*args, **kwargs)
+                    result_values = self._split(out, req["return_ids"])
             else:
                 fn_blob = req.get("fn_blob")
                 if fn_blob is not None:
@@ -474,8 +587,15 @@ class Worker:
                 else:
                     fn, args, kwargs = cloudpickle.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
-                out = fn(*args, **kwargs)
-                result_values = self._split(out, req["return_ids"])
+                if req.get("streaming"):
+                    # owns ALL user-code exceptions (sealed as the final
+                    # stream item) — a raise here would end the lease
+                    # without a stream-done marker and hang the consumer
+                    self._run_streaming_task(req, fn, args, kwargs)
+                    result_values = []
+                else:
+                    out = fn(*args, **kwargs)
+                    result_values = self._split(out, req["return_ids"])
         except BaseException as exc:  # noqa: BLE001 - errors are values
             return self._error_reply(req, exc)
         finally:
@@ -1074,15 +1194,26 @@ class Worker:
     def _direct_seal_loop(self) -> None:
         while True:
             with self._direct_seal_cv:
-                while not self._direct_seals:
+                while not (
+                    self._direct_seals
+                    or self._stream_reports
+                    or self._stream_done_reports
+                ):
                     self._direct_seal_cv.wait(timeout=1.0)
                 seals = self._direct_seals
                 self._direct_seals = []
+                stream = self._stream_reports
+                self._stream_reports = []
+                stream_done = self._stream_done_reports
+                self._stream_done_reports = []
+            msg = {"seals": seals}
+            if stream:
+                msg["stream"] = stream
+            if stream_done:
+                msg["stream_done"] = stream_done
             while True:
                 try:
-                    self.agent.call(
-                        "WorkerSealed", {"seals": seals}, timeout=30.0
-                    )
+                    self.agent.call("WorkerSealed", msg, timeout=30.0)
                     break
                 except RpcError:
                     # a dropped seal would orphan the object in the head's

@@ -139,6 +139,19 @@ define(
     "kernel gains for tiny rounds; 0 = always use the device kernels).",
 )
 define(
+    "streaming_window",
+    128,
+    "num_returns='streaming' backpressure: max items an executor seals "
+    "ahead of the consumer's watermark before pausing (the reference's "
+    "_generator_backpressure_num_objects analog).",
+)
+define(
+    "stream_idle_gc_s",
+    600.0,
+    "Head-side GC: a finished stream untouched this long is dropped and "
+    "its undelivered item holds released (abandoned-generator cleanup).",
+)
+define(
     "trace_tasks",
     True,
     "Mint a root trace context for every untraced task submission "

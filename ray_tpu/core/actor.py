@@ -435,6 +435,12 @@ class ActorHandle:
         return ActorMethod(self, name, opts.get("num_returns", 1))
 
     def _invoke(self, method_name, args, kwargs, num_returns):
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                "num_returns='streaming' on actor methods requires the "
+                "cluster runtime (ray_tpu.init(address=...) or Cluster()); "
+                "the in-process runtime streams from tasks only"
+            )
         refs = [ObjectRef.new(owner=self._actor_id) for _ in range(num_returns)]
         for r in refs:
             self._runtime.store.create(r)

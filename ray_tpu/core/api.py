@@ -300,14 +300,21 @@ class RemoteFunction:
         return RemoteFunction(self._fn, merged)
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._ids import rand_hex
+
         rt = get_runtime()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         ctx = get_context()
         owner = ctx.task_id or "driver"
-        refs = [ObjectRef.new(owner=owner) for _ in range(num_returns)]
+        refs = (
+            []
+            if streaming
+            else [ObjectRef.new(owner=owner) for _ in range(num_returns)]
+        )
         spec = TaskSpec(
-            task_id=uuid.uuid4().hex[:16],
+            task_id=rand_hex(8),
             func=self._fn,
             args=args,
             kwargs=kwargs,
@@ -318,8 +325,13 @@ class RemoteFunction:
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             runtime_env=opts.get("runtime_env"),
+            streaming=streaming,
         )
         rt.submit(spec)
+        if streaming:
+            from ray_tpu.core.object_store import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, rt)
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

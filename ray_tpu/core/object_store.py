@@ -396,3 +396,65 @@ def _now() -> float:
     import time
 
     return time.monotonic()
+
+
+class ObjectRefGenerator:
+    """Iterator over the incrementally-produced returns of a
+    ``num_returns="streaming"`` task (object_ref_generator.py /
+    _raylet.pyx:246 analog).
+
+    Each ``__next__`` blocks until the executor has sealed the next item,
+    then returns its ``ObjectRef`` — normal object-plane semantics apply
+    (``ray_tpu.get``, ``ray_tpu.wait``, passing to other tasks, GC on
+    drop, recovery through the deterministic item ids on executor
+    retry). A task exception surfaces as a final ref whose ``get()``
+    raises (reference semantics); iteration then stops. The runtime is
+    duck-typed: both the in-process runtime and the cluster client
+    implement ``stream_next(task_id, index, timeout)``.
+    """
+
+    def __init__(self, task_id: str, runtime):
+        self._task_id = task_id
+        self._rt = runtime
+        self._index = 0
+        self._exhausted = False
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        ref = self._rt.stream_next(self._task_id, self._index, None)
+        if ref is None:
+            self._exhausted = True
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def next_ref(self, timeout: Optional[float] = None) -> "ObjectRef":
+        """``__next__`` with a timeout (raises GetTimeoutError)."""
+        ref = self._rt.stream_next(self._task_id, self._index, timeout)
+        if ref is None:
+            self._exhausted = True
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def __del__(self):
+        # consumer dropped the generator mid-stream: tell the runtime so
+        # the executor's backpressure window opens (it would otherwise
+        # wedge forever waiting for a watermark that can't move) and the
+        # stream state becomes GC-eligible. Best-effort: interpreter
+        # teardown may have already dismantled the runtime.
+        if self._exhausted:
+            return
+        try:
+            self._rt.stream_abandon(self._task_id)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"ObjectRefGenerator({self._task_id}, at={self._index})"

@@ -45,12 +45,20 @@ from ray_tpu.scheduler import (
     ResourceVocab,
     hybrid_schedule_reference,
 )
-from .object_store import ObjectRef, ObjectStore, TaskError
+from .object_store import GetTimeoutError, ObjectRef, ObjectStore, TaskError
 
 logger = logging.getLogger("ray_tpu")
 
 # Leases per scheduling round (the batching that makes the TPU kernel pay).
 MAX_SCHEDULE_BATCH = 1024
+
+_STREAM_END = object()  # generator-exhausted sentinel (values can be None)
+
+
+def _now() -> float:
+    import time
+
+    return time.monotonic()
 
 
 class ActorDiedError(Exception):
@@ -89,6 +97,10 @@ class TaskSpec:
     trace: Optional[dict] = None
     # return object ids; a slot is None once that output has been freed
     return_ids: List[Optional[str]] = field(default_factory=list)
+    # num_returns="streaming": executor iterates the function's generator,
+    # sealing each yield under stream_item_id(task_id, i); the caller
+    # consumes an ObjectRefGenerator
+    streaming: bool = False
 
 
 @dataclass
@@ -169,6 +181,11 @@ class Runtime:
         self._lazy_device = LazyDeviceState(use_device_scheduler)
         self._parked_at_change = -1
         self._rng = np.random.default_rng(0)
+        # streaming-generator state: task_id -> {"items": [hex...],
+        # "done": bool} (num_returns="streaming" tasks; cluster analog
+        # lives on the head)
+        self._streams: Dict[str, dict] = {}
+        self._stream_cv = threading.Condition()
         self._spread_rr = 0  # SPREAD round-robin cursor
         self._label_rr = 0  # label-selector tie-break cursor
         self._seed_counter = itertools.count(1)
@@ -791,6 +808,8 @@ class Runtime:
                 actor_holds_resources = via_pg is None
                 assign_held = True
                 self._seal_results(spec, node, spec.actor_id)
+            elif spec.streaming:
+                self._run_streaming(spec, node, result)
             else:
                 self._seal_results(spec, node, result)
             self.metrics["tasks_finished"] += 1
@@ -812,6 +831,8 @@ class Runtime:
                 err.__cause__ = exc
                 for rid in spec.return_ids:
                     self._seal_id(None, rid, err, is_error=True)
+                if spec.streaming:
+                    self._fail_stream(spec, err)
                 if spec.kind == "actor_creation":
                     state = self._actors.get(spec.actor_id)
                     if state is not None:
@@ -884,6 +905,101 @@ class Runtime:
             for k, v in kwargs.items()
         }
         return res_args, res_kwargs
+
+    def _run_streaming(self, spec: TaskSpec, node: Node, gen: Any) -> None:
+        """Drive a ``num_returns="streaming"`` task: seal every yield as
+        its own object under stream_item_id(task_id, i) and publish it to
+        the stream state consumers long-poll via ``stream_next``. Item
+        appends are idempotent by index, so a retried generator re-seals
+        the same ids without duplicating stream entries."""
+        from ray_tpu.cluster.common import stream_item_id
+
+        if not hasattr(gen, "__next__"):
+            gen = iter(gen)
+        idx = 0
+        while True:
+            value = next(gen, _STREAM_END)
+            if value is _STREAM_END:
+                break
+            oid = stream_item_id(spec.task_id, idx)
+            self._lineage[oid] = spec
+            self._seal_id(node, oid, value)
+            with self._stream_cv:
+                st = self._streams.setdefault(
+                    spec.task_id, {"items": [], "done": False}
+                )
+                if idx == len(st["items"]):
+                    st["items"].append(oid)
+                abandoned = st.get("abandoned", False)
+                self._stream_cv.notify_all()
+            if abandoned:
+                try:
+                    gen.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                break
+            idx += 1
+        with self._stream_cv:
+            st = self._streams.setdefault(
+                spec.task_id, {"items": [], "done": False}
+            )
+            st["done"] = True
+            if st.get("abandoned"):
+                self._streams.pop(spec.task_id, None)
+            self._stream_cv.notify_all()
+
+    def _fail_stream(self, spec: TaskSpec, err: Any) -> None:
+        """Mid-stream failure, retries exhausted: the NEXT item the
+        consumer sees is a ref whose get() raises (reference generator
+        semantics), then the stream ends."""
+        from ray_tpu.cluster.common import stream_item_id
+
+        with self._stream_cv:
+            st = self._streams.setdefault(
+                spec.task_id, {"items": [], "done": False}
+            )
+            if not st["done"]:
+                oid = stream_item_id(spec.task_id, len(st["items"]))
+                self._seal_id(None, oid, err, is_error=True)
+                st["items"].append(oid)
+                st["done"] = True
+            self._stream_cv.notify_all()
+
+    def stream_next(
+        self, task_id: str, index: int, timeout: Optional[float]
+    ) -> Optional[ObjectRef]:
+        """Blocking fetch of stream item ``index``; None = stream ended
+        before it (StopIteration for the caller's generator)."""
+        deadline = None if timeout is None else _now() + timeout
+        with self._stream_cv:
+            while True:
+                st = self._streams.get(task_id)
+                if st is not None:
+                    if index < len(st["items"]):
+                        return ObjectRef(st["items"][index], owner=task_id)
+                    if st["done"]:
+                        return None
+                elif self._shutdown:
+                    return None
+                wait_s = 0.5
+                if deadline is not None:
+                    wait_s = min(wait_s, deadline - _now())
+                    if wait_s <= 0:
+                        raise GetTimeoutError(
+                            f"stream {task_id} item {index} not ready"
+                        )
+                self._stream_cv.wait(timeout=wait_s)
+
+    def stream_abandon(self, task_id: str) -> None:
+        """Consumer dropped the generator: make the state GC-able (the
+        in-process executor has no backpressure window to unwedge)."""
+        with self._stream_cv:
+            st = self._streams.get(task_id)
+            if st is not None and st["done"]:
+                self._streams.pop(task_id, None)
+            elif st is not None:
+                st["abandoned"] = True
+            self._stream_cv.notify_all()
 
     def _seal_results(self, spec: TaskSpec, node: Node, result: Any) -> None:
         rids = spec.return_ids

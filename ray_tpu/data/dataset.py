@@ -234,6 +234,10 @@ class Dataset:
             bounds=bounds,
             reduce_fn=_reduce_sorted,
             reduce_args=(key_fn, descending),
+            # sort's sampling stage already blocked the driver; the
+            # streaming map emits each range partition as its own sealed
+            # object (num_returns="streaming" block emission)
+            streaming=True,
         )
         if descending:
             refs = refs[::-1]

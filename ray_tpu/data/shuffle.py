@@ -38,16 +38,15 @@ def _stable_hash(value: Any) -> int:
     return int.from_bytes(hashlib.md5(data).digest()[:8], "little")
 
 
-@ray_tpu.remote
-def _partition_block(
+def _compute_parts(
     block: List[Any],
     num_parts: int,
     mode: str,
     key_fn: Optional[Callable],
     bounds: Optional[List[Any]],
     seed: Optional[int],
-) -> tuple:
-    """Map side: split one block into num_parts lists."""
+) -> List[List[Any]]:
+    """Split one block into num_parts lists (shared by both map tasks)."""
     parts: List[List[Any]] = [[] for _ in range(num_parts)]
     if mode == "random":
         rng = np.random.default_rng(seed)
@@ -71,9 +70,43 @@ def _partition_block(
             parts[lo].append(row)
     else:
         raise ValueError(f"unknown partition mode {mode}")
+    return parts
+
+
+@ray_tpu.remote
+def _partition_block(
+    block: List[Any],
+    num_parts: int,
+    mode: str,
+    key_fn: Optional[Callable],
+    bounds: Optional[List[Any]],
+    seed: Optional[int],
+) -> tuple:
+    """Map side (N-return form): split one block into num_parts lists."""
+    parts = _compute_parts(block, num_parts, mode, key_fn, bounds, seed)
     if num_parts == 1:
         return parts[0]  # num_returns=1 -> single (unwrapped) return value
     return tuple(parts)
+
+
+@ray_tpu.remote
+def _partition_block_stream(
+    block: List[Any],
+    num_parts: int,
+    mode: str,
+    key_fn: Optional[Callable],
+    bounds: Optional[List[Any]],
+    seed: Optional[int],
+):
+    """Map side (streaming form): yield partitions in index order.
+
+    Each partition seals as its own object the moment it is yielded
+    (num_returns="streaming"), so reduce p launches as soon as every map
+    has emitted its p-th partition — the reference's streaming block
+    emission for shuffles (hash_shuffle.py rides ObjectRefGenerator the
+    same way) instead of waiting for whole map tasks."""
+    for part in _compute_parts(block, num_parts, mode, key_fn, bounds, seed):
+        yield part
 
 
 @ray_tpu.remote
@@ -112,10 +145,25 @@ def shuffle_blocks(
     seed: Optional[int] = None,
     reduce_fn=None,
     reduce_args: tuple = (),
+    streaming: bool = False,
 ) -> List[Any]:
-    """Run the two-stage shuffle; returns one ObjectRef per output part."""
+    """Run the two-stage shuffle; returns one ObjectRef per output part.
+
+    Default: the N-return map form — fully non-blocking, every task
+    submitted before returning (callers keep driver/laziness overlap).
+    ``streaming=True``: maps emit partitions through
+    ``num_returns="streaming"`` generators and reduces launch in lockstep
+    as each partition row lands — per-partition seals spread object-plane
+    pressure across the map stage instead of one burst per map, at the
+    cost of the driver walking the streams (reference: hash_shuffle block
+    emission over ObjectRefGenerator)."""
     if reduce_fn is None:
         reduce_fn = _reduce_concat
+    if streaming:
+        return _shuffle_blocks_streaming(
+            blocks, num_parts, mode, key_fn, bounds, seed,
+            reduce_fn, reduce_args,
+        )
     map_refs = [
         _partition_block.options(num_returns=num_parts).remote(
             block,
@@ -133,6 +181,45 @@ def shuffle_blocks(
         reduce_fn.remote(*reduce_args, *[m[p] for m in map_refs])
         for p in range(num_parts)
     ]
+
+
+def _shuffle_blocks_streaming(
+    blocks, num_parts, mode, key_fn, bounds, seed, reduce_fn, reduce_args
+) -> List[Any]:
+    gens = [
+        _partition_block_stream.options(num_returns="streaming").remote(
+            block,
+            num_parts,
+            mode,
+            key_fn,
+            bounds,
+            None if seed is None else seed + i,
+        )
+        for i, block in enumerate(blocks)
+    ]
+    iters = [iter(g) for g in gens]
+    last: List[Any] = [None] * len(iters)
+
+    def next_part(i: int):
+        try:
+            last[i] = next(iters[i])
+        except StopIteration:
+            # the stream ended early: its final item is the map task's
+            # sealed error — hand that ref to the reduce so the failure
+            # surfaces as a TaskError on get(), like the N-return form
+            if last[i] is None:
+                raise RuntimeError(
+                    f"shuffle map {i} produced no partitions"
+                ) from None
+        return last[i]
+
+    out = []
+    for _p in range(num_parts):
+        # generators yield in partition order: one lockstep row across
+        # all maps unlocks reduce _p
+        parts_p = [next_part(i) for i in range(len(iters))]
+        out.append(reduce_fn.remote(*reduce_args, *parts_p))
+    return out
 
 
 def sample_bounds(

@@ -56,6 +56,27 @@ def build_llm_deployment(
             text = self.engine.generate([prompt], gen)[0]
             return {"prompt": prompt, "generated_text": text}
 
+        def stream_tokens(self, request):
+            """Generator-based token streaming: call with
+            ``.options(num_returns="streaming")`` and iterate the
+            ObjectRefGenerator — each decoded token text seals as its own
+            object with normal object-plane semantics (the reference's
+            serve/LLM token streaming rides ObjectRefGenerator the same
+            way; the Channel path below is the lower-latency in-cluster
+            alternative)."""
+            if not hasattr(self.engine, "stream_ids"):
+                raise TypeError(
+                    "token streaming requires engine='continuous'"
+                )
+            gen = GenerationConfig(
+                max_new_tokens=int(request.get("max_new_tokens", 32)),
+                temperature=float(request.get("temperature", 0.0)),
+                seed=int(request.get("seed", 0)),
+            )
+            prompt = self.engine.tokenizer.encode(request["prompt"])
+            for tok in self.engine.stream_ids(prompt, gen):
+                yield self.engine.tokenizer.decode([int(tok)])
+
         def stream_to(self, writer, request):
             """HTTP proxy SSE contract: POST /<name>/stream streams decoded
             token text through a mutable-object Channel (continuous engine

@@ -91,3 +91,45 @@ def test_stream_through_channel_from_actor():
     finally:
         ch.destroy()
         ray_tpu.shutdown()
+
+
+def test_stream_tokens_via_object_ref_generator():
+    """Generator-based token streaming (num_returns="streaming"): a
+    cluster actor hosting the engine yields decoded tokens, each sealed
+    as its own object and consumed through an ObjectRefGenerator — the
+    reference's serve/LLM token streaming surface."""
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    cfg, params = _small()
+
+    class Engine:
+        def __init__(self):
+            self.engine = ContinuousBatchingEngine(
+                cfg, params, max_batch=2, page_size=8, n_pages=32
+            )
+
+        def stream(self, prompt, n):
+            g = GenerationConfig(max_new_tokens=n, temperature=0.0)
+            for tok in self.engine.stream_ids(prompt, g):
+                yield int(tok)
+
+        def batch(self, prompt, n):
+            g = GenerationConfig(max_new_tokens=n, temperature=0.0)
+            return self.engine.generate_ids([prompt], g)[0]
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        a = ray_tpu.remote(Engine).options(num_cpus=1.0).remote()
+        want = ray_tpu.get(a.batch.remote([3, 5, 7], 10), timeout=300)
+        gen = a.stream.options(num_returns="streaming").remote([3, 5, 7], 10)
+        toks = [ray_tpu.get(r, timeout=300) for r in gen]
+        assert toks == want
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
