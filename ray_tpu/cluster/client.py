@@ -1113,6 +1113,7 @@ class RemoteRuntime:
         *,
         resources: Dict[str, float],
         name: Optional[str] = None,
+        lifetime: Optional[str] = None,
         max_restarts: int = 0,
         max_concurrency: Optional[int] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
@@ -1121,6 +1122,12 @@ class RemoteRuntime:
         **_ignored,
     ) -> RemoteActorHandle:
         from ray_tpu.core.refcount import collect_serialized
+
+        if lifetime not in (None, "detached", "non_detached"):
+            raise ValueError(
+                f"lifetime must be 'detached' or 'non_detached', "
+                f"got {lifetime!r}"
+            )
 
         _ship_module_by_value(cls)
         actor_id = new_id()
@@ -1154,6 +1161,7 @@ class RemoteRuntime:
                 "max_restarts": max_restarts,
                 "max_concurrency": max_concurrency,
                 "concurrency_groups": dict(concurrency_groups or {}),
+                "lifetime": lifetime,
             },
         )
         return RemoteActorHandle(self, actor_id, cls)
@@ -1493,6 +1501,16 @@ class RemoteRuntime:
             self._flusher.stop(release_all=True)
             refcount.clear_consumer(self._flusher)
         self._sender.stop()
+        try:
+            # clean driver exit: the head reaps this client's non-detached
+            # actors (detached ones survive — reference job-exit
+            # semantics). Best-effort: a crashed driver skips this and
+            # its actors linger until killed explicitly.
+            self.head.call(
+                "DisconnectClient", {"client_id": self.client_id}, timeout=5.0
+            )
+        except RpcError:
+            pass
         self._pipe_chan.close()
         self.head.close()
         with self._lock:

@@ -159,3 +159,9 @@ class ActorInfo:
     class_name: str = ""
     max_restarts: int = 0
     num_restarts: int = 0
+    # lifetime="detached": owned by the head, survives its creating
+    # driver's disconnect and head restarts; killed only explicitly
+    # (reference actor.py:1875 detached lifetimes). Default (None):
+    # reaped when the owning client disconnects.
+    lifetime: Optional[str] = None
+    owner_client: str = ""

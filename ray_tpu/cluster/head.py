@@ -244,6 +244,7 @@ class HeadServer:
             "PendingDemands": self._h_pending_demands,
             "CancelLease": self._h_cancel_lease,
             "KillActor": self._h_kill_actor,
+            "DisconnectClient": self._h_disconnect_client,
             "CreatePlacementGroup": self._h_create_pg,
             "WaitPlacementGroup": self._h_wait_pg,
             "RemovePlacementGroup": self._h_remove_pg,
@@ -525,6 +526,8 @@ class HeadServer:
                         address=info.address,
                         state="ALIVE",
                         max_restarts=meta.get("max_restarts", 0),
+                        lifetime=meta.get("lifetime"),
+                        owner_client=meta.get("owner_client", ""),
                     )
                     if name and name not in self._named_actors:
                         self._named_actors[name] = actor_id
@@ -2026,12 +2029,18 @@ class HeadServer:
             name=name,
             class_name=req.get("class_name", ""),
             max_restarts=req.get("max_restarts", 0),
+            lifetime=req.get("lifetime"),
+            owner_client=spec.client_id,
         )
         spec.actor_meta = {
             "name": name,
             "max_restarts": info.max_restarts,
             "max_concurrency": req.get("max_concurrency"),
             "concurrency_groups": req.get("concurrency_groups", {}),
+            # ride to the agent so re-attach after an unpersisted head
+            # restart keeps disconnect-reaping semantics
+            "lifetime": info.lifetime,
+            "owner_client": info.owner_client,
         }
         # ctor args stay pinned for the actor's whole life (restarts replay
         # the creation payload); released when the actor is finally DEAD
@@ -2196,6 +2205,38 @@ class HeadServer:
         if info is None:
             raise ValueError(f"unknown actor {actor_id}")
         return info
+
+    def _h_disconnect_client(self, req: dict) -> None:
+        """A driver disconnected cleanly: reap its NON-detached actors
+        (reference job-exit semantics — actors die with their owner
+        unless lifetime="detached", actor.py:1875). Detached actors are
+        owned by the head and only die on explicit kill."""
+        cid = req.get("client_id")
+        if not cid:
+            return
+        with self._lock:
+            victims = [
+                info.actor_id
+                for info in self._actors.values()
+                if info.owner_client == cid
+                and info.lifetime != "detached"
+                and info.state != "DEAD"
+            ]
+        # reap OFF the handler thread: agent kill RPCs can block up to
+        # their timeout per victim, while the disconnecting client only
+        # waits ~5s for this reply
+        for aid in victims:
+            self._dispatch_pool.submit(
+                _best_effort,
+                self._h_kill_actor,
+                {"actor_id": aid, "no_restart": True},
+            )
+        if victims:
+            logger.info(
+                "client %s disconnected; reaping %d non-detached actors",
+                cid[:8],
+                len(victims),
+            )
 
     def _h_kill_actor(self, req: dict) -> None:
         info = self._actors.get(req["actor_id"])

@@ -371,6 +371,7 @@ class ActorClass:
                 kwargs,
                 resources=_resource_map(opts, is_actor=True),
                 name=opts.get("name"),
+                lifetime=opts.get("lifetime"),
                 max_restarts=opts.get("max_restarts", 0),
                 max_concurrency=opts.get("max_concurrency"),
                 concurrency_groups=opts.get("concurrency_groups"),

@@ -351,3 +351,113 @@ def test_repeated_connect_teardown_no_stray_threads(cluster):
         and id(t) not in before
     ]
     assert not stray, stray
+
+
+class _KVStore:
+    def __init__(self):
+        self.d = {}
+
+    def put(self, k, v):
+        self.d[k] = v
+        return True
+
+    def get(self, k):
+        return self.d.get(k)
+
+
+def test_detached_actor_lifetime():
+    """lifetime="detached" actors survive their creating driver's
+    disconnect and stay reachable by name from a new driver; default
+    (non-detached) actors are reaped at driver disconnect (reference
+    actor.py:1875 detached lifetimes / job-exit reaping)."""
+    from ray_tpu.cluster.client import connect
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster()
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    try:
+        # driver A: one detached, one default actor
+        rtA = connect(c.address)
+        set_runtime(rtA)
+        KV = ray_tpu.remote(_KVStore)
+        det = KV.options(
+            name="detached-store", lifetime="detached", num_cpus=0.5
+        ).remote()
+        tmp = KV.options(name="temp-store", num_cpus=0.5).remote()
+        assert ray_tpu.get(det.put.remote("x", 42), timeout=60)
+        assert ray_tpu.get(tmp.put.remote("y", 7), timeout=60)
+        set_runtime(None)
+        rtA.shutdown()
+
+        # driver B: detached actor reachable with state intact; the
+        # non-detached one was reaped with driver A
+        rtB = connect(c.address)
+        set_runtime(rtB)
+        try:
+            h = ray_tpu.get_actor("detached-store")
+            assert ray_tpu.get(h.get.remote("x"), timeout=60) == 42
+            dead = True
+            try:
+                h2 = ray_tpu.get_actor("temp-store")
+                ray_tpu.get(h2.get.remote("y"), timeout=20)
+                dead = False
+            except Exception:
+                pass
+            assert dead, "non-detached actor survived its driver"
+            # explicit kill is the only way a detached actor dies
+            ray_tpu.kill(h)
+            deadline = time.monotonic() + 30
+            gone = False
+            while time.monotonic() < deadline and not gone:
+                try:
+                    ray_tpu.get(
+                        ray_tpu.get_actor("detached-store").get.remote("x"),
+                        timeout=5,
+                    )
+                    time.sleep(0.5)
+                except Exception:
+                    gone = True
+            assert gone
+        finally:
+            set_runtime(None)
+            rtB.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_detached_actor_survives_head_restart(tmp_path):
+    """Detached actor + its name registration persist across a head
+    restart (WAL actor records + agent re-attach)."""
+    from ray_tpu.cluster.client import connect
+    from ray_tpu.core.runtime import set_runtime
+
+    c = Cluster(persist_path=str(tmp_path / "head_state.pkl"))
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    try:
+        rtA = connect(c.address)
+        set_runtime(rtA)
+        det = (
+            ray_tpu.remote(_KVStore)
+            .options(
+                name="restart-store",
+                lifetime="detached",
+                num_cpus=0.5,
+            )
+            .remote()
+        )
+        assert ray_tpu.get(det.put.remote("k", 99), timeout=60)
+        set_runtime(None)
+        rtA.shutdown()
+
+        c.restart_head()
+
+        rtB = connect(c.address)
+        set_runtime(rtB)
+        try:
+            h = ray_tpu.get_actor("restart-store")
+            assert ray_tpu.get(h.get.remote("k"), timeout=90) == 99
+        finally:
+            set_runtime(None)
+            rtB.shutdown()
+    finally:
+        c.shutdown()
