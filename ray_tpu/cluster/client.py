@@ -984,9 +984,16 @@ class RemoteRuntime:
         for h, data, contained in todo:
             if not self._upload_owned(h, data, contained):
                 # keep the record: the dependent submission will dep-wait,
-                # and the next share (or eviction) retries the upload
+                # and the next share (or eviction) retries the upload.
+                # Also restore the VALUE: a concurrent cap-eviction sweep
+                # may have dropped it while the marker was popped (the
+                # sweep skips its own upload when it sees no marker) —
+                # without this the only copy of the object is lost
                 with self._direct_cv:
                     self._deferred_seals.setdefault(h, contained)
+                    if h not in self._direct_results:
+                        self._direct_results[h] = ("val", data)
+                        self._direct_results_order.append(h)
 
     def _fallback_submit(self, item: dict) -> None:
         """Route a direct-call item through the head-scheduled path (actor
@@ -1238,6 +1245,15 @@ class RemoteRuntime:
         try:
             return self._read(
                 "LocateObjects", {"object_ids": [r.hex for r in refs]}
+            )
+        except Exception:  # noqa: BLE001
+            return {}
+
+    def object_sizes(self, refs: List[ObjectRef]) -> Dict[str, int]:
+        """hex -> sealed byte size (0 = unknown); head object directory."""
+        try:
+            return self._read(
+                "ObjectSizes", {"object_ids": [r.hex for r in refs]}
             )
         except Exception:  # noqa: BLE001
             return {}
@@ -1509,8 +1525,8 @@ class RemoteRuntime:
             self.head.call(
                 "DisconnectClient", {"client_id": self.client_id}, timeout=5.0
             )
-        except RpcError:
-            pass
+        except Exception:  # noqa: BLE001 - best-effort: call() re-raises
+            pass  # server-side exceptions verbatim (not just RpcError)
         self._pipe_chan.close()
         self.head.close()
         with self._lock:

@@ -232,6 +232,7 @@ class HeadServer:
             "PutObject": self._h_put_object,
             "WaitObject": self._h_wait_object,
             "LocateObjects": self._h_locate_objects,
+            "ObjectSizes": self._h_object_sizes,
             "WaitObjectBatch": self._h_wait_object_batch,
             "WaitStream": self._h_wait_stream,
             "StreamConsumed": self._h_stream_consumed,
@@ -1131,6 +1132,16 @@ class HeadServer:
             for oid in req["object_ids"]:
                 e = self._objects.get(oid)
                 out[oid] = sorted(e.locations) if e is not None else []
+        return out
+
+    def _h_object_sizes(self, req: dict) -> Dict[str, int]:
+        """Sealed sizes from the directory (0 = unknown/unsealed); the
+        Data executor samples these to calibrate its byte budget."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for oid in req["object_ids"]:
+                e = self._objects.get(oid)
+                out[oid] = int(e.size) if e is not None else 0
         return out
 
     def _h_wait_object(self, req: dict) -> dict:

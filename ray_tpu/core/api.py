@@ -354,6 +354,13 @@ class ActorClass:
     def remote(self, *args, **kwargs):
         rt = get_runtime()
         opts = self._options
+        # validate once here so both runtimes agree — a typo'd lifetime
+        # must not silently mean "non-detached" on one backend
+        if opts.get("lifetime") not in (None, "detached", "non_detached"):
+            raise ValueError(
+                "lifetime must be 'detached' or 'non_detached', "
+                f"got {opts.get('lifetime')!r}"
+            )
         if getattr(rt, "is_remote", False):
             v = opts.get("max_task_retries")
             if v not in (None, 0):

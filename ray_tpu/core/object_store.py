@@ -56,10 +56,20 @@ class _RefWaiter:
     futures — unresolved refs cost a slot in a dict, not a thread."""
 
     def __init__(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
         self._cv = threading.Condition()
         # hex -> (ref, [futures]); many futures may await one ref
         self._pending: Dict[str, tuple] = {}
         self._generation = 0  # bumped per submit: shrinks the poll window
+        # READY refs resolve on a small pool: one slow large cross-node
+        # fetch must not head-of-line block completion of every other
+        # already-sealed awaited ref (r4 advisor); only the wait_many
+        # multiplexing stays on the single thread
+        self._resolve_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="ref-resolve"
+        )
+        self._resolving: set = set()  # hexes handed to the pool
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="ref-await"
         )
@@ -84,8 +94,19 @@ class _RefWaiter:
             with self._cv:
                 while not self._pending:
                     self._cv.wait()
-                refs = [r for r, _ in self._pending.values()]
+                # refs mid-fetch in the resolve pool stay OUT of the wait
+                # set: wait_many reports a sealed ref ready instantly, so
+                # including one being slow-fetched turns this loop into a
+                # zero-delay spin (head RPC storm in cluster mode)
+                refs = [
+                    r
+                    for h, (r, _) in self._pending.items()
+                    if h not in self._resolving
+                ]
                 gen = self._generation
+            if not refs:
+                time.sleep(0.05)  # everything pending is mid-fetch
+                continue
             # adaptive window: freshly submitted refs get a short wait (a
             # just-sealed object resolves fast); an unchanged pending set
             # backs the window off so one long-running awaited task does
@@ -102,26 +123,36 @@ class _RefWaiter:
                 ready = []
                 time.sleep(0.05)
             for r in ready:
-                try:
-                    value, is_err = rt.get_object(r, 5.0), False
-                except GetTimeoutError:
-                    # sealed but the fetch is slow (large cross-node
-                    # object): leave it pending and retry next round
-                    # rather than surfacing a timeout the caller never
-                    # asked for
-                    continue
-                except BaseException as exc:  # noqa: BLE001
-                    value, is_err = exc, True
                 with self._cv:
-                    entry = self._pending.pop(r.hex, None)
-                for fut in entry[1] if entry else ():
-                    try:
-                        if is_err:
-                            fut.set_exception(value)
-                        else:
-                            fut.set_result(value)
-                    except Exception:  # noqa: BLE001 - future cancelled
-                        pass
+                    if r.hex in self._resolving:
+                        continue  # a pool worker already owns this fetch
+                    self._resolving.add(r.hex)
+                self._resolve_pool.submit(self._resolve_one, rt, r)
+
+    def _resolve_one(self, rt, r: "ObjectRef") -> None:
+        try:
+            try:
+                value, is_err = rt.get_object(r, 5.0), False
+            except GetTimeoutError:
+                # sealed but the fetch is slow (large cross-node object):
+                # leave it pending and retry next round rather than
+                # surfacing a timeout the caller never asked for
+                return
+            except BaseException as exc:  # noqa: BLE001
+                value, is_err = exc, True
+            with self._cv:
+                entry = self._pending.pop(r.hex, None)
+            for fut in entry[1] if entry else ():
+                try:
+                    if is_err:
+                        fut.set_exception(value)
+                    else:
+                        fut.set_result(value)
+                except Exception:  # noqa: BLE001 - future cancelled
+                    pass
+        finally:
+            with self._cv:
+                self._resolving.discard(r.hex)
 
 
 _RESOLVER = None

@@ -251,14 +251,22 @@ class _StageState:
     in_flight: Dict[str, tuple] = field(default_factory=dict)  # hex -> meta
     pool: Optional[_ActorPool] = None
     est_block_bytes: Optional[int] = None
+    # True once est came from a MEASURED block (seal size of a completed
+    # output), not an inherited/seeded guess
+    est_measured: bool = False
+    sample_attempts: int = 0  # bounded retries when a measurement fails
 
     def window(self) -> int:
         """Byte-budget admission window (resource_manager.py:55 analog):
         budget / estimated block size, clamped to keep the pipeline both
-        alive and bounded."""
-        est = self.est_block_bytes or (64 << 10)
-        w = int(cfg.data_inflight_budget_bytes // est)
-        return max(2, min(w, 1024))
+        alive and bounded. Until a REAL size sample lands the window stays
+        conservative — the old 64KiB default admitted 1024 in-flight
+        multi-MB blocks, gigabytes past the budget (r4 advisor finding)."""
+        if self.est_block_bytes is None:
+            return 16
+        w = int(cfg.data_inflight_budget_bytes // self.est_block_bytes)
+        cap = 1024 if self.est_measured else 16
+        return max(2, min(w, cap))
 
 
 class StreamingExecutor:
@@ -279,10 +287,35 @@ class StreamingExecutor:
                 input_blocks[0], ray_tpu.ObjectRef
             ):
                 self._stages[0].est_block_bytes = _est_bytes(input_blocks[0])
+                self._stages[0].est_measured = True
+            elif input_blocks:
+                # ObjectRef inputs: calibrate stage 0 from one input's
+                # seal size (nothing downstream ever samples INTO stage
+                # 0, which would otherwise sit at the conservative window
+                # forever — a ~64x parallelism cap for small blocks)
+                size = self._measure_block(input_blocks[0], fetch_timeout=0.5)
+                if size:
+                    self._stages[0].est_block_bytes = size
+                    self._stages[0].est_measured = True
         for st in self._stages:
             if isinstance(st.stage, ActorStage):
                 st.pool = _ActorPool(st.stage, self._rt)
         self._locations: Dict[str, List[str]] = {}
+
+    def _measure_block(
+        self, ref: ray_tpu.ObjectRef, fetch_timeout: float = 5.0
+    ) -> int:
+        """Real byte size of a completed block: seal size from the object
+        directory (cluster) or one sampled pickle (local runtime)."""
+        sizes_fn = getattr(self._rt, "object_sizes", None)
+        if sizes_fn is not None:
+            size = sizes_fn([ref]).get(ref.hex, 0)
+            if size:
+                return int(size)
+        try:
+            return _est_bytes(self._rt.get_object(ref, fetch_timeout))
+        except Exception:  # noqa: BLE001
+            return 0
 
     # ------------------------------------------------------------------
     def _locate(self, refs: List[ray_tpu.ObjectRef]) -> None:
@@ -393,6 +426,26 @@ class StreamingExecutor:
                             continue
                         if meta[2] is not None:
                             st.pool.complete(meta[2])
+                        # calibrate the byte budget from the first MEASURED
+                        # output of this stage (seal size from the
+                        # directory; local fallback re-pickles one block) —
+                        # the module's backpressure claim was previously
+                        # seeded-only (r4 advisor finding). Measure only
+                        # when a downstream stage still needs it; bounded
+                        # retries when a measurement comes back empty.
+                        tgt = (
+                            stages[si + 1] if si + 1 < len(stages) else None
+                        )
+                        if (
+                            tgt is not None
+                            and not tgt.est_measured
+                            and st.sample_attempts < 5
+                        ):
+                            st.sample_attempts += 1
+                            size = self._measure_block(ref)
+                            if size:
+                                tgt.est_block_bytes = size
+                                tgt.est_measured = True
                         nxt = si + 1
                         if nxt < len(stages):
                             stages[nxt].queue.append(ref)

@@ -10,6 +10,33 @@ only (like StringIdMap), and the *authoritative* bookkeeping on grant/return
 is exact int64 fixed-point (1e-4 quantum, mirroring fixed_point.h:26) host-side;
 the device arrays are the approximate scoring view (eventually-consistent, the
 same trust model the reference assigns to ClusterResourceManager).
+
+Unit & exactness contract
+-------------------------
+Quantities are floats in HUMAN units — CPU/GPU/TPU as device counts,
+``memory`` / ``object_store_memory`` in whatever unit the caller adopts
+(counts, GiB, or bytes), custom resources likewise. Two layers, two
+guarantees:
+
+- **Admission is exact.** Every quantity is quantized once at the edge to
+  int64 fixed point (``to_fp``, 1e-4 quantum like the reference's
+  FixedPoint) and all grant/release arithmetic — the agent ledger
+  (native/ledger.cc) and the local-runtime ``NodeResourceLedger`` — is
+  integer. Bytes-valued resources (e.g. ``memory: 2**30``) admit exactly:
+  int64 fixed point is exact through 2**59, so sums/compares never drift
+  and the last byte is grantable (tests/test_resource_units.py proves the
+  boundary).
+- **Scoring is float32 and approximate past ``MAX_EXACT_VIEW_TOTAL``.**
+  The dense view arrays feed the batched XLA kernels; float32 represents
+  the 1e-4 quantum exactly only while value/1e-4 fits the 24-bit
+  mantissa, i.e. magnitudes ≤ 2**24 × 1e-4 ≈ 1677.72. Larger totals
+  (bytes-valued memory) degrade only *scoring/feasibility pre-checks*
+  (float32 spacing at 2**30 is 128) — a stale-view over-grant is caught by
+  the agents' exact grant-or-reject and re-queued, the same trust model
+  the reference assigns its eventually-consistent
+  ClusterResourceManager. ``ClusterView.add_node`` warns once per
+  resource name when a total crosses the bound so the precision trade is
+  loud, not silent.
 """
 from __future__ import annotations
 
@@ -38,6 +65,32 @@ PREDEFINED_NAMES = ("CPU", "memory", "object_store_memory", "GPU", "TPU")
 # Columns used by CalculateCriticalResourceUtilization
 # (cluster_resource_data.cc:62-77): CPU, MEM, OBJECT_STORE_MEM.
 CRITICAL_COLUMNS = (CPU, MEMORY, OBJECT_STORE_MEMORY)
+
+
+# Above this magnitude the float32 VIEW can no longer represent the 1e-4
+# quantum exactly: exactness needs value/1e-4 ≤ 2^24 (float32's 24-bit
+# mantissa), i.e. value ≤ 1677.7216. Admission stays exact (int64
+# ledger) at any magnitude; scoring becomes approximate past this.
+MAX_EXACT_VIEW_TOTAL = float(1 << 24) / FP_SCALE
+
+_warned_view_precision: set = set()
+
+
+def _warn_view_precision(name: str, value: float) -> None:
+    if name in _warned_view_precision:
+        return
+    _warned_view_precision.add(name)
+    import logging
+
+    logging.getLogger("ray_tpu.scheduler").warning(
+        "resource %r total %.4g exceeds MAX_EXACT_VIEW_TOTAL (%.4g): the "
+        "float32 scoring view is approximate at this magnitude (admission "
+        "stays exact via the int64 ledger). Consider coarser units (GiB "
+        "instead of bytes) for exact scoring.",
+        name,
+        value,
+        MAX_EXACT_VIEW_TOTAL,
+    )
 
 
 def to_fp(value: float) -> int:
@@ -280,6 +333,9 @@ class ClusterView:
         total: Mapping[str, float],
         labels: Optional[Mapping[str, str]] = None,
     ) -> int:
+        for name, v in total.items():
+            if float(v) > MAX_EXACT_VIEW_TOTAL:
+                _warn_view_precision(name, float(v))
         row_total = self.vocab.pack(total)
         self._grow(len(self._node_ids) + 1, self.vocab.capacity)
         if row_total.shape[0] < self.totals.shape[1]:

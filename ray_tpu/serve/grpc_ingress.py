@@ -117,6 +117,9 @@ class GrpcIngress:
                 raise KeyError(f"unknown stream {sid!r}")
             stream = entry[0]
             self._streams[sid] = (stream, time.monotonic())
+            reaped = self._pop_idle_locked()
+        for stale in reaped:  # a server that stops seeing Opens must
+            stale.close()  # still reap vanished clients (r4 advisor)
         max_items = int(req.get("max_items") or 64)
         window = float(req.get("timeout") or 5.0)
         items = []
@@ -166,8 +169,11 @@ class GrpcIngress:
     def _h_close(self, req: dict) -> None:
         with self._lock:
             entry = self._streams.pop(req["stream_id"], None)
+            reaped = self._pop_idle_locked()
         if entry is not None:
             entry[0].close()
+        for stale in reaped:
+            stale.close()
 
     def _pop_idle_locked(self) -> list:
         """Collect abandoned streams (client vanished without Close) so

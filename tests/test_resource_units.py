@@ -1,0 +1,93 @@
+"""Resource unit & exactness contract (scheduler/resources.py docstring).
+
+Admission must be EXACT for any unit choice — counts, GiB, or bytes —
+because grant/release arithmetic is int64 fixed point
+(fixed_point.h:26 analog); only the float32 scoring view is allowed to
+be approximate past MAX_EXACT_VIEW_TOTAL, and crossing that bound warns
+loudly.
+"""
+import logging
+
+import pytest
+
+import ray_tpu
+from ray_tpu.scheduler.resources import (
+    FP_SCALE,
+    MAX_EXACT_VIEW_TOTAL,
+    ClusterView,
+    ResourceVocab,
+    from_fp,
+    to_fp,
+)
+
+
+def test_fixed_point_exact_for_bytes_values():
+    """int64 fixed point is exact well past bytes-scale magnitudes."""
+    gib = 2**30
+    assert to_fp(gib) == gib * FP_SCALE
+    assert from_fp(to_fp(gib)) == gib
+    # sums of quanta never drift: 2^30 split into 4 quarters plus one
+    # 1e-4 quantum reconstructs exactly
+    q = to_fp(gib / 4)
+    assert 4 * q == to_fp(gib)
+    assert to_fp(gib) + 1 == to_fp(gib + 0.0001)
+
+
+def test_view_precision_warning_once(caplog):
+    from ray_tpu.scheduler import resources as res
+
+    res._warned_view_precision.discard("memory")
+    v = ClusterView(ResourceVocab())
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.scheduler"):
+        v.add_node("n1", {"CPU": 4.0, "memory": float(2**30)})
+        v.add_node("n2", {"CPU": 4.0, "memory": float(2**30)})
+    hits = [r for r in caplog.records if "MAX_EXACT_VIEW_TOTAL" in r.message]
+    assert len(hits) == 1  # once per resource name, not per node
+    # exactness bound: value/quantum must fit float32's 24-bit mantissa
+    assert MAX_EXACT_VIEW_TOTAL == pytest.approx((1 << 24) / 10_000)
+
+
+def _hold(mem, t):
+    import time
+
+    time.sleep(t)
+    return mem
+
+
+def test_bytes_valued_memory_admits_exactly():
+    """A bytes-valued memory resource grants to the LAST quantum and
+    rejects one quantum over — exact admission despite the approximate
+    float32 scoring view (grant-or-reject on the int64 ledger)."""
+    rt = ray_tpu.init(
+        num_nodes=1, resources_per_node={"CPU": 4.0, "memory": float(2**30)}
+    )
+    try:
+        gib = 2**30
+        quarter = gib / 4
+        # four quarter-GiB holders exactly exhaust memory
+        refs = [
+            ray_tpu.remote(_hold)
+            .options(num_cpus=0.5, resources={"memory": quarter})
+            .remote(i, 2.0)
+            for i in range(4)
+        ]
+        import time
+
+        time.sleep(0.8)  # all four running, memory == 0 exactly
+        # a fifth demanding one quantum must NOT run concurrently: it
+        # parks until a quarter frees, then completes
+        t0 = time.monotonic()
+        extra = (
+            ray_tpu.remote(_hold)
+            .options(num_cpus=0.5, resources={"memory": 0.0001})
+            .remote(99, 0.0)
+        )
+        assert ray_tpu.get(extra, timeout=60) == 99
+        waited = time.monotonic() - t0
+        assert waited > 0.5, (
+            f"one-quantum task ran in {waited:.2f}s while memory was "
+            "exactly exhausted — admission is not exact"
+        )
+        assert ray_tpu.get(refs, timeout=60) == [0, 1, 2, 3]
+    finally:
+        ray_tpu.shutdown()
