@@ -27,6 +27,15 @@ from ray_tpu.scheduler import ResourceRequest, ResourceVocab
 from ray_tpu.scheduler.instances import NodeAcceleratorState
 from ray_tpu.scheduler.resources import make_ledger
 
+from .pip_env import ENV_KINDS, env_slice
+
+
+def _has_env(runtime_env) -> bool:
+    """True when the lease needs an isolated-env-bound worker."""
+    return bool(runtime_env) and any(
+        runtime_env.get(k) is not None for k in ENV_KINDS
+    )
+
 from .common import (
     REPORT_PERIOD_S,
     LeaseRequest,
@@ -371,19 +380,42 @@ class NodeAgent:
     # worker pool
     # ------------------------------------------------------------------
     def _spawn_worker(
-        self, pip_env: Optional[Tuple[str, str]] = None
+        self, pip_env: Optional[Tuple] = None
     ) -> _WorkerHandle:
         worker_id = new_id()
         env = dict(os.environ)
         env["RAY_TPU_HEAD_ADDRESS"] = self.head_address
         env["RAY_TPU_NODE_ID"] = self.node_id
+        interpreter = sys.executable
         if pip_env is not None:
-            # pip runtime env: the worker prepends this dir to sys.path at
-            # startup, shadowing base site-packages (pip_env.py)
-            env["RAY_TPU_PIP_ENV_DIR"] = pip_env[1]
+            kind = pip_env[2] if len(pip_env) > 2 else "pip"
+            if kind == "conda":
+                # conda envs bring their own interpreter (pip_env.py) and
+                # must have ray_tpu importable inside them — reference
+                # conda.py injects ray into the env's dependencies the
+                # same way. RAY_TPU_CONDA_INJECT_SOURCE=1 opts into
+                # prepending this source checkout's parent dir instead
+                # (dev convenience only: PYTHONPATH entries shadow the
+                # env's own site-packages, defeating isolation for any
+                # package both provide).
+                from .pip_env import PipEnvManager
+
+                interpreter = PipEnvManager.interpreter_for(kind, pip_env[1])
+                if os.environ.get("RAY_TPU_CONDA_INJECT_SOURCE"):
+                    env["PYTHONPATH"] = (
+                        os.path.dirname(
+                            os.path.dirname(os.path.dirname(__file__))
+                        )
+                        + os.pathsep
+                        + env.get("PYTHONPATH", "")
+                    )
+            else:
+                # pip/uv --target env: the worker prepends this dir to
+                # sys.path at startup, shadowing base site-packages
+                env["RAY_TPU_PIP_ENV_DIR"] = pip_env[1]
         proc = subprocess.Popen(
             [
-                sys.executable,
+                interpreter,
                 "-m",
                 "ray_tpu.cluster.worker",
                 "--agent",
@@ -559,10 +591,11 @@ class NodeAgent:
             self._release(scalar_alloc)
             return {"status": "reject", "available": self.ledger.avail_map()}
         alloc = scalar_alloc + (assign,)
-        if (spec.runtime_env or {}).get("pip"):
-            # pip runtime env: needs a worker bound to the built env dir
-            # (dedicated interpreter path); dispatched individually — env
-            # builds can take seconds and must not stall the batch drainer
+        if _has_env(spec.runtime_env):
+            # pip/uv/conda runtime env: needs a worker bound to the built
+            # env (dedicated interpreter path); dispatched individually —
+            # env builds can take seconds and must not stall the batch
+            # drainer
             self._exec_pool.submit(self._dispatch_pip_task, spec, alloc)
         elif spec.kind == "actor_creation":
             # pins its worker for life — dispatched individually
@@ -723,7 +756,7 @@ class NodeAgent:
             self._release(scalar_alloc)
             self._spillback(spec, "chips busy after dep wait")
             return
-        if (spec.runtime_env or {}).get("pip"):
+        if _has_env(spec.runtime_env):
             self._exec_pool.submit(
                 self._dispatch_pip_task, spec, scalar_alloc + (assign,)
             )
@@ -963,13 +996,21 @@ class NodeAgent:
         agent-side env creation before worker startup
         (_private/runtime_env/agent/main.py shape)."""
         # dispatch guard ref taken BEFORE ensure: the GC sweep must never
-        # delete the env between its build and its worker's spawn
-        guard_key = self._pip_mgr.key_of(spec.runtime_env["pip"])
-        self._pip_mgr.acquire(guard_key)
+        # delete the env between its build and its worker's spawn. The
+        # slice/key prologue sits INSIDE the failure path too: a malformed
+        # runtime_env (e.g. pip+uv merged from job-level + task-level
+        # envs) must release the allocation and report, not die silently
+        # in the exec pool.
+        guard_key = None
         try:
-            key, env_dir = self._pip_mgr.ensure(spec.runtime_env["pip"])
+            env = env_slice(spec.runtime_env)
+            kind = next(iter(env))
+            guard_key = self._pip_mgr.key_of(env)
+            self._pip_mgr.acquire(guard_key)
+            key, env_dir = self._pip_mgr.ensure(env)
         except Exception as exc:  # noqa: BLE001 - build failure is final
-            self._pip_mgr.release(guard_key)
+            if guard_key is not None:
+                self._pip_mgr.release(guard_key)
             self._release(alloc)
             self._report_to_head(
                 {
@@ -985,7 +1026,7 @@ class NodeAgent:
             )
             return
         try:
-            handle = self._pop_pip_worker(key, env_dir)
+            handle = self._pop_pip_worker(key, env_dir, kind=kind)
         except Exception:  # noqa: BLE001 - spawn failure (fork pressure)
             logger.exception("pip env worker spawn failed")
             handle = None
@@ -1015,7 +1056,7 @@ class NodeAgent:
         self._run_on_worker(spec, handle, alloc)
 
     def _pop_pip_worker(
-        self, key: str, env_dir: str, timeout: float = 120.0
+        self, key: str, env_dir: str, kind: str = "pip", timeout: float = 120.0
     ) -> Optional[_WorkerHandle]:
         """Idle env-bound worker, or spawn one (jax import makes worker
         startup seconds-scale; the deadline covers it)."""
@@ -1030,7 +1071,7 @@ class NodeAgent:
         # the health loop or reaper collects it)
         self._pip_mgr.acquire(key)
         try:
-            self._spawn_worker(pip_env=(key, env_dir))
+            self._spawn_worker(pip_env=(key, env_dir, kind))
         except BaseException:
             self._pip_mgr.release(key)
             raise

@@ -385,9 +385,14 @@ class ActorClass:
                 scheduling_strategy=opts.get("scheduling_strategy"),
                 runtime_env=opts.get("runtime_env"),
             )
-        if (opts.get("runtime_env") or {}).get("pip"):
+        from ray_tpu.cluster.pip_env import ENV_KINDS
+
+        if any(
+            (opts.get("runtime_env") or {}).get(k) is not None
+            for k in ENV_KINDS
+        ):
             raise NotImplementedError(
-                "pip runtime environments need per-env worker processes — "
+                "pip/uv/conda runtime environments need per-env worker processes — "
                 "run against a cluster (ray_tpu.init(address=...) or "
                 "Cluster()); the in-process runtime shares one interpreter"
             )

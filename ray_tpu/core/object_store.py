@@ -55,21 +55,21 @@ class _RefWaiter:
     those (get_object returns promptly once sealed), and completes their
     futures — unresolved refs cost a slot in a dict, not a thread."""
 
-    def __init__(self) -> None:
-        from concurrent.futures import ThreadPoolExecutor
+    _MAX_RESOLVERS = 4
 
+    def __init__(self) -> None:
         self._cv = threading.Condition()
         # hex -> (ref, [futures]); many futures may await one ref
         self._pending: Dict[str, tuple] = {}
         self._generation = 0  # bumped per submit: shrinks the poll window
-        # READY refs resolve on a small pool: one slow large cross-node
-        # fetch must not head-of-line block completion of every other
-        # already-sealed awaited ref (r4 advisor); only the wait_many
-        # multiplexing stays on the single thread
-        self._resolve_pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="ref-resolve"
-        )
-        self._resolving: set = set()  # hexes handed to the pool
+        # READY refs resolve on up to _MAX_RESOLVERS DAEMON threads: one
+        # slow large cross-node fetch must not head-of-line block
+        # completion of every other already-sealed awaited ref (r4
+        # advisor); only the wait_many multiplexing stays on the single
+        # thread. Plain daemon threads, not a ThreadPoolExecutor — its
+        # atexit join would hold interpreter shutdown for a fetch in
+        # flight (up to the 5s get timeout).
+        self._resolving: set = set()  # hexes being fetched right now
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="ref-await"
         )
@@ -124,10 +124,20 @@ class _RefWaiter:
                 time.sleep(0.05)
             for r in ready:
                 with self._cv:
-                    if r.hex in self._resolving:
-                        continue  # a pool worker already owns this fetch
+                    if (
+                        r.hex in self._resolving
+                        or len(self._resolving) >= self._MAX_RESOLVERS
+                    ):
+                        # owned by a resolver, or all slots busy: the ref
+                        # stays pending and retries next round
+                        continue
                     self._resolving.add(r.hex)
-                self._resolve_pool.submit(self._resolve_one, rt, r)
+                threading.Thread(
+                    target=self._resolve_one,
+                    args=(rt, r),
+                    daemon=True,
+                    name="ref-resolve",
+                ).start()
 
     def _resolve_one(self, rt, r: "ObjectRef") -> None:
         try:

@@ -396,9 +396,13 @@ class Runtime:
     # submission (NormalTaskSubmitter analog)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
-        if (spec.runtime_env or {}).get("pip"):
+        from ray_tpu.cluster.pip_env import ENV_KINDS
+
+        if any(
+            (spec.runtime_env or {}).get(k) is not None for k in ENV_KINDS
+        ):
             raise NotImplementedError(
-                "pip runtime environments need per-env worker processes — "
+                "pip/uv/conda runtime environments need per-env worker processes — "
                 "run against a cluster (ray_tpu.init(address=...) or "
                 "Cluster()); the in-process runtime shares one interpreter"
             )

@@ -170,7 +170,7 @@ def test_local_runtime_rejects_pip_env():
         f = ray_tpu.remote(_ver).options(
             runtime_env={"pip": ["conflictpkg==1.0.0"]}
         )
-        with pytest.raises(NotImplementedError, match="pip runtime"):
+        with pytest.raises(NotImplementedError, match="pip/uv/conda runtime"):
             f.remote()
     finally:
         ray_tpu.shutdown()
