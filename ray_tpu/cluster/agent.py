@@ -27,14 +27,7 @@ from ray_tpu.scheduler import ResourceRequest, ResourceVocab
 from ray_tpu.scheduler.instances import NodeAcceleratorState
 from ray_tpu.scheduler.resources import make_ledger
 
-from .pip_env import ENV_KINDS, env_slice
-
-
-def _has_env(runtime_env) -> bool:
-    """True when the lease needs an isolated-env-bound worker."""
-    return bool(runtime_env) and any(
-        runtime_env.get(k) is not None for k in ENV_KINDS
-    )
+from .pip_env import env_slice, has_env as _has_env
 
 from .common import (
     REPORT_PERIOD_S,

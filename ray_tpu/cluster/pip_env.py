@@ -42,6 +42,13 @@ from typing import Dict, List, Optional, Tuple
 ENV_KINDS = ("pip", "uv", "conda")
 
 
+def has_env(runtime_env) -> bool:
+    """True when a runtime_env needs an isolated-env-bound worker."""
+    return bool(runtime_env) and any(
+        runtime_env.get(k) is not None for k in ENV_KINDS
+    )
+
+
 def env_slice(runtime_env) -> Optional[Dict[str, object]]:
     """The isolated-env portion of a runtime_env: {"pip": ...},
     {"uv": ...}, or {"conda": ...} (at most one), else None."""

@@ -385,12 +385,9 @@ class ActorClass:
                 scheduling_strategy=opts.get("scheduling_strategy"),
                 runtime_env=opts.get("runtime_env"),
             )
-        from ray_tpu.cluster.pip_env import ENV_KINDS
+        from ray_tpu.cluster.pip_env import has_env
 
-        if any(
-            (opts.get("runtime_env") or {}).get(k) is not None
-            for k in ENV_KINDS
-        ):
+        if has_env(opts.get("runtime_env")):
             raise NotImplementedError(
                 "pip/uv/conda runtime environments need per-env worker processes — "
                 "run against a cluster (ray_tpu.init(address=...) or "

@@ -122,14 +122,13 @@ class _RefWaiter:
             except Exception:  # noqa: BLE001 - runtime mid-swap/teardown
                 ready = []
                 time.sleep(0.05)
+            slots_full = False
             for r in ready:
                 with self._cv:
-                    if (
-                        r.hex in self._resolving
-                        or len(self._resolving) >= self._MAX_RESOLVERS
-                    ):
-                        # owned by a resolver, or all slots busy: the ref
-                        # stays pending and retries next round
+                    if r.hex in self._resolving:
+                        continue  # already owned by a resolver
+                    if len(self._resolving) >= self._MAX_RESOLVERS:
+                        slots_full = True
                         continue
                     self._resolving.add(r.hex)
                 threading.Thread(
@@ -138,6 +137,11 @@ class _RefWaiter:
                     daemon=True,
                     name="ref-resolve",
                 ).start()
+            if slots_full:
+                # a sealed ref is waiting on a slot: wait_many would
+                # return it instantly, so pause instead of re-polling in
+                # a zero-delay spin until a resolver frees up
+                time.sleep(0.05)
 
     def _resolve_one(self, rt, r: "ObjectRef") -> None:
         try:

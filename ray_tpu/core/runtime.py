@@ -396,11 +396,9 @@ class Runtime:
     # submission (NormalTaskSubmitter analog)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
-        from ray_tpu.cluster.pip_env import ENV_KINDS
+        from ray_tpu.cluster.pip_env import has_env
 
-        if any(
-            (spec.runtime_env or {}).get(k) is not None for k in ENV_KINDS
-        ):
+        if has_env(spec.runtime_env):
             raise NotImplementedError(
                 "pip/uv/conda runtime environments need per-env worker processes — "
                 "run against a cluster (ray_tpu.init(address=...) or "

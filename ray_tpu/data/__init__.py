@@ -15,8 +15,10 @@ from .dataset import (  # noqa: F401
 )
 from .execution import ActorPoolStrategy, actors  # noqa: F401
 from .io import (  # noqa: F401
+    from_numpy,
     from_pandas,
     read_csv,
+    read_json,
     read_parquet,
     to_pandas,
     write_csv,

@@ -13,21 +13,46 @@ import ray_tpu
 # -- block-level task (executed remotely) -----------------------------------
 
 
-def _apply_batches(fn: Callable, block: List[Any], kwargs: dict) -> List[Any]:
-    """One map_batches op over one block: slice into batches, convert to
-    the requested batch_format, apply, convert back to rows."""
-    size = kwargs.get("batch_size") or len(block) or 1
+def _apply_batches(fn: Callable, block: Any, kwargs: dict):
+    """One map_batches op over one block: slice into batches (zero-copy
+    for Arrow blocks), convert to the requested batch_format, apply,
+    convert back to a block (Arrow preferred for tabular results)."""
+    from . import block as blk
+
+    n = blk.block_len(block)
+    size = kwargs.get("batch_size") or n or 1
     fmt = kwargs.get("batch_format") or "numpy"
-    out: List[Any] = []
-    for i in range(0, len(block), size):
+    if blk.is_arrow(block):
+        results = []
+        for i in range(0, n, size):
+            piece = blk.slice_block(block, i, min(size, n - i))
+            results.append(
+                blk.batch_to_block(fn(blk.arrow_to_batch(piece, fmt)))
+            )
+        if not results:
+            return block
+        if all(blk.is_arrow(r) for r in results):
+            return blk.concat_blocks(results)
+        out: List[Any] = []
+        for r in results:
+            out.extend(blk.block_rows(r))
+        return out
+    out = []
+    for i in range(0, n, size):
         batch = _rows_to_batch(block[i : i + size], fmt)
         result = fn(batch)
         out.extend(_batch_to_rows(result))
     return out
 
 
-def _apply_chain_local(block: List[Any], ops: List[tuple]) -> List[Any]:
+def _apply_chain_local(block: Any, ops: List[tuple]) -> Any:
+    from . import block as blk
+
     for kind, fn, kwargs in ops:
+        if kind != "map_batches" and blk.is_arrow(block):
+            # row-wise ops see rows (block-accessor row view): one
+            # materialization at the op boundary
+            block = blk.block_rows(block)
         if kind == "map":
             block = [fn(row) for row in block]
         elif kind == "filter":
@@ -41,12 +66,16 @@ def _apply_chain_local(block: List[Any], ops: List[tuple]) -> List[Any]:
 
 _apply_chain = ray_tpu.remote(_apply_chain_local)
 
-_BATCH_FORMATS = ("numpy", "default", "pandas")
+_BATCH_FORMATS = ("numpy", "default", "pandas", "pyarrow")
 
 
 def _rows_to_batch(rows: List[Any], batch_format: str = "numpy"):
     """Batch conversion. "numpy"/"default": dict of numpy arrays (the
-    reference's default); "pandas": a DataFrame."""
+    reference's default); "pandas": a DataFrame; "pyarrow": a Table."""
+    if batch_format == "pyarrow":
+        from . import block as blk
+
+        return blk.rows_to_arrow(rows)
     if batch_format == "pandas":
         import pandas as pd
 
@@ -60,6 +89,10 @@ def _rows_to_batch(rows: List[Any], batch_format: str = "numpy"):
 
 
 def _batch_to_rows(batch: Any) -> List[Any]:
+    from . import block as blk
+
+    if blk.is_arrow(batch):
+        return blk.block_rows(batch)
     if type(batch).__name__ == "DataFrame":  # pandas without the import
         return batch.to_dict("records")
     if isinstance(batch, dict):
@@ -178,15 +211,57 @@ class Dataset:
             )
         return Dataset(self._input_blocks, self._ops + [op])
 
-    def repartition(self, num_blocks: int) -> "Dataset":
-        """All-to-all rebalance via the distributed shuffle (round-robin
-        random partition; reference repartition exchange ops)."""
-        from .shuffle import shuffle_blocks
+    def repartition(
+        self,
+        num_blocks: Optional[int] = None,
+        *,
+        target_block_bytes: Optional[int] = None,
+    ) -> "Dataset":
+        """Rebalance blocks. ``num_blocks``: all-to-all via the
+        distributed shuffle (round-robin random partition; reference
+        repartition exchange ops). ``target_block_bytes``: block-SIZE-
+        aware local coalesce/split — adjacent blocks merge until the
+        byte target (Arrow ``nbytes``; pickled estimate for row lists)
+        and oversized blocks split, preserving row order (the
+        reference's target-size block splitting)."""
+        if (num_blocks is None) == (target_block_bytes is None):
+            raise ValueError(
+                "pass exactly one of num_blocks / target_block_bytes"
+            )
+        if num_blocks is not None:
+            from .shuffle import shuffle_blocks
 
-        refs = shuffle_blocks(
-            self._executed_blocks(), num_blocks, mode="random", seed=0
-        )
-        return Dataset(refs, [])
+            refs = shuffle_blocks(
+                self._executed_blocks(), num_blocks, mode="random", seed=0
+            )
+            return Dataset(refs, [])
+        from . import block as blk
+
+        out: List[Any] = []
+        acc: List[Any] = []
+        acc_bytes = 0
+        for b in self.iter_blocks():
+            n = blk.block_len(b)
+            if n == 0:
+                continue
+            nbytes = blk.block_nbytes(b)
+            if nbytes > target_block_bytes and n > 1:
+                if acc:
+                    out.append(blk.concat_blocks(acc))
+                    acc, acc_bytes = [], 0
+                per_row = max(1, nbytes // n)
+                rows_per = max(1, int(target_block_bytes // per_row))
+                for i in range(0, n, rows_per):
+                    out.append(blk.slice_block(b, i, min(rows_per, n - i)))
+                continue
+            acc.append(b)
+            acc_bytes += nbytes
+            if acc_bytes >= target_block_bytes:
+                out.append(blk.concat_blocks(acc))
+                acc, acc_bytes = [], 0
+        if acc:
+            out.append(blk.concat_blocks(acc))
+        return Dataset(out, [])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Distributed two-stage random shuffle (hash-shuffle op analog):
@@ -481,20 +556,38 @@ class Dataset:
             yield ray_tpu.get(ref)
 
     def iter_rows(self) -> Iterator[Any]:
+        from . import block as blk
+
         for block in self.iter_blocks():
-            yield from block
+            yield from blk.rows_iter(block)
 
     def iter_batches(
         self, *, batch_size: int = 256, batch_format: str = "numpy"
-    ) -> Iterator[Dict[str, np.ndarray]]:
+    ) -> Iterator[Any]:
+        """Arrow blocks batch as zero-copy slices (a block boundary may
+        yield a short batch); row-list blocks buffer across blocks."""
+        from . import block as blk
+
         buf: List[Any] = []
-        for row in self.iter_rows():
-            buf.append(row)
-            if len(buf) >= batch_size:
-                yield _rows_to_batch(buf)
-                buf = []
+        for block in self.iter_blocks():
+            if blk.is_arrow(block):
+                if buf:
+                    yield _rows_to_batch(buf, batch_format)
+                    buf = []
+                n = block.num_rows
+                for i in range(0, n, batch_size):
+                    piece = blk.slice_block(
+                        block, i, min(batch_size, n - i)
+                    )
+                    yield blk.arrow_to_batch(piece, batch_format)
+                continue
+            for row in block:
+                buf.append(row)
+                if len(buf) >= batch_size:
+                    yield _rows_to_batch(buf, batch_format)
+                    buf = []
         if buf:
-            yield _rows_to_batch(buf)
+            yield _rows_to_batch(buf, batch_format)
 
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
@@ -541,7 +634,9 @@ def _scalar(row: Any, on: Optional[str]) -> Any:
 
 @ray_tpu.remote
 def _block_agg(block: List[Any], ops: List[tuple], agg: str, on: Optional[str]):
-    block = _apply_chain_local(block, ops)
+    from . import block as blk
+
+    block = blk.block_rows(_apply_chain_local(block, ops))
     values = [_scalar(r, on) for r in block]
     if agg == "sum":
         return builtins.sum(values) if values else None

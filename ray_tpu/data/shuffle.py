@@ -47,6 +47,9 @@ def _compute_parts(
     seed: Optional[int],
 ) -> List[List[Any]]:
     """Split one block into num_parts lists (shared by both map tasks)."""
+    from .block import block_rows
+
+    block = block_rows(block)  # hash/range partitioning is row-wise
     parts: List[List[Any]] = [[] for _ in range(num_parts)]
     if mode == "random":
         rng = np.random.default_rng(seed)
@@ -233,7 +236,9 @@ def sample_bounds(
 
     @ray_tpu.remote
     def sample(block):
-        keys = [key_fn(r) if key_fn else r for r in block]
+        from ray_tpu.data.block import block_rows
+
+        keys = [key_fn(r) if key_fn else r for r in block_rows(block)]
         if len(keys) <= samples_per_block:
             return keys
         idx = np.random.default_rng(0).choice(

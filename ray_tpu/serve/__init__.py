@@ -17,4 +17,5 @@ from .deployment import (  # noqa: F401
     shutdown,
     start_grpc_ingress,
     start_http_proxy,
+    start_proto_grpc_ingress,
 )

@@ -195,6 +195,81 @@ class _ReplicaSet:
             self._watch_cv.notify()
         return ref
 
+    class _StreamRequest:
+        """Iterator over a streaming replica call that releases the
+        replica's ongoing count exactly once — on exhaustion, error,
+        close, OR drop-before-first-next (a generator's ``finally`` never
+        runs if its frame never starts, which leaked the count when a
+        gRPC client cancelled before the first message)."""
+
+        def __init__(self, rs, replica, gen):
+            self._rs = rs
+            self._replica = replica
+            self._gen = gen
+            self._done = False
+
+        def _finish(self) -> None:
+            if not self._done:
+                self._done = True
+                self._rs._stream_finished(self._replica)
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self._done:
+                raise StopIteration
+            try:
+                return next(self._gen)
+            except BaseException:
+                self._finish()
+                raise
+
+        def close(self) -> None:
+            self._finish()
+
+        def __del__(self):
+            self._finish()
+
+    def submit_streaming(self, method: str, args, kwargs):
+        """Route a server-streaming call to a replica as a
+        num_returns="streaming" actor method; returns an iterator of
+        item ObjectRefs. The replica's ongoing count holds until the
+        stream is fully consumed (or dropped), then drains like any
+        completed request. Cluster runtime only (the in-process runtime
+        has no per-item actor-method streaming)."""
+        with self.lock:
+            replica = self._pick_replica(None, False)
+            replica.ongoing += 1
+            self.total_requests += 1
+            actor = replica.actor
+        try:
+            gen = (
+                getattr(actor, method)
+                .options(num_returns="streaming")
+                .remote(*args, **kwargs)
+            )
+        except BaseException:
+            with self.lock:
+                replica.ongoing -= 1
+            raise
+
+        return self._StreamRequest(self, replica, gen)
+
+    def _stream_finished(self, replica) -> None:
+        to_kill = None
+        with self.lock:
+            replica.ongoing -= 1
+            if (
+                replica.draining
+                and replica.ongoing == 0
+                and replica in self.replicas
+            ):
+                self.replicas.remove(replica)
+                to_kill = replica
+        if to_kill is not None:
+            ray_tpu.kill(to_kill.actor)
+
     def _watch_loop(self):
         """Single completion watcher: decrements in-flight counters when the
         request's result seals (never on a timeout), and finishes draining
@@ -311,7 +386,7 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 
 def shutdown() -> None:
-    global _http_server, _grpc_server
+    global _http_server, _grpc_server, _proto_grpc_server
     _controller_stop.set()
     for rs in _apps.values():
         rs.close()
@@ -327,6 +402,9 @@ def shutdown() -> None:
     if _grpc_server is not None:
         _grpc_server.shutdown()
         _grpc_server = None
+    if _proto_grpc_server is not None:
+        _proto_grpc_server.stop()
+        _proto_grpc_server = None
 
 
 _grpc_server = None
@@ -352,6 +430,33 @@ def start_grpc_ingress(port: int = 0) -> str:
                 f"cannot also bind port {port} (call serve.shutdown() first)"
             )
         return _grpc_server.address
+
+
+_proto_grpc_server = None
+
+
+def start_proto_grpc_ingress(
+    registrations, port: int = 0
+) -> str:
+    """Protobuf-interop gRPC ingress (reference grpc_util.py gRPCProxy):
+    ``registrations`` is a list of ``(add_<Service>Servicer_to_server,
+    deployment_name)`` pairs using the user's GENERATED grpc code — any
+    grpcio client with its own compiled stubs (no ray_tpu installed)
+    calls the deployment's methods; server-streaming methods stream via
+    num_returns="streaming" replica calls. Returns "host:port"."""
+    global _proto_grpc_server
+    from .proto_ingress import ProtoGrpcIngress
+
+    with _grpc_lock:
+        if _proto_grpc_server is not None:
+            raise RuntimeError(
+                "proto gRPC ingress already running at "
+                f"{_proto_grpc_server.address}; serve.shutdown() first"
+            )
+        _proto_grpc_server = ProtoGrpcIngress(
+            _apps, list(registrations), port=port
+        )
+        return _proto_grpc_server.address
 
 
 def start_http_proxy(port: int = 8000) -> int:
