@@ -206,14 +206,19 @@ class NodeAgent:
             inner = _MemStore()
             self.store_path = ""
         from ray_tpu.native.spill import SpillingStore
+        from ray_tpu.native.spill_storage import storage_from_uri
 
+        spill_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"ray_tpu_spill_{self.node_id}_{os.getpid()}",
+        )
         self.store = SpillingStore(
             inner,
-            spill_dir=os.path.join(
-                tempfile.gettempdir(),
-                f"ray_tpu_spill_{self.node_id}_{os.getpid()}",
-            ),
+            spill_dir=spill_dir,
             capacity=store_capacity,
+            # remote spill (external_storage.py analog): file:// (default)
+            # | memory:// | s3://bucket/prefix
+            backend=storage_from_uri(cfg.spill_storage_uri, spill_dir),
         )
 
         # --- bundle (placement group) reservations ---

@@ -139,6 +139,13 @@ define(
     "kernel gains for tiny rounds; 0 = always use the device kernels).",
 )
 define(
+    "spill_storage_uri",
+    "",
+    "External spill storage for the object plane (external_storage.py "
+    "analog): empty = node-local spill dir; file:///path; memory://; "
+    "s3://bucket/prefix (boto3 or an injected client).",
+)
+define(
     "streaming_window",
     128,
     "num_returns='streaming' backpressure: max items an executor seals "

@@ -39,9 +39,10 @@ def _apply_batches(fn: Callable, block: Any, kwargs: dict):
         return out
     out = []
     for i in range(0, n, size):
-        batch = _rows_to_batch(block[i : i + size], fmt)
-        result = fn(batch)
-        out.extend(_batch_to_rows(result))
+        rows = block[i : i + size]
+        scalar_rows = not (rows and isinstance(rows[0], dict))
+        result = fn(_rows_to_batch(rows, fmt))
+        out.extend(_batch_to_rows(result, unwrap_scalar=scalar_rows))
     return out
 
 
@@ -88,7 +89,11 @@ def _rows_to_batch(rows: List[Any], batch_format: str = "numpy"):
     return {"data": np.asarray(rows)}
 
 
-def _batch_to_rows(batch: Any) -> List[Any]:
+def _batch_to_rows(batch: Any, unwrap_scalar: bool = False) -> List[Any]:
+    """``unwrap_scalar`` is set ONLY when the batch was built by wrapping
+    NON-dict rows into a synthetic "data" column (_rows_to_batch): a real
+    dataset whose rows are {"data": ...} dicts must keep its shape
+    (matching block.py's metadata-marker discipline for Arrow blocks)."""
     from . import block as blk
 
     if blk.is_arrow(batch):
@@ -99,8 +104,7 @@ def _batch_to_rows(batch: Any) -> List[Any]:
         keys = list(batch.keys())
         n = len(batch[keys[0]])
         rows = [{k: batch[k][i] for k in keys} for i in range(n)]
-        # unwrap the synthetic "data" column
-        if keys == ["data"]:
+        if unwrap_scalar and keys == ["data"]:
             return [r["data"] for r in rows]
         return rows
     return list(batch)

@@ -19,10 +19,8 @@ book covers them too (it can read any arena object for spilling).
 """
 from __future__ import annotations
 
-import os
 import shutil
 import threading
-import uuid
 from collections import OrderedDict
 from typing import Dict, Optional
 
@@ -34,10 +32,20 @@ class SpillingStore:
         spill_dir: str,
         capacity: Optional[int] = None,
         headroom_frac: float = 0.1,
+        backend=None,
     ):
+        from .spill_storage import FileSystemBackend
+
         self.inner = inner
         self.spill_dir = spill_dir
-        os.makedirs(spill_dir, exist_ok=True)
+        # pluggable external storage (external_storage.py analog):
+        # node-local files by default; memory:// / s3:// via
+        # cfg.spill_storage_uri at the agent. Only a backend WE created
+        # (the per-node default) is destroyed at close: a user-configured
+        # shared target (file:// on NFS, an s3 prefix) holds other nodes'
+        # objects.
+        self._owns_backend = backend is None
+        self.backend = backend or FileSystemBackend(spill_dir)
         stats = getattr(inner, "stats", None)
         self.capacity = capacity or (stats()["capacity"] if stats else 1 << 28)
         self._headroom = int(self.capacity * headroom_frac)
@@ -50,17 +58,8 @@ class SpillingStore:
         self.metrics = {"spilled_objects": 0, "spilled_bytes": 0, "restored": 0}
 
     # -- paths ---------------------------------------------------------
-    def _path(self, oid: str) -> str:
-        return os.path.join(self.spill_dir, oid)
-
     def _write_spill_file(self, oid: str, data: bytes) -> None:
-        """Atomic write with a UNIQUE temp name: a concurrent spill and a
-        duplicate-put fallback for the same id must never race on one
-        .tmp path (os.replace of a vanished tmp is FileNotFoundError)."""
-        tmp = f"{self._path(oid)}.{uuid.uuid4().hex[:8]}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, self._path(oid))
+        self.backend.put(oid, data)
 
     @property
     def store_path(self) -> str:  # workers map the inner arena
@@ -117,10 +116,7 @@ class SpillingStore:
                     # deleted (GC) while writing — unless it was spilled by
                     # a competing path, the file must go too
                     if oid not in self._spilled:
-                        try:
-                            os.remove(self._path(oid))
-                        except OSError:
-                            pass
+                        self.backend.delete(oid)
                     continue
                 try:
                     self.inner.delete(oid)
@@ -162,12 +158,13 @@ class SpillingStore:
             if self.inner.contains(oid):
                 self._touch(oid)
                 return self.inner.get_bytes(oid)
-            spilled = oid in self._spilled or os.path.exists(self._path(oid))
+            spilled = oid in self._spilled
+        if not spilled:
+            spilled = self.backend.exists(oid)  # network probe: no lock
         if spilled:
             try:
-                with open(self._path(oid), "rb") as f:  # outside the lock
-                    data = f.read()
-            except FileNotFoundError:
+                data = self.backend.get(oid)  # outside the lock
+            except KeyError:
                 # a concurrent restore_to_arena moved it back to shm
                 with self._lock:
                     if self.inner.contains(oid):
@@ -186,11 +183,20 @@ class SpillingStore:
             if self.inner.contains(oid):
                 self._touch(oid)  # a reader is coming: keep it hot
                 return True
-            if oid not in self._spilled and not os.path.exists(self._path(oid)):
-                return False
-            with open(self._path(oid), "rb") as f:
-                data = f.read()
-            self._make_room(len(data))
+            known_spilled = oid in self._spilled
+        # backend download OUTSIDE the lock: a remote restore can be a
+        # multi-MB network read and must not stall every put/get/contains
+        if not known_spilled and not self.backend.exists(oid):
+            return False
+        try:
+            data = self.backend.get(oid)
+        except KeyError:
+            return False
+        self._make_room(len(data))
+        with self._lock:
+            if self.inner.contains(oid):
+                self._touch(oid)
+                return True  # raced another restore
             try:
                 self.inner.put_bytes(oid, data)
             except Exception:  # noqa: BLE001
@@ -198,20 +204,17 @@ class SpillingStore:
             self._resident[oid] = len(data)
             self._resident.move_to_end(oid)
             self._spilled.pop(oid, None)
-            try:
-                os.remove(self._path(oid))
-            except OSError:
-                pass
             self.metrics["restored"] += 1
-            return True
+        self.backend.delete(oid)
+        return True
 
     def contains(self, oid: str) -> bool:
         with self._lock:
-            return (
-                self.inner.contains(oid)
-                or oid in self._spilled
-                or os.path.exists(self._path(oid))
-            )
+            if self.inner.contains(oid) or oid in self._spilled:
+                return True
+        # backend probe OUTSIDE the lock: with a remote backend this is a
+        # network round-trip and must not serialize the object plane
+        return self.backend.exists(oid)
 
     def delete(self, oid: str) -> None:
         with self._lock:
@@ -221,10 +224,7 @@ class SpillingStore:
                 self.inner.delete(oid)
             except Exception:  # noqa: BLE001
                 pass
-            try:
-                os.remove(self._path(oid))
-            except OSError:
-                pass
+        self.backend.delete(oid)  # network call: outside the lock
 
     def stats(self) -> dict:
         base = getattr(self.inner, "stats", None)
@@ -241,4 +241,8 @@ class SpillingStore:
         except Exception:  # noqa: BLE001
             pass
         if unlink:
+            if self._owns_backend:
+                destroy = getattr(self.backend, "destroy", None)
+                if destroy is not None:
+                    destroy()
             shutil.rmtree(self.spill_dir, ignore_errors=True)

@@ -257,6 +257,9 @@ class _ReplicaSet:
         return self._StreamRequest(self, replica, gen)
 
     def _stream_finished(self, replica) -> None:
+        """Release one finished request's hold on ``replica`` (shared by
+        the completion watcher and streaming requests): decrement the
+        ongoing count and finish a draining replica once idle."""
         to_kill = None
         with self.lock:
             replica.ongoing -= 1
@@ -288,21 +291,19 @@ class _ReplicaSet:
             if not ready:
                 continue
             ready_set = {r.hex for r in ready}
-            to_kill = []
+            finished = []
             with self._watch_cv:
                 still = []
                 for ref, replica in self._outstanding:
                     if ref.hex in ready_set:
-                        replica.ongoing -= 1
-                        if replica.draining and replica.ongoing == 0:
-                            if replica in self.replicas:
-                                self.replicas.remove(replica)
-                            to_kill.append(replica)
+                        finished.append(replica)
                     else:
                         still.append((ref, replica))
                 self._outstanding = still
-            for replica in to_kill:
-                ray_tpu.kill(replica.actor)
+            for replica in finished:
+                # shared release path (streaming requests use it too):
+                # decrement under self.lock, drain-remove-kill once idle
+                self._stream_finished(replica)
 
     def autoscale_tick(self):
         cfg = self.dep.autoscaling_config

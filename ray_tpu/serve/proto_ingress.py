@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import inspect
 import re
-import threading
 from typing import Any, Callable, Dict, List, Tuple
 
 import ray_tpu
@@ -65,7 +64,6 @@ class ProtoGrpcIngress:
         from concurrent.futures import ThreadPoolExecutor
 
         self._apps = apps
-        self._lock = threading.Lock()
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=16, thread_name_prefix="proto-grpc")
         )
