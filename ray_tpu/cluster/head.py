@@ -1446,8 +1446,6 @@ class HeadServer:
                 self._scheduling_batch = []
             time.sleep(SCHED_TICK_S)
 
-    _UNPARK_SLACK = 32
-
     def _maybe_unpark_locked(self) -> None:
         """Rate-limited, change-gated entry to ``_unpark_grantable``:
         completions bump the change counter continuously under load;
@@ -1479,59 +1477,26 @@ class HeadServer:
         Constrained specs (strategy / PG / target-node routed) don't fit
         the shape-capacity math and unpark slack-at-a-time. Caller holds
         ``self._cond``."""
+        from ray_tpu.scheduler.unpark import select_unparkable
+
         parked = self._infeasible
         if not parked:
-            return
-        if len(parked) <= self._UNPARK_SLACK:
-            self._pending.extend(parked)
-            self._infeasible = []
             return
         with self._lock:
             _, a0, al0 = self.view.active_arrays()
             avail = a0.copy()
             alive = al0.copy()
-        r = avail.shape[1] if avail.ndim == 2 else 0
-        by_shape: Dict[object, List[LeaseRequest]] = {}
-        order: List[object] = []
-        for spec in parked:
-            if (
-                spec.strategy is not None
-                or spec.target_node
-                or spec.pg_reservation
-            ):
-                key: object = None
-            else:
-                key = tuple(sorted(spec.resources.items()))
-            q = by_shape.get(key)
-            if q is None:
-                q = by_shape[key] = []
-                order.append(key)
-            q.append(spec)
-        keep: List[LeaseRequest] = []
-        for key in order:
-            q = by_shape[key]
-            if key is None:
-                cap = self._UNPARK_SLACK
-            else:
-                req = self._spec_req(q[0])
-                if any(c >= r for c in req.demands):
-                    # names a resource no node reported: infeasible until
-                    # the cluster changes shape; slack covers vocab growth
-                    cap = self._UNPARK_SLACK
-                else:
-                    d = req.dense(r)
-                    cols = d > 0
-                    if not cols.any():
-                        cap = len(q)  # zero-demand shape: all grantable
-                    else:
-                        slots = np.floor(
-                            avail[:, cols] / d[cols][None, :]
-                        ).min(axis=1)
-                        slots = np.where(alive, np.maximum(slots, 0.0), 0.0)
-                        cap = int(slots.sum()) + self._UNPARK_SLACK
-            n = min(len(q), cap)
-            self._pending.extend(q[:n])
-            keep.extend(q[n:])
+        take, keep = select_unparkable(
+            parked,
+            avail,
+            alive,
+            is_constrained=lambda s: (
+                s.strategy is not None or s.target_node or s.pg_reservation
+            ),
+            resources_of=lambda s: s.resources,
+            request_of=self._spec_req,
+        )
+        self._pending.extend(take)
         self._infeasible = keep
 
     def _pop_fair_batch(self) -> List[LeaseRequest]:

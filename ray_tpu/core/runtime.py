@@ -180,6 +180,7 @@ class Runtime:
 
         self._lazy_device = LazyDeviceState(use_device_scheduler)
         self._parked_at_change = -1
+        self._last_park_retry = 0.0
         self._rng = np.random.default_rng(0)
         # streaming-generator state: task_id -> {"items": [hex...],
         # "done": bool} (num_returns="streaming" tasks; cluster analog
@@ -469,18 +470,15 @@ class Runtime:
                     # until the next cluster change. Retry parked work only
                     # when the view actually moved since the last drain, so
                     # truly-infeasible specs don't spin the kernel at 2 Hz.
-                    if (
-                        self._infeasible
-                        and not self._pending
-                        and self.view.change_counter != self._parked_at_change
-                    ):
-                        self._parked_at_change = self.view.change_counter
-                        self._pending.extend(self._infeasible)
-                        self._infeasible.clear()
+                    self._maybe_unpark_locked()
                     if self._dep_waiting:
                         self._pending.extend(self._admit_dep_ready())
                 if self._shutdown:
                     return
+                # parked work also retries while NEW submissions keep the
+                # queue hot (a steady submit stream would otherwise starve
+                # every parked spec — same discipline as the cluster head)
+                self._maybe_unpark_locked()
                 self._dirty = False
                 take = min(len(self._pending), MAX_SCHEDULE_BATCH)
                 batch = self._admit_dep_ready() + self._pending[:take]
@@ -512,11 +510,52 @@ class Runtime:
             self._cond.notify_all()
 
     def notify_resources_changed(self) -> None:
+        # completions only NOTIFY; the scheduler loop's capacity-capped
+        # unpark retries parked work. Draining the whole parked queue here
+        # (pre-r5) re-scheduled every parked spec on every completion —
+        # O(parked²) churn under a deep backlog (see cluster/head.py).
         with self._cond:
+            # some callers free capacity the ClusterView can't see (PG
+            # bundle releases mutate bundle-local books only): bump the
+            # change counter HERE so the change-gated unpark always fires
+            # for an explicit resource-changed notification
+            self.view.change_counter += 1
             self._dirty = True
-            self._pending.extend(self._infeasible)
-            self._infeasible.clear()
             self._cond.notify_all()
+
+    def _maybe_unpark_locked(self) -> None:
+        """Rate-limited, change-gated unpark. Caller holds self._cond."""
+        if (
+            self._infeasible
+            and self.view.change_counter != self._parked_at_change
+            and _now() - self._last_park_retry > 0.02
+        ):
+            self._parked_at_change = self.view.change_counter
+            self._last_park_retry = _now()
+            self._unpark_grantable()
+
+    def _unpark_grantable(self) -> None:
+        """Move parked specs back to pending, capped per resource shape
+        at what the view could grant (scheduler/unpark.py, shared with
+        the cluster head). Caller holds self._cond."""
+        from ray_tpu.scheduler.unpark import select_unparkable
+
+        parked = self._infeasible
+        if not parked:
+            return
+        _, a0, al0 = self.view.active_arrays()
+        take, keep = select_unparkable(
+            parked,
+            a0.copy(),
+            al0.copy(),
+            is_constrained=lambda s: s.strategy is not None,
+            resources_of=lambda s: s.resources,
+            request_of=lambda s: ResourceRequest.from_map(
+                self.vocab, s.resources
+            ),
+        )
+        self._pending.extend(take)
+        self._infeasible = keep
 
     def _try_schedule_pgs(self) -> None:
         with self._cond:
@@ -861,10 +900,10 @@ class Runtime:
                     node.accel.release(assign)
                 with self._cond:
                     self.view.update_available(node.node_id, node.ledger.avail_map())
-                    # freed capacity may unblock queued/infeasible leases
+                    # freed capacity may unblock queued/infeasible leases:
+                    # notify only — the scheduler loop's capacity-capped
+                    # unpark retries parked work (O(parked²) otherwise)
                     self._dirty = True
-                    self._pending.extend(self._infeasible)
-                    self._infeasible.clear()
                     self._cond.notify_all()
             _context.node_id = None
             _context.task_id = None

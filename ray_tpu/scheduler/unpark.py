@@ -1,0 +1,81 @@
+"""Capacity-capped unparking of infeasible-queued work.
+
+Shared by the cluster head and the in-process runtime: re-feeding the
+ENTIRE parked queue into the pending queue on every capacity-freeing
+event is O(parked²) aggregate scheduling work under a deep backlog (5k
+parked specs × ~40 unpark events re-scores ~200k placements to grant
+5k) — exactly the storm the reference avoids by leaving unschedulable
+scheduling classes parked until resources change and retrying them
+per-class (cluster_lease_manager.cc:298 TryScheduleInfeasibleLease +
+local_lease_manager.h per-class backoff). Per resource shape, the
+grantable-slot count is estimated from the live availability arrays and
+only that many specs (+slack for estimate error) unpark; the remainder
+stays parked for the next change event.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import numpy as np
+
+UNPARK_SLACK = 32
+
+
+def select_unparkable(
+    parked: List[Any],
+    avail: np.ndarray,
+    alive: np.ndarray,
+    *,
+    is_constrained: Callable[[Any], bool],
+    resources_of: Callable[[Any], dict],
+    request_of: Callable[[Any], Any],
+    slack: int = UNPARK_SLACK,
+) -> Tuple[List[Any], List[Any]]:
+    """(take, keep): specs to re-queue now vs. keep parked.
+
+    ``is_constrained``: shape-capacity math doesn't apply (affinity /
+    PG / target-node routed) — those unpark ``slack`` at a time.
+    ``request_of`` returns a ResourceRequest (``demands`` keyed by dense
+    column, ``dense(width)``)."""
+    if len(parked) <= slack:
+        return list(parked), []
+    r = avail.shape[1] if avail.ndim == 2 else 0
+    by_shape: dict = {}
+    order: List[Any] = []
+    for spec in parked:
+        if is_constrained(spec):
+            key: Any = None
+        else:
+            key = tuple(sorted(resources_of(spec).items()))
+        q = by_shape.get(key)
+        if q is None:
+            q = by_shape[key] = []
+            order.append(key)
+        q.append(spec)
+    take: List[Any] = []
+    keep: List[Any] = []
+    for key in order:
+        q = by_shape[key]
+        if key is None:
+            cap = slack
+        else:
+            req = request_of(q[0])
+            if any(c >= r for c in req.demands):
+                # names a resource no node reported: infeasible until the
+                # cluster changes shape; slack covers vocab growth
+                cap = slack
+            else:
+                d = req.dense(r)
+                cols = d > 0
+                if not cols.any():
+                    cap = len(q)  # zero-demand shape: all grantable
+                else:
+                    slots = np.floor(
+                        avail[:, cols] / d[cols][None, :]
+                    ).min(axis=1)
+                    slots = np.where(alive, np.maximum(slots, 0.0), 0.0)
+                    cap = int(slots.sum()) + slack
+        n = min(len(q), cap)
+        take.extend(q[:n])
+        keep.extend(q[n:])
+    return take, keep
