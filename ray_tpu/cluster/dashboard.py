@@ -43,6 +43,7 @@ class Dashboard:
         app.add_routes(
             [
                 web.get("/", self._index),
+                web.get("/ui", self._ui),
                 web.get("/api/cluster_status", self._cluster_status),
                 web.get("/api/nodes", self._nodes),
                 web.get("/api/nodes/{node_id}/debug", self._node_debug),
@@ -85,6 +86,13 @@ class Dashboard:
     def _json(self, data) -> web.Response:
         return web.json_response(data, dumps=lambda d: json.dumps(d, default=str))
 
+    async def _ui(self, request) -> web.Response:
+        """Self-contained live dashboard page (dashboard/client analog —
+        one HTML file polling the JSON APIs; no external assets)."""
+        from .dashboard_ui import UI_HTML
+
+        return web.Response(text=UI_HTML, content_type="text/html")
+
     async def _index(self, request) -> web.Response:
         info = self.head._h_query_state({"kind": "summary"})
         html = (
@@ -99,7 +107,10 @@ class Dashboard:
         return web.Response(text=html, content_type="text/html")
 
     async def _cluster_status(self, request) -> web.Response:
-        return self._json(self.head._h_cluster_info(None))
+        info = self.head._h_cluster_info(None)
+        info["head_address"] = self.head.address
+        info["leases"] = self.head._h_query_state({"kind": "leases"})
+        return self._json(info)
 
     async def _node_debug(self, request) -> web.Response:
         """Proxy one agent's DebugState (node_manager DebugString analog):

@@ -1451,10 +1451,15 @@ class HeadServer:
         completions bump the change counter continuously under load;
         re-routing parked specs each 2ms tick multiplies per-spec Python
         work ~10x for no placement gain. Caller holds ``self._cond``."""
-        if (
-            self._infeasible
-            and self.view.change_counter != self._parked_at_change
-            and time.monotonic() - self._last_park_retry > 0.02
+        if self._infeasible and (
+            (
+                self.view.change_counter != self._parked_at_change
+                and time.monotonic() - self._last_park_retry > 0.02
+            )
+            # liveness fallback: capacity can free without a view change
+            # (PG bundle books are bundle-local) — retry parked work at
+            # 1 Hz regardless, bounded by the per-shape cap
+            or time.monotonic() - self._last_park_retry > 1.0
         ):
             self._parked_at_change = self.view.change_counter
             self._last_park_retry = time.monotonic()
@@ -1477,10 +1482,16 @@ class HeadServer:
         Constrained specs (strategy / PG / target-node routed) don't fit
         the shape-capacity math and unpark slack-at-a-time. Caller holds
         ``self._cond``."""
-        from ray_tpu.scheduler.unpark import select_unparkable
+        from ray_tpu.scheduler.unpark import UNPARK_SLACK, select_unparkable
 
         parked = self._infeasible
         if not parked:
+            return
+        if len(parked) <= UNPARK_SLACK:
+            # below the slack there is nothing to cap: skip the view
+            # lock + array copies entirely (steady-state common case)
+            self._pending.extend(parked)
+            self._infeasible = []
             return
         with self._lock:
             _, a0, al0 = self.view.active_arrays()

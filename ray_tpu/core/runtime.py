@@ -525,10 +525,15 @@ class Runtime:
 
     def _maybe_unpark_locked(self) -> None:
         """Rate-limited, change-gated unpark. Caller holds self._cond."""
-        if (
-            self._infeasible
-            and self.view.change_counter != self._parked_at_change
-            and _now() - self._last_park_retry > 0.02
+        if self._infeasible and (
+            (
+                self.view.change_counter != self._parked_at_change
+                and _now() - self._last_park_retry > 0.02
+            )
+            # liveness fallback: capacity can free without a view change
+            # (PG bundle books are bundle-local) — retry parked work at
+            # 1 Hz regardless, bounded by the per-shape cap
+            or _now() - self._last_park_retry > 1.0
         ):
             self._parked_at_change = self.view.change_counter
             self._last_park_retry = _now()
@@ -538,17 +543,24 @@ class Runtime:
         """Move parked specs back to pending, capped per resource shape
         at what the view could grant (scheduler/unpark.py, shared with
         the cluster head). Caller holds self._cond."""
-        from ray_tpu.scheduler.unpark import select_unparkable
+        from ray_tpu.scheduler.unpark import UNPARK_SLACK, select_unparkable
 
         parked = self._infeasible
         if not parked:
+            return
+        if len(parked) <= UNPARK_SLACK:
+            self._pending.extend(parked)
+            self._infeasible = []
             return
         _, a0, al0 = self.view.active_arrays()
         take, keep = select_unparkable(
             parked,
             a0.copy(),
             al0.copy(),
-            is_constrained=lambda s: s.strategy is not None,
+            # "DEFAULT" routes through the hybrid kernels like None —
+            # only real placement constraints skip the capacity math
+            is_constrained=lambda s: s.strategy is not None
+            and s.strategy != "DEFAULT",
             resources_of=lambda s: s.resources,
             request_of=lambda s: ResourceRequest.from_map(
                 self.vocab, s.resources

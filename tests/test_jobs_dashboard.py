@@ -177,3 +177,40 @@ def test_dashboard_job_rest(cluster, tmp_path):
     assert info["status"] == "SUCCEEDED"
     with urllib.request.urlopen(f"{base}/api/jobs/{job_id}/logs", timeout=10) as r:
         assert "from-rest" in r.read().decode()
+
+
+def test_dashboard_ui_page(cluster):
+    """The self-contained web UI (dashboard/client analog): /ui serves a
+    page whose tables poll the JSON APIs, and those APIs return the
+    field names the page reads."""
+    import json
+    import urllib.request
+
+    port = cluster.head.dashboard.port
+    html = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/ui", timeout=10
+    ).read().decode()
+    for table_id in ("nodes", "actors", "pgs", "jobs", "rpc"):
+        assert f'<table id="{table_id}">' in html
+    # field-name contract between the page's JS and the APIs
+    nodes = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/nodes", timeout=10
+        ).read()
+    )
+    assert nodes and {"NodeID", "Alive", "Address", "Resources"} <= set(
+        nodes[0]
+    )
+    rpc = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/rpc_stats", timeout=10
+        ).read()
+    )
+    assert all({"count", "mean_ms", "max_ms"} <= set(v) for v in rpc.values())
+    status = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/cluster_status", timeout=10
+        ).read()
+    )
+    assert status["head_address"]
+    assert {"pending", "infeasible", "in_flight"} <= set(status["leases"])
