@@ -215,14 +215,14 @@ class ActorState:
         from .runtime import ActorDiedError
 
         for call in calls:
+            err = ActorDiedError(f"actor {self.name or self.actor_id} {why}")
+            if call.get("stream_tid"):
+                # a queued streaming call dies with the actor: end the
+                # stream with the error as its final item
+                self.runtime._fail_stream(call["stream_tid"], err)
+                continue
             for ref in call["returns"]:
-                self.runtime.store.seal(
-                    ref,
-                    ActorDiedError(
-                        f"actor {self.name or self.actor_id} {why}"
-                    ),
-                    is_error=True,
-                )
+                self.runtime.store.seal(ref, err, is_error=True)
 
     def _stop_event_loop(self) -> None:
         loop = self._loop
@@ -238,20 +238,24 @@ class ActorState:
 
     # -- method invocation ---------------------------------------------
     def submit_method(
-        self, method_name: str, args: tuple, kwargs: dict, returns: List[ObjectRef]
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        returns: List[ObjectRef],
+        stream_tid: Optional[str] = None,
     ) -> None:
         from .runtime import ActorDiedError
 
         with self._cond:
             if self.dead_forever:
+                err = ActorDiedError(
+                    f"actor {self.name or self.actor_id} is dead"
+                )
+                if stream_tid is not None:
+                    self.runtime._fail_stream(stream_tid, err)
                 for ref in returns:
-                    self.runtime.store.seal(
-                        ref,
-                        ActorDiedError(
-                            f"actor {self.name or self.actor_id} is dead"
-                        ),
-                        is_error=True,
-                    )
+                    self.runtime.store.seal(ref, err, is_error=True)
                 return
             group = self._method_group(method_name)
             call = {
@@ -261,6 +265,7 @@ class ActorState:
                 "returns": returns,
                 "attempt": 0,
                 "group": group,
+                "stream_tid": stream_tid,
             }
             if self.is_async and self.alive:
                 self._dispatch_async(call)
@@ -334,6 +339,41 @@ class ActorState:
         ctx.node_id = self.node_id
         ctx.actor_id = self.actor_id
         try:
+            if call.get("stream_tid"):
+                # num_returns="streaming" method: the generator drives the
+                # runtime's per-item stream machinery; ANY failure —
+                # argument resolution included — seals as the final
+                # stream item (no per-call retries: a resumed generator
+                # cannot replay consumed yields). The dag_lock spans the
+                # WHOLE drive: a generator function body runs lazily, so
+                # locking only its creation would serialize nothing.
+                tid = call["stream_tid"]
+                import contextlib
+
+                try:
+                    args, kwargs = self.runtime._resolve_args(
+                        call["args"], call["kwargs"]
+                    )
+                    fn = getattr(instance, call["method"])
+                    guard = (
+                        self.dag_lock
+                        if self.dag_lock is not None
+                        else contextlib.nullcontext()
+                    )
+                    with guard:
+                        gen = fn(*args, **kwargs)
+                        self.runtime.run_actor_stream(
+                            tid, self.node_id, gen
+                        )
+                    self.runtime.metrics["tasks_finished"] += 1
+                except BaseException as exc:  # noqa: BLE001
+                    err = TaskError(
+                        exc, f"{self.cls.__name__}.{call['method']}"
+                    )
+                    err.__cause__ = exc
+                    self.runtime._fail_stream(tid, err)
+                    self.runtime.metrics["tasks_failed"] += 1
+                return
             args, kwargs = self.runtime._resolve_args(call["args"], call["kwargs"])
             fn = getattr(instance, call["method"])
             lock = self.dag_lock
@@ -436,11 +476,26 @@ class ActorHandle:
 
     def _invoke(self, method_name, args, kwargs, num_returns):
         if num_returns == "streaming":
-            raise NotImplementedError(
-                "num_returns='streaming' on actor methods requires the "
-                "cluster runtime (ray_tpu.init(address=...) or Cluster()); "
-                "the in-process runtime streams from tasks only"
+            from ray_tpu.cluster.common import new_id
+            from .object_store import ObjectRefGenerator
+
+            state = self._actor_state
+            target = getattr(state.cls, method_name, None)
+            if (
+                state.is_async
+                or inspect.iscoroutinefunction(target)
+                or inspect.isasyncgenfunction(target)
+            ):
+                raise TypeError(
+                    "num_returns='streaming' is not supported on async "
+                    "actors; use a sync actor or a task"
+                )
+            tid = new_id()
+            self._runtime.metrics["tasks_submitted"] += 1
+            state.submit_method(
+                method_name, args, kwargs, [], stream_tid=tid
             )
+            return ObjectRefGenerator(tid, self._runtime)
         refs = [ObjectRef.new(owner=self._actor_id) for _ in range(num_returns)]
         for r in refs:
             self._runtime.store.create(r)

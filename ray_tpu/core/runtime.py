@@ -885,7 +885,7 @@ class Runtime:
                 for rid in spec.return_ids:
                     self._seal_id(None, rid, err, is_error=True)
                 if spec.streaming:
-                    self._fail_stream(spec, err)
+                    self._fail_stream(spec.task_id, err)
                 if spec.kind == "actor_creation":
                     state = self._actors.get(spec.actor_id)
                     if state is not None:
@@ -960,11 +960,23 @@ class Runtime:
         return res_args, res_kwargs
 
     def _run_streaming(self, spec: TaskSpec, node: Node, gen: Any) -> None:
-        """Drive a ``num_returns="streaming"`` task: seal every yield as
-        its own object under stream_item_id(task_id, i) and publish it to
-        the stream state consumers long-poll via ``stream_next``. Item
-        appends are idempotent by index, so a retried generator re-seals
-        the same ids without duplicating stream entries."""
+        """Drive a ``num_returns="streaming"`` task (lineage registered:
+        tasks re-execute on object loss)."""
+        self._drive_stream(spec.task_id, node, gen, lineage_spec=spec)
+
+    def run_actor_stream(self, task_id: str, node_id: str, gen: Any) -> None:
+        """Drive a streaming ACTOR-method call (no lineage — actor
+        methods are not re-executable)."""
+        self._drive_stream(task_id, self.nodes.get(node_id), gen)
+
+    def _drive_stream(
+        self, task_id: str, node, gen: Any, lineage_spec=None
+    ) -> None:
+        """Seal every yield as its own object under
+        stream_item_id(task_id, i) and publish it to the stream state
+        consumers long-poll via ``stream_next``. Item appends are
+        idempotent by index, so a retried generator re-seals the same
+        ids without duplicating stream entries."""
         from ray_tpu.cluster.common import stream_item_id
 
         if not hasattr(gen, "__next__"):
@@ -974,12 +986,13 @@ class Runtime:
             value = next(gen, _STREAM_END)
             if value is _STREAM_END:
                 break
-            oid = stream_item_id(spec.task_id, idx)
-            self._lineage[oid] = spec
+            oid = stream_item_id(task_id, idx)
+            if lineage_spec is not None:
+                self._lineage[oid] = lineage_spec
             self._seal_id(node, oid, value)
             with self._stream_cv:
                 st = self._streams.setdefault(
-                    spec.task_id, {"items": [], "done": False}
+                    task_id, {"items": [], "done": False}
                 )
                 if idx == len(st["items"]):
                     st["items"].append(oid)
@@ -994,14 +1007,14 @@ class Runtime:
             idx += 1
         with self._stream_cv:
             st = self._streams.setdefault(
-                spec.task_id, {"items": [], "done": False}
+                task_id, {"items": [], "done": False}
             )
             st["done"] = True
             if st.get("abandoned"):
-                self._streams.pop(spec.task_id, None)
+                self._streams.pop(task_id, None)
             self._stream_cv.notify_all()
 
-    def _fail_stream(self, spec: TaskSpec, err: Any) -> None:
+    def _fail_stream(self, task_id: str, err: Any) -> None:
         """Mid-stream failure, retries exhausted: the NEXT item the
         consumer sees is a ref whose get() raises (reference generator
         semantics), then the stream ends."""
@@ -1009,10 +1022,10 @@ class Runtime:
 
         with self._stream_cv:
             st = self._streams.setdefault(
-                spec.task_id, {"items": [], "done": False}
+                task_id, {"items": [], "done": False}
             )
             if not st["done"]:
-                oid = stream_item_id(spec.task_id, len(st["items"]))
+                oid = stream_item_id(task_id, len(st["items"]))
                 self._seal_id(None, oid, err, is_error=True)
                 st["items"].append(oid)
                 st["done"] = True

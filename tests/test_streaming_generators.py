@@ -212,3 +212,67 @@ def test_cluster_streaming_worker_kill_mid_stream_recovers():
         set_runtime(None)
         rt.shutdown()
         c.shutdown()
+
+
+def test_local_actor_method_streaming(rt):
+    """num_returns="streaming" on a sync actor method, in-process
+    runtime (parity with the cluster path)."""
+
+    @ray_tpu.remote
+    class Gen:
+        def __init__(self):
+            self.base = 100
+
+        def stream(self, n):
+            for i in range(n):
+                yield self.base + i
+
+        def boom(self):
+            yield 1
+            raise ValueError("mid-stream")
+
+    a = Gen.options(num_cpus=0.5).remote()
+    g = a.stream.options(num_returns="streaming").remote(6)
+    assert isinstance(g, ObjectRefGenerator)
+    assert [ray_tpu.get(r, timeout=30) for r in g] == [
+        100 + i for i in range(6)
+    ]
+    it = iter(a.boom.options(num_returns="streaming").remote())
+    assert ray_tpu.get(next(it), timeout=30) == 1
+    with pytest.raises(TaskError):
+        ray_tpu.get(next(it), timeout=30)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_local_async_actor_streaming_rejected(rt):
+    @ray_tpu.remote
+    class A:
+        async def m(self):
+            yield 1
+
+    a = A.options(num_cpus=0.5).remote()
+    with pytest.raises(TypeError, match="async actors"):
+        a.m.options(num_returns="streaming").remote()
+
+
+def test_local_actor_streaming_bad_arg_fails_stream(rt):
+    """A failure BEFORE the generator exists (argument resolution) still
+    ends the stream with an error item — the consumer never hangs."""
+
+    @ray_tpu.remote
+    def explode():
+        raise RuntimeError("dep failed")
+
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, x):
+            yield x
+
+    bad_ref = explode.options(num_cpus=0.5, max_retries=0).remote()
+    a = Gen.options(num_cpus=0.5).remote()
+    it = iter(a.stream.options(num_returns="streaming").remote(bad_ref))
+    with pytest.raises(TaskError):
+        ray_tpu.get(next(it), timeout=30)
+    with pytest.raises(StopIteration):
+        next(it)
