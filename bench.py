@@ -51,6 +51,8 @@ R = 16
 
 BASELINE_E2E_TASKS_PER_S = 594.04  # many_tasks.json (64x64-core cluster)
 BASELINE_NN_ASYNC_CALLS_PER_S = 22_974.9  # microbenchmark.json n_n_actor_calls_async
+BASELINE_ACTORS_PER_S = 421.58  # many_actors.json (64x64-core cluster)
+BASELINE_PG_PAIRS_PER_S = 588.8  # microbenchmark.json placement_group_create/removal
 
 
 # ---------------------------------------------------------------------------
@@ -512,6 +514,41 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
         per_core = async_calls_per_s / cores
         baseline_per_core = BASELINE_NN_ASYNC_CALLS_PER_S / 64.0
 
+        # tier 6: actor-creation throughput (many_actors.json analog) —
+        # create N tiny actors, wait until every one answered a ping
+        # (state ALIVE + method served), then release them
+        # worker processes spawn per actor (reference worker_pool.cc
+        # semantics) and a jax-importing worker costs seconds on this
+        # 1-core host — size for that; the honest comparison is per-core
+        # (the baseline ran on 64x64 cores)
+        n_actors = int(os.environ.get("RAY_TPU_BENCH_ACTORS", 20))
+        t0 = time.perf_counter()
+        creations = [
+            Echo.options(num_cpus=0.01, max_restarts=0).remote()
+            for _ in range(n_actors)
+        ]
+        ray_tpu.get([a.ping.remote(0) for a in creations], timeout=600)
+        actors_per_s = n_actors / (time.perf_counter() - t0)
+        for h_ in creations:
+            try:
+                ray_tpu.kill(h_)
+            except Exception:  # noqa: BLE001
+                pass
+
+        # tier 7: placement-group create/removal pairs (microbenchmark.json
+        # placement_group_create/removal analog): each pair runs the JAX
+        # bundle packer + 2PC prepare/commit + return on the agents
+        n_pairs = int(os.environ.get("RAY_TPU_BENCH_PG_PAIRS", 60))
+        t0 = time.perf_counter()
+        for _ in range(n_pairs):
+            pg = ray_tpu.placement_group(
+                [{"CPU": 0.1}, {"CPU": 0.1}], strategy="PACK"
+            )
+            if not pg.wait(60):
+                raise RuntimeError("placement group never became ready")
+            ray_tpu.remove_placement_group(pg)
+        pg_pairs_per_s = n_pairs / (time.perf_counter() - t0)
+
         # tier 5: Data actor-pool map_batches over many blocks — the
         # BASELINE.json config "map_batches over 50k blocks, actor-pool
         # scheduling" (reference: actor_pool_map_operator.py). Block
@@ -573,6 +610,19 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
                 **{str(k): v for k, v in async_scaling.items()},
                 str(N): round(async_calls_per_s, 1),
             },
+            "actor_creations_per_s": round(actors_per_s, 2),
+            "actors_vs_baseline": round(
+                actors_per_s / BASELINE_ACTORS_PER_S, 4
+            ),
+            # baseline ran on 64 nodes x 64 cores; this host has `cores`
+            "actors_per_core_vs_baseline_per_core": round(
+                (actors_per_s / cores) / (BASELINE_ACTORS_PER_S / 4096.0),
+                2,
+            ),
+            "pg_create_remove_pairs_per_s": round(pg_pairs_per_s, 1),
+            "pg_pairs_vs_baseline": round(
+                pg_pairs_per_s / BASELINE_PG_PAIRS_PER_S, 3
+            ),
             **dag_metrics,
         }
     finally:

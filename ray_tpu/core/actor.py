@@ -491,6 +491,9 @@ class ActorHandle:
                     "actors; use a sync actor or a task"
                 )
             tid = new_id()
+            # state exists from submission so an abandon arriving before
+            # the executor starts sticks (runtime.register_stream)
+            self._runtime.register_stream(tid)
             self._runtime.metrics["tasks_submitted"] += 1
             state.submit_method(
                 method_name, args, kwargs, [], stream_tid=tid

@@ -414,6 +414,8 @@ class Runtime:
             self.store.create(ref, creating_task=spec.task_id)
             self._lineage[ref.hex] = spec
         self.metrics["tasks_submitted"] += 1
+        if spec.streaming:
+            self.register_stream(spec.task_id)
         from ray_tpu.util import tracing
 
         if spec.trace is None:
@@ -969,6 +971,17 @@ class Runtime:
         methods are not re-executable)."""
         self._drive_stream(task_id, self.nodes.get(node_id), gen)
 
+    def register_stream(self, task_id: str) -> None:
+        """Stream state exists from SUBMISSION (cluster-head parity): an
+        abandon arriving before the executor starts must stick, or a
+        dropped generator would later drive to completion on the
+        executor — wedging a sync actor's only thread forever."""
+        with self._stream_cv:
+            self._streams.setdefault(
+                task_id, {"items": [], "done": False}
+            )
+            self._stream_cv.notify_all()
+
     def _drive_stream(
         self, task_id: str, node, gen: Any, lineage_spec=None
     ) -> None:
@@ -983,6 +996,20 @@ class Runtime:
             gen = iter(gen)
         idx = 0
         while True:
+            with self._stream_cv:
+                st = self._streams.setdefault(
+                    task_id, {"items": [], "done": False}
+                )
+                if st.get("abandoned"):
+                    # consumer gone (possibly before our first yield):
+                    # stop producing instead of running the generator out
+                    try:
+                        gen.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._streams.pop(task_id, None)
+                    self._stream_cv.notify_all()
+                    return
             value = next(gen, _STREAM_END)
             if value is _STREAM_END:
                 break
@@ -996,14 +1023,7 @@ class Runtime:
                 )
                 if idx == len(st["items"]):
                     st["items"].append(oid)
-                abandoned = st.get("abandoned", False)
                 self._stream_cv.notify_all()
-            if abandoned:
-                try:
-                    gen.close()
-                except Exception:  # noqa: BLE001
-                    pass
-                break
             idx += 1
         with self._stream_cv:
             st = self._streams.setdefault(
@@ -1044,6 +1064,9 @@ class Runtime:
                     if index < len(st["items"]):
                         return ObjectRef(st["items"][index], owner=task_id)
                     if st["done"]:
+                        # fully drained: drop the state (it would leak one
+                        # entry per streaming call otherwise)
+                        self._streams.pop(task_id, None)
                         return None
                 elif self._shutdown:
                     return None
@@ -1057,13 +1080,16 @@ class Runtime:
                 self._stream_cv.wait(timeout=wait_s)
 
     def stream_abandon(self, task_id: str) -> None:
-        """Consumer dropped the generator: make the state GC-able (the
-        in-process executor has no backpressure window to unwedge)."""
+        """Consumer dropped the generator: stop production and make the
+        state GC-able."""
         with self._stream_cv:
             st = self._streams.get(task_id)
             if st is not None and st["done"]:
                 self._streams.pop(task_id, None)
-            elif st is not None:
+            else:
+                st = self._streams.setdefault(
+                    task_id, {"items": [], "done": False}
+                )
                 st["abandoned"] = True
             self._stream_cv.notify_all()
 

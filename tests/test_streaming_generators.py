@@ -276,3 +276,47 @@ def test_local_actor_streaming_bad_arg_fails_stream(rt):
         ray_tpu.get(next(it), timeout=30)
     with pytest.raises(StopIteration):
         next(it)
+
+
+def test_local_abandon_before_start_does_not_wedge_actor(rt):
+    """Dropping a generator before its call starts must NOT let the
+    executor drive the whole (long) generator on the actor's only
+    thread — the pre-registered stream state keeps the abandon."""
+    import gc
+
+    @ray_tpu.remote
+    class Gen:
+        def block(self, t):
+            time.sleep(t)
+            return "done"
+
+        def endless(self):
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+    a = Gen.options(num_cpus=0.5).remote()
+    blocker = a.block.remote(1.0)  # the stream call queues behind this
+    g = a.endless.options(num_returns="streaming").remote()
+    del g  # abandoned before the executor ever starts it
+    gc.collect()
+    assert ray_tpu.get(blocker, timeout=30) == "done"
+    # the actor still serves calls promptly (not stuck in endless())
+    assert ray_tpu.get(a.block.remote(0.0), timeout=10) == "done"
+
+
+def test_local_stream_state_reclaimed_after_drain(rt):
+    """Fully-drained streams drop their runtime state (no per-call
+    leak)."""
+
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            yield from range(n)
+
+    a = Gen.options(num_cpus=0.5).remote()
+    for _ in range(5):
+        g = a.stream.options(num_returns="streaming").remote(3)
+        assert [ray_tpu.get(r, timeout=30) for r in g] == [0, 1, 2]
+    assert len(rt._streams) == 0
