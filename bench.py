@@ -287,6 +287,12 @@ def model_bench() -> dict:
             n_kv_heads=16,
             d_ff=5504,
             max_seq_len=1024,
+            # block-level rematerialization: the 700M-param config's scan
+            # residuals (~1 GiB/layer of d_ff activations) exceed a v5e's
+            # 16 GiB HBM; remat trades ~1/3 extra FLOPs to fit. MFU is
+            # still accounted on model FLOPs only (the standard
+            # definition), so remat lowers tokens/s, not the honesty.
+            remat=True,
         )
         B, T = 8, 1024
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
@@ -300,12 +306,15 @@ def model_bench() -> dict:
         jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size, jnp.int32
     )
     params, opt_state, loss = step(params, opt_state, tokens)  # compile
-    loss.block_until_ready()
-    n_steps = 2 if smoke else 5
+    float(loss)  # full completion: on the tunneled platform
+    # block_until_ready returns at remote ENQUEUE; only a device->host
+    # readback proves the computation ran. Time a readback of the final
+    # chained loss — one tunnel RTT amortized over n_steps.
+    n_steps = 2 if smoke else 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, tokens)
-    loss.block_until_ready()
+    train_loss = float(loss)
     dt = time.perf_counter() - t0
     toks = B * (T - 1)  # loss_fn trains on T-1 positions
     # standard training-FLOPs accounting: 6·N per token (fwd+bwd matmuls)
@@ -318,7 +327,7 @@ def model_bench() -> dict:
         train_tokens_per_s=round(toks * n_steps / dt, 1),
         train_step_ms=round(dt / n_steps * 1e3, 2),
         train_step_mfu=round(flops_per_step * n_steps / dt / peak, 4),
-        train_loss=float(loss),
+        train_loss=train_loss,
     )
 
     # --- paged decode: kernel vs gather at the engine's defaults ---------
@@ -352,11 +361,12 @@ def model_bench() -> dict:
         # otherwise dominate at ~64ms/step)
         pk, pv = eng.pool.k, eng.pool.v
         toks_d, pos = eng.cur_tokens, eng.positions
-        n_dec = 8 if smoke else 64
-        _ = eng._decode_step(  # warm the chained shapes
+        n_dec = 8 if smoke else 256
+        warm = eng._decode_step(  # warm the chained shapes
             eng.params, pk, pv, eng.block_tables, pos, toks_d,
             eng.active_mask, eng.temps, eng.seeds,
-        )[0].block_until_ready()
+        )[0]
+        np.asarray(warm)  # tunnel: readback, not block_until_ready
         t0 = time.perf_counter()
         for _ in range(n_dec):
             toks_d, pk, pv = eng._decode_step(
@@ -364,7 +374,9 @@ def model_bench() -> dict:
                 eng.active_mask, eng.temps, eng.seeds,
             )
             pos = pos + 1
-        toks_d.block_until_ready()
+        # final-token readback forces the whole device-chained sequence;
+        # one RTT amortized over n_dec steps
+        np.asarray(toks_d)
         return 8 * n_dec / (time.perf_counter() - t0)
 
     gather_rate = decode_rate(False)

@@ -182,8 +182,11 @@ define(
 )
 define(
     "health_timeout_s",
-    3.0,
-    "Head marks a node dead after this long without a report.",
+    8.0,
+    "Head marks a node dead after this long without a report. The"
+    " reference's detection window is ~15-25s (health_check_period_ms x"
+    " failure_threshold); 3s proved twitchy enough to falsely kill nodes"
+    " mid-transfer-storm on a loaded 1-core host.",
 )
 define(
     "orphan_timeout_s",

@@ -472,6 +472,11 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> "ObjectRef":
+        # iterator protocol: once exhausted, keep raising StopIteration —
+        # the runtime drops the drained stream state on the None return,
+        # so asking it again would block on a stream that no longer exists
+        if self._exhausted:
+            raise StopIteration
         ref = self._rt.stream_next(self._task_id, self._index, None)
         if ref is None:
             self._exhausted = True
@@ -481,6 +486,8 @@ class ObjectRefGenerator:
 
     def next_ref(self, timeout: Optional[float] = None) -> "ObjectRef":
         """``__next__`` with a timeout (raises GetTimeoutError)."""
+        if self._exhausted:
+            raise StopIteration
         ref = self._rt.stream_next(self._task_id, self._index, timeout)
         if ref is None:
             self._exhausted = True

@@ -186,6 +186,11 @@ class Runtime:
         # "done": bool} (num_returns="streaming" tasks; cluster analog
         # lives on the head)
         self._streams: Dict[str, dict] = {}
+        # tombstones for abandoned streams: popping the live state must
+        # not let a lineage re-execution of the same task resurrect a
+        # fresh un-abandoned stream and drive the generator with no
+        # consumer (one small string per abandoned stream)
+        self._abandoned_streams: set = set()
         self._stream_cv = threading.Condition()
         self._spread_rr = 0  # SPREAD round-robin cursor
         self._label_rr = 0  # label-selector tie-break cursor
@@ -997,6 +1002,16 @@ class Runtime:
         idx = 0
         while True:
             with self._stream_cv:
+                if task_id in self._abandoned_streams:
+                    # abandoned before (or during a re-execution of) this
+                    # drive: never resurrect a consumer-less stream
+                    try:
+                        gen.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._streams.pop(task_id, None)
+                    self._stream_cv.notify_all()
+                    return
                 st = self._streams.setdefault(
                     task_id, {"items": [], "done": False}
                 )
@@ -1007,6 +1022,7 @@ class Runtime:
                         gen.close()
                     except Exception:  # noqa: BLE001
                         pass
+                    self._abandoned_streams.add(task_id)
                     self._streams.pop(task_id, None)
                     self._stream_cv.notify_all()
                     return
@@ -1083,6 +1099,7 @@ class Runtime:
         """Consumer dropped the generator: stop production and make the
         state GC-able."""
         with self._stream_cv:
+            self._abandoned_streams.add(task_id)
             st = self._streams.get(task_id)
             if st is not None and st["done"]:
                 self._streams.pop(task_id, None)

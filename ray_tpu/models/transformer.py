@@ -53,6 +53,10 @@ class ModelConfig:
     # ops/ring_attention.py) or "ulysses" (all-to-all head/sequence swap,
     # ops/ulysses.py) — both net-new vs the reference (SURVEY §2.3).
     sp_attention: str = "ring"
+    # rematerialize each block in the backward pass (jax.checkpoint) —
+    # trades ~1/3 extra FLOPs for O(n_layers) less residual HBM. The
+    # standard TPU memory lever for deep/long-sequence configs.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -173,6 +177,10 @@ def _scan_blocks(cfg: ModelConfig, blocks, h, angles, *, sp_manual: bool):
     def body(h, layer_p):
         return _block(cfg, layer_p, h, angles, sp_manual=sp_manual), None
 
+    if cfg.remat:
+        # prevent_cse=False: under lax.scan the CSE-prevention barriers
+        # are redundant and only cost compile/runtime (jax.checkpoint doc)
+        body = jax.checkpoint(body, prevent_cse=False)
     h, _ = jax.lax.scan(body, h, blocks)
     return h
 

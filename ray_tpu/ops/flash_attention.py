@@ -19,6 +19,7 @@ the reference lacks natively (SURVEY §2.3).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +34,11 @@ DEFAULT_BLOCK_K = 128
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
                 causal: bool, scale: float):
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d]; o_ref: [1, block_q, d]
-    # l_ref: [1, block_q] — per-row logsumexp saved for the backward pass
+    # l_ref: [1, 1, block_q] — per-row logsumexp saved for the backward
+    # pass. lse/delta ride as [bh, 1, t] (not [bh, t]) so their block
+    # specs' trailing dims are (1, block) with 1 == the full array dim —
+    # the Mosaic TPU lowering rejects a (1, block) window on a 2-D array
+    # whose sublane dim is larger.
     _, block_q, d = q_ref.shape
     s = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -78,7 +83,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
     m, l, o = jax.lax.fori_loop(0, num_kb_live, body, (m0, l0, o0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
-    l_ref[0] = m + jnp.log(l_safe)  # logsumexp per row
+    l_ref[0, 0] = m + jnp.log(l_safe)  # logsumexp per row
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -89,8 +94,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0] * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
     def body(kb, dq):
@@ -138,8 +143,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
             jnp.float32
         )
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         scores = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
@@ -189,11 +194,11 @@ def _fwd_impl(qg, kg, vg, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, qi: (b, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda b, qi: (b, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qg.shape, qg.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         interpret=interpret,
     )(qg, kg, vg)
@@ -221,7 +226,7 @@ def _flash_grouped_bwd(causal, block_q, block_k, interpret, res, do):
     # delta_i = rowsum(dO ⊙ O): the softmax-jacobian correction term
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # [bh, t]
+    )[:, None, :]  # [bh, 1, t] — same layout as lse (see _fwd_kernel)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, block_k=block_k, causal=causal, scale=scale
@@ -232,8 +237,8 @@ def _flash_grouped_bwd(causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, s, d), lambda b, qi: (b, 0, 0)),
             pl.BlockSpec((1, s, d), lambda b, qi: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, qi: (b, qi)),
-            pl.BlockSpec((1, block_q), lambda b, qi: (b, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda b, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda b, qi: (b, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qg.shape, qg.dtype),
@@ -249,8 +254,8 @@ def _flash_grouped_bwd(causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
             pl.BlockSpec((1, t, d), lambda b, ki: (b, 0, 0)),
-            pl.BlockSpec((1, t), lambda b, ki: (b, 0)),
-            pl.BlockSpec((1, t), lambda b, ki: (b, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, ki: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, ki: (b, ki, 0)),
@@ -286,7 +291,24 @@ def flash_attention(
     s, hkv = k.shape[1], k.shape[2]
     groups = h // hkv
     if t % block_q or s % block_k:
-        # ragged tails fall back to the fused-XLA reference path
+        if causal and t == s:
+            # Ragged causal self-attention: zero-pad to the block multiple
+            # and slice the pad rows back off. Exact — padded keys sit at
+            # positions >= t, strictly in every real query's masked future,
+            # and the pad's transpose discards their cotangents. Keeps the
+            # O(T) flash memory profile on ragged lengths (e.g. the T-1
+            # next-token training slice), where the reference fallback
+            # would materialize [T, S] per layer.
+            m = block_q * block_k // math.gcd(block_q, block_k)
+            pad = -t % m
+            zq = ((0, 0), (0, pad), (0, 0), (0, 0))
+            out = flash_attention(
+                jnp.pad(q, zq), jnp.pad(k, zq), jnp.pad(v, zq),
+                causal=True, block_q=block_q, block_k=block_k,
+                interpret=interpret,
+            )
+            return out[:, :t]
+        # ragged cross/non-causal tails fall back to the fused-XLA path
         return attention_reference(q, k, v, causal=causal)
 
     # layout: fold (batch, kv_head, group) into the grid's first axis; GQA

@@ -31,11 +31,12 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _paged_kernel(
-    tbl_ref,  # [1, P_max] int32 — this slot's block table
-    len_ref,  # [1, 1] int32 — number of valid positions (q_pos + 1)
+    tbl_ref,  # [B, P_max] int32 in SMEM — all block tables (scalar loads)
+    len_ref,  # [B] int32 in SMEM — valid positions (q_pos + 1) per slot
     q_ref,  # [1, 1, G, D]
     k_ref,  # [1, N, page, D] — this kv head's pool slice
     v_ref,  # [1, N, page, D]
@@ -47,7 +48,8 @@ def _paged_kernel(
 ):
     g, d = q_ref.shape[2], q_ref.shape[3]
     q = q_ref[0, 0] * scale  # [G, D]
-    length = len_ref[0, 0]
+    slot = pl.program_id(0)
+    length = len_ref[slot]
 
     m0 = jnp.full((g,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((g,), jnp.float32)
@@ -55,7 +57,7 @@ def _paged_kernel(
 
     def body(j, carry):
         m, l, o = carry
-        pid = tbl_ref[0, j]
+        pid = tbl_ref[slot, j]
         k_pg = k_ref[0, pid]  # [page, D] — dynamic page index into the pool
         v_pg = v_ref[0, pid]
         scores = jnp.dot(
@@ -101,8 +103,11 @@ def paged_attention_decode(
         kernel,
         grid=(b, kh),
         in_specs=[
-            pl.BlockSpec((1, p_max), lambda i, h: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i, h: (i, 0)),
+            # block table + lengths are scalar control data: whole arrays
+            # in SMEM (the Mosaic lowering rejects (1, P) VMEM windows on
+            # int32 tables, and page ids drive addresses, not vectors)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, g, d), lambda i, h: (i, h, 0, 0)),
             pl.BlockSpec(
                 (1, k_pages.shape[1], page_size, d), lambda i, h: (h, 0, 0, 0)
@@ -114,7 +119,7 @@ def paged_attention_decode(
         out_specs=pl.BlockSpec((1, 1, g, d), lambda i, h: (i, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
         interpret=interpret,
-    )(block_tables, lengths.reshape(b, 1), q, k_pages, v_pages)
+    )(block_tables, lengths, q, k_pages, v_pages)
 
 
 def paged_attention_reference(

@@ -78,9 +78,38 @@ def test_backward_gqa_groups():
         )
 
 
-def test_ragged_fallback():
-    # seq not divisible by block → silently uses the XLA reference path
+def test_ragged_causal_pads_through_kernel():
+    # causal self-attention with seq not divisible by block: zero-pad to
+    # the block multiple, run the kernel, slice — exact because padded
+    # keys sit strictly in every real query's masked future (the T-1
+    # next-token training slice hits this every step)
     q, k, v = mk_qkv(jax.random.PRNGKey(2), b=1, t=100, h=2, hkv=2, d=16)
     out = flash_attention(q, k, v, causal=True, interpret=True)
     ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
+
+
+def test_ragged_causal_backward():
+    # gradients flow through the pad+slice path; pad cotangents drop
+    q, k, v = mk_qkv(jax.random.PRNGKey(3), b=1, t=70, h=2, hkv=2, d=16)
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, interpret=True) ** 2
+        )
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
+def test_ragged_noncausal_still_falls_back():
+    # non-causal ragged shapes would attend to padded keys — reference path
+    q, k, v = mk_qkv(jax.random.PRNGKey(4), b=1, t=100, h=2, hkv=2, d=16)
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    ref = attention_reference(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)

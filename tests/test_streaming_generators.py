@@ -320,3 +320,49 @@ def test_local_stream_state_reclaimed_after_drain(rt):
         g = a.stream.options(num_returns="streaming").remote(3)
         assert [ray_tpu.get(r, timeout=30) for r in g] == [0, 1, 2]
     assert len(rt._streams) == 0
+
+
+def test_local_exhausted_generator_keeps_raising(rt):
+    """Iterator protocol: next() on an exhausted generator raises
+    StopIteration immediately, forever — the runtime dropped the drained
+    stream state, so asking it again must not block on a stream that no
+    longer exists."""
+    g = (
+        ray_tpu.remote(_count)
+        .options(num_returns="streaming", num_cpus=0.5)
+        .remote(2)
+    )
+    assert [ray_tpu.get(r, timeout=30) for r in g] == [0, 2]
+    t0 = time.monotonic()
+    with pytest.raises(StopIteration):
+        next(g)
+    with pytest.raises(StopIteration):
+        g.next_ref(timeout=5.0)
+    assert time.monotonic() - t0 < 1.0, "post-drain next() blocked"
+
+
+def test_local_abandoned_stream_not_resurrected_by_reexecution(rt):
+    """An abandoned stream stays abandoned across re-executions of the
+    same task id: a lineage retry must not drive the generator to
+    completion with no consumer."""
+    g = (
+        ray_tpu.remote(_count)
+        .options(num_returns="streaming", num_cpus=0.5)
+        .remote(1000)
+    )
+    task_id = g.task_id
+    first = next(g)
+    assert ray_tpu.get(first, timeout=30) == 0
+    del g  # abandon mid-stream
+    import gc
+
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while task_id not in rt._abandoned_streams and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert task_id in rt._abandoned_streams
+    # simulate the lineage re-execution path re-driving the same task id
+    rt._drive_stream(task_id, None, iter(range(1000)))
+    with rt._stream_cv:
+        st = rt._streams.get(task_id)
+    assert st is None, "re-execution resurrected an abandoned stream"
