@@ -23,6 +23,8 @@ import cloudpickle
 import grpc
 
 _MAX_MSG = 256 * 1024 * 1024
+# ceiling on any single retry backoff sleep
+_BACKOFF_CAP_S = 2.0
 _OPTIONS = [
     ("grpc.max_send_message_length", _MAX_MSG),
     ("grpc.max_receive_message_length", _MAX_MSG),
@@ -215,8 +217,18 @@ class RpcClient:
         retries: int = 0,
         retry_interval: float = 0.1,
     ) -> Any:
+        import random
+
         data = cloudpickle.dumps(payload)
         attempt = 0
+        # exponential backoff with decorrelated jitter: each sleep draws
+        # uniform in [base, 3*prev], capped — retry bursts from many
+        # callers desynchronize instead of hammering a recovering peer in
+        # lockstep (retryable_grpc_client.cc exponential-backoff analog;
+        # the previous linear `interval * attempt` ramp kept every waiter
+        # phase-aligned).
+        backoff = retry_interval
+        cap = max(retry_interval, _BACKOFF_CAP_S)
         while True:
             try:
                 _get_chaos().apply(method)
@@ -232,7 +244,13 @@ class RpcClient:
                         f"{exc.code() if hasattr(exc, 'code') else exc}"
                     ) from exc
                 attempt += 1
-                time.sleep(retry_interval * attempt)
+                backoff = min(
+                    cap,
+                    random.uniform(
+                        retry_interval, max(retry_interval, 3.0 * backoff)
+                    ),
+                )
+                time.sleep(backoff)
 
     def close(self) -> None:
         self._channel.close()
