@@ -31,7 +31,7 @@ from ray_tpu.core.object_store import GetTimeoutError, ObjectRef
 from ray_tpu.core.runtime import TaskSpec
 
 from .common import INLINE_OBJECT_MAX, LeaseRequest, new_id
-from .rpc import RpcClient, RpcError, RpcServer
+from .rpc import RpcClient, RpcDeadlineError, RpcError, RpcServer
 
 _BY_VALUE_REGISTERED: set = set()
 
@@ -565,12 +565,24 @@ class RemoteRuntime:
             refcount.install_consumer(self._flusher)
             self._owns_flusher = True
 
-    def _read(self, method: str, payload: Any = None, timeout: float = 30.0):
+    def _read(
+        self,
+        method: str,
+        payload: Any = None,
+        timeout: float = 30.0,
+        deadline_s: Optional[float] = None,
+    ):
         """Idempotent head reads retry through transport blips — a client
         rides through a head restart the way the reference's GCS client
-        does (gcs_rpc_client.h retry budgets)."""
+        does (gcs_rpc_client.h retry budgets). ``deadline_s`` propagates a
+        caller's overall budget: the retry loop never outlives it."""
         return self.head.call(
-            method, payload, timeout=timeout, retries=8, retry_interval=0.25
+            method,
+            payload,
+            timeout=timeout,
+            retries=8,
+            retry_interval=0.25,
+            deadline_s=deadline_s,
         )
 
     # ------------------------------------------------------------------
@@ -1280,11 +1292,25 @@ class RemoteRuntime:
                     if resolved:
                         return value
             poll = 2.0
+            budget = None
             if deadline is not None:
-                poll = min(poll, max(0.0, deadline - time.monotonic()))
-            reply = self._read(
-                "WaitObject", {"object_id": ref.hex, "timeout": poll}
-            )
+                remaining = max(0.0, deadline - time.monotonic())
+                poll = min(poll, remaining)
+                # head-retry loop bounded by the caller's FULL remaining
+                # get() budget (+grace for one in-flight reply) — capping
+                # at the poll slice would abort a 60s get() 3s into a 5s
+                # head restart
+                budget = remaining + 1.0
+            try:
+                reply = self._read(
+                    "WaitObject",
+                    {"object_id": ref.hex, "timeout": poll},
+                    deadline_s=budget,
+                )
+            except RpcDeadlineError:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {ref} (head unreachable)"
+                ) from None
             status = reply["status"]
             if status in ("inline", "error", "located"):
                 self._direct_note_head_resolved(h)
@@ -1348,13 +1374,24 @@ class RemoteRuntime:
                 if not unresolved:
                     break
             poll = 2.0
+            budget = None
             if deadline is not None:
-                poll = min(poll, max(0.0, deadline - time.monotonic()))
-            replies = self._read(
-                "WaitObjectBatch",
-                {"object_ids": unresolved, "timeout": poll},
-                timeout=poll + 30.0,
-            )
+                remaining = max(0.0, deadline - time.monotonic())
+                poll = min(poll, remaining)
+                budget = remaining + 1.0
+            try:
+                replies = self._read(
+                    "WaitObjectBatch",
+                    {"object_ids": unresolved, "timeout": poll},
+                    timeout=poll + 30.0,
+                    deadline_s=budget,
+                )
+            except RpcDeadlineError:
+                missing = [h for h in order if h not in results]
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {len(missing)} objects "
+                    "(head unreachable)"
+                ) from None
             located: Dict[tuple, List[str]] = {}
             for h, rep in zip(unresolved, replies):
                 status = rep["status"]

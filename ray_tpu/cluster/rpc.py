@@ -15,16 +15,240 @@ src/ray/rpc/retryable_grpc_client.h — retries here are explicit via
 from __future__ import annotations
 
 import pickle
+import threading
 import time
 from concurrent import futures
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 import grpc
 
 _MAX_MSG = 256 * 1024 * 1024
-# ceiling on any single retry backoff sleep
-_BACKOFF_CAP_S = 2.0
+
+
+class RpcError(Exception):
+    """Transport-level failure (peer dead/unreachable)."""
+
+
+class PeerUnavailableError(RpcError):
+    """The peer's circuit breaker is open: calls fail fast without
+    touching the wire until a half-open probe succeeds."""
+
+
+class RpcDeadlineError(RpcError):
+    """The caller's overall deadline was exhausted across retries."""
+
+
+class _Blackholed(Exception):
+    """Injected partition: the peer is unreachable from this process.
+    Handled exactly like a transport failure (retries, breaker)."""
+
+
+class FaultInjection:
+    """Runtime-mutable, process-local fault injection for chaos runs.
+
+    The env-driven ``RAY_TPU_RPC_CHAOS`` knob (``_Chaos`` below) covers
+    probabilistic per-method faults fixed at process start; this registry
+    is the orchestrator-facing surface — per-PEER blackholes (partition)
+    and delays (straggler ramps) that can be toggled mid-run. Injection
+    happens inside ``RpcClient.call`` so the blackholed traffic exercises
+    the real retry/breaker/recovery machinery."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._blackholed: set = set()
+        self._delays: Dict[str, float] = {}
+
+    def blackhole(self, address: str) -> None:
+        with self._lock:
+            self._blackholed.add(address)
+
+    def heal(self, address: str) -> None:
+        with self._lock:
+            self._blackholed.discard(address)
+            self._delays.pop(address, None)
+
+    def set_delay(self, address: str, seconds: float) -> None:
+        with self._lock:
+            if seconds <= 0:
+                self._delays.pop(address, None)
+            else:
+                self._delays[address] = float(seconds)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blackholed.clear()
+            self._delays.clear()
+
+    def check(self, address: str) -> float:
+        """Returns the injected delay for ``address`` (0 if none); raises
+        ``_Blackholed`` if the peer is partitioned away."""
+        with self._lock:
+            if address in self._blackholed:
+                raise _Blackholed(f"chaos: peer {address} blackholed")
+            return self._delays.get(address, 0.0)
+
+
+FAULTS = FaultInjection()
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker (RetryableGrpcClient's
+    server-unavailable-timeout analog, src/ray/rpc/retryable_grpc_client.h).
+
+    Closed → transport failures spanning ``rpc_breaker_window_s`` with no
+    intervening success → Open (calls fail fast, node-unreachable
+    callbacks fire) → after ``rpc_breaker_cooldown_s`` one half-open
+    probe is allowed; its success closes the circuit, its failure
+    re-opens it. State is shared per peer address across every RpcClient
+    in the process, so a wedged transport fails fast everywhere instead
+    of stalling each caller for its full timeout."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, address: str):
+        self.address = address
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self._first_failure: Optional[float] = None
+        self._last_failure = 0.0
+        self._fail_count = 0
+        self._open_until = 0.0
+        self._probe_in_flight = False
+        self.open_count = 0
+        # id(owner) -> callback; fired (outside the lock) on each
+        # closed->open transition. Owners unregister via remove_callback.
+        self._callbacks: Dict[int, Callable[[], None]] = {}
+
+    def add_callback(self, owner: Any, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._callbacks[id(owner)] = fn
+
+    def remove_callback(self, owner: Any) -> None:
+        with self._lock:
+            self._callbacks.pop(id(owner), None)
+
+    def allow(self) -> bool:
+        """May an attempt touch the wire right now?"""
+        now = time.monotonic()
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN and now >= self._open_until:
+                self.state = self.HALF_OPEN
+                self._probe_in_flight = True
+                return True
+            if self.state == self.HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def on_success(self) -> None:
+        with self._lock:
+            was_open = self.state != self.CLOSED
+            self.state = self.CLOSED
+            self._first_failure = None
+            self._fail_count = 0
+            self._probe_in_flight = False
+        if was_open:
+            BREAKER_STATE.set(0, labels={"peer": self.address})
+
+    def abort_probe(self) -> None:
+        """A half-open probe attempt died without a transport verdict
+        (e.g. serialization error): release the probe slot so the
+        breaker can't wedge in HALF_OPEN forever."""
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                self._probe_in_flight = False
+
+    def on_failure(self) -> None:
+        from ray_tpu.config import cfg
+
+        now = time.monotonic()
+        opened = False
+        fire: List[Callable[[], None]] = []
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                # probe failed: straight back to open — and RE-fire the
+                # callbacks. A persistently partitioned node can
+                # re-register between cooldowns (its own reports still
+                # flow); without re-firing, it would stay 'alive' forever
+                # while every dispatch to it fails fast.
+                self.state = self.OPEN
+                self._probe_in_flight = False
+                self._open_until = now + cfg.rpc_breaker_cooldown_s
+                self.open_count += 1
+                opened = True
+                fire = list(self._callbacks.values())
+            elif self.state == self.OPEN:
+                return
+            else:
+                window = cfg.rpc_breaker_window_s
+                # SLIDING window: a failure separated from the previous
+                # one by more than the window starts a fresh streak —
+                # sparse unrelated timeouts hours apart on a quiet peer
+                # must never accumulate into a false open
+                if (
+                    self._first_failure is None
+                    or now - self._last_failure > window
+                ):
+                    self._first_failure = now
+                    self._last_failure = now
+                    self._fail_count = 1
+                    return
+                self._last_failure = now
+                self._fail_count += 1
+                # open only when a CONTINUOUS failure streak both spans
+                # the window and numbers at least the minimum
+                if (
+                    now - self._first_failure >= window
+                    and self._fail_count >= cfg.rpc_breaker_min_failures
+                ):
+                    self.state = self.OPEN
+                    self._open_until = now + cfg.rpc_breaker_cooldown_s
+                    self.open_count += 1
+                    opened = True
+                    fire = list(self._callbacks.values())
+        if opened:
+            BREAKER_OPENS.inc(labels={"peer": self.address})
+            BREAKER_STATE.set(1, labels={"peer": self.address})
+            for fn in fire:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 - health path best-effort
+                    import logging
+
+                    logging.getLogger("ray_tpu.cluster.rpc").exception(
+                        "node-unreachable callback failed for %s",
+                        self.address,
+                    )
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def get_breaker(address: str) -> CircuitBreaker:
+    with _BREAKERS_LOCK:
+        br = _BREAKERS.get(address)
+        if br is None:
+            br = _BREAKERS[address] = CircuitBreaker(address)
+        return br
+
+
+def reset_breakers() -> None:
+    """Drop all breaker state (tests / chaos teardown). Clears IN PLACE:
+    live clients hold direct references to their breakers (reset those to
+    closed too), and stale imports of _BREAKERS must keep seeing the
+    shared registry object."""
+    with _BREAKERS_LOCK:
+        for br in _BREAKERS.values():
+            with br._lock:
+                br.state = br.CLOSED
+                br._first_failure = None
+                br._fail_count = 0
+                br._probe_in_flight = False
+        _BREAKERS.clear()
 _OPTIONS = [
     ("grpc.max_send_message_length", _MAX_MSG),
     ("grpc.max_receive_message_length", _MAX_MSG),
@@ -32,8 +256,29 @@ _OPTIONS = [
 ]
 
 
-class RpcError(Exception):
-    """Transport-level failure (peer dead/unreachable)."""
+from ray_tpu.util.metrics import Counter as _Counter
+from ray_tpu.util.metrics import Gauge as _Gauge
+
+RPC_RETRIES = _Counter(
+    "rpc_client_retries_total",
+    "RPC attempts retried after a transport-level failure.",
+    label_names=("method",),
+)
+RPC_DEADLINE_EXCEEDED = _Counter(
+    "rpc_client_deadline_exceeded_total",
+    "RPC calls abandoned because the caller's overall deadline expired.",
+    label_names=("method",),
+)
+BREAKER_OPENS = _Counter(
+    "rpc_breaker_opens_total",
+    "Circuit-breaker closed->open transitions per peer.",
+    label_names=("peer",),
+)
+BREAKER_STATE = _Gauge(
+    "rpc_breaker_open",
+    "1 while the peer's circuit is open, 0 otherwise.",
+    label_names=("peer",),
+)
 
 
 class _ChaosDrop(Exception):
@@ -191,12 +436,29 @@ class RpcServer:
 
 
 class RpcClient:
-    """Channel to one peer; ``call(method, payload)`` round-trips an object."""
+    """Channel to one peer; ``call(method, payload)`` round-trips an object.
 
-    def __init__(self, address: str):
+    The full RetryableGrpcClient analog (retryable_grpc_client.h):
+    exponential backoff with decorrelated jitter under a cap, caller
+    deadline propagation (``deadline_s`` bounds the WHOLE retry loop —
+    attempts, injected delays, and backoff sleeps included), and a
+    per-peer circuit breaker shared across every client to the same
+    address. ``on_unreachable`` registers a callback fired when the
+    breaker opens (the head routes it into its health path so a wedged
+    transport is declared dead in seconds, not after every caller's
+    timeout stacks up)."""
+
+    def __init__(
+        self,
+        address: str,
+        on_unreachable: Optional[Callable[[], None]] = None,
+    ):
         self.address = address
         self._channel = grpc.insecure_channel(address, options=_OPTIONS)
         self._methods: Dict[str, Any] = {}
+        self._breaker = get_breaker(address)
+        if on_unreachable is not None:
+            self._breaker.add_callback(self, on_unreachable)
 
     def _method(self, name: str):
         m = self._methods.get(name)
@@ -216,41 +478,107 @@ class RpcClient:
         timeout: Optional[float] = 30.0,
         retries: int = 0,
         retry_interval: float = 0.1,
+        deadline_s: Optional[float] = None,
     ) -> Any:
+        """Round-trip ``payload`` to handler ``method``.
+
+        ``timeout`` is the per-attempt RPC deadline; ``deadline_s`` is the
+        caller's OVERALL budget — no retry sequence (attempts + backoff)
+        ever exceeds it, and per-attempt timeouts shrink to the remaining
+        budget. Transport failures (gRPC errors, injected drops/partitions)
+        consume the retry budget; handler exceptions re-raise immediately."""
         import random
+
+        from ray_tpu.config import cfg
 
         data = cloudpickle.dumps(payload)
         attempt = 0
+        deadline = (
+            None if deadline_s is None else time.monotonic() + deadline_s
+        )
         # exponential backoff with decorrelated jitter: each sleep draws
         # uniform in [base, 3*prev], capped — retry bursts from many
         # callers desynchronize instead of hammering a recovering peer in
-        # lockstep (retryable_grpc_client.cc exponential-backoff analog;
-        # the previous linear `interval * attempt` ramp kept every waiter
-        # phase-aligned).
+        # lockstep (the previous linear `interval * attempt` ramp kept
+        # every waiter phase-aligned).
         backoff = retry_interval
-        cap = max(retry_interval, _BACKOFF_CAP_S)
+        cap = max(retry_interval, cfg.rpc_backoff_cap_s)
+        br = self._breaker
+
+        def _out_of_time() -> bool:
+            return deadline is not None and time.monotonic() >= deadline
+
+        def _raise_deadline(cause: Optional[BaseException]) -> None:
+            RPC_DEADLINE_EXCEEDED.inc(labels={"method": method})
+            raise RpcDeadlineError(
+                f"rpc {method} to {self.address} exceeded the caller "
+                f"deadline of {deadline_s}s after {attempt + 1} attempt(s)"
+            ) from cause
+
         while True:
-            try:
-                _get_chaos().apply(method)
-                raw = self._method(method)(data, timeout=timeout)
-                ok, value = pickle.loads(raw)
-                if not ok:
-                    raise value
-                return value
-            except (grpc.RpcError, _ChaosDrop) as exc:
+            if _out_of_time():
+                _raise_deadline(None)
+            if not br.allow():
+                # circuit open: fail fast without touching the wire. With
+                # retries left we keep (bounded) patience — backoff sleeps
+                # line the caller up with the half-open probe window.
                 if attempt >= retries:
-                    raise RpcError(
-                        f"rpc {method} to {self.address} failed: "
-                        f"{exc.code() if hasattr(exc, 'code') else exc}"
-                    ) from exc
+                    raise PeerUnavailableError(
+                        f"rpc {method} to {self.address}: circuit open "
+                        f"(peer unavailable)"
+                    )
                 attempt += 1
-                backoff = min(
-                    cap,
-                    random.uniform(
-                        retry_interval, max(retry_interval, 3.0 * backoff)
-                    ),
-                )
-                time.sleep(backoff)
+            else:
+                try:
+                    delay = FAULTS.check(self.address)
+                    if delay > 0:
+                        if deadline is not None:
+                            delay = min(
+                                delay, max(0.0, deadline - time.monotonic())
+                            )
+                        time.sleep(delay)
+                    _get_chaos().apply(method)
+                    att_timeout = timeout
+                    if deadline is not None:
+                        remaining = max(0.001, deadline - time.monotonic())
+                        att_timeout = (
+                            remaining
+                            if timeout is None
+                            else min(timeout, remaining)
+                        )
+                    raw = self._method(method)(data, timeout=att_timeout)
+                    ok, value = pickle.loads(raw)
+                    br.on_success()
+                    if not ok:
+                        raise value
+                    return value
+                except (grpc.RpcError, _ChaosDrop, _Blackholed) as exc:
+                    br.on_failure()
+                    if attempt >= retries:
+                        raise RpcError(
+                            f"rpc {method} to {self.address} failed: "
+                            f"{exc.code() if hasattr(exc, 'code') else exc}"
+                        ) from exc
+                    if _out_of_time():
+                        _raise_deadline(exc)
+                    attempt += 1
+                    RPC_RETRIES.inc(labels={"method": method})
+                except BaseException:
+                    # no transport verdict (serialization error, interrupt):
+                    # release a half-open probe slot instead of wedging the
+                    # breaker, and surface the error unchanged
+                    br.abort_probe()
+                    raise
+            backoff = min(
+                cap,
+                random.uniform(
+                    retry_interval, max(retry_interval, 3.0 * backoff)
+                ),
+            )
+            if deadline is not None:
+                backoff = min(backoff, max(0.0, deadline - time.monotonic()))
+            time.sleep(backoff)
 
     def close(self) -> None:
+        self._breaker.remove_callback(self)
         self._channel.close()

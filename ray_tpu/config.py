@@ -189,9 +189,56 @@ define(
     " mid-transfer-storm on a loaded 1-core host.",
 )
 define(
+    "health_miss_threshold",
+    3,
+    "Consecutive missed health windows before the head marks a node dead "
+    "(gcs_health_check_manager failure_threshold analog). The window is "
+    "health_timeout_s / health_miss_threshold, so total detection latency "
+    "stays ~health_timeout_s while a single wall-clock gap (GC pause, "
+    "transfer storm on a loaded host) is no longer a death sentence.",
+)
+define(
     "orphan_timeout_s",
     120.0,
     "An agent that cannot reach any head for this long exits.",
+)
+
+# ---------------------------------------------------------------------------
+# rpc retry + circuit breaking (RetryableGrpcClient analog)
+# ---------------------------------------------------------------------------
+define(
+    "rpc_backoff_cap_s",
+    2.0,
+    "Ceiling on any single RPC retry backoff sleep (decorrelated-jitter "
+    "exponential backoff below the cap).",
+)
+define(
+    "rpc_breaker_window_s",
+    5.0,
+    "A peer whose calls have failed at transport level for this long "
+    "with no intervening success gets its circuit opened: calls fail "
+    "fast and the node-unreachable callback fires into the health path "
+    "(server_unavailable_timeout_seconds analog).",
+)
+define(
+    "rpc_breaker_cooldown_s",
+    1.0,
+    "How long an open circuit stays open before one half-open probe "
+    "call is allowed through; probe success closes it.",
+)
+define(
+    "rpc_breaker_min_failures",
+    3,
+    "Minimum transport failures (with no intervening success) before the "
+    "breaker may open — the window span alone must not let two isolated "
+    "large-transfer timeouts read as a dead peer.",
+)
+define(
+    "chaos_seed",
+    0,
+    "Seed for the deterministic chaos orchestrator (ray_tpu.chaos). The "
+    "same seed replays the exact same fault schedule; soak failures "
+    "print the seed so they reproduce exactly.",
 )
 
 define(
