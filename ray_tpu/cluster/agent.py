@@ -1531,12 +1531,16 @@ class NodeAgent:
                 {"actor_id": aid, **self._actor_meta.get(aid, {})}
                 for aid in self._actor_workers
             ]
+        lister = getattr(self.store, "list_objects", None)
         return NodeInfo(
             node_id=self.node_id,
             address=self.address,
             resources=dict(self.resources),
             labels=self.labels,
             hosted_actors=hosted,
+            # store inventory: a restarted head re-seeds its object
+            # directory from this, so pre-restart refs keep resolving
+            stored_objects=list(lister()) if lister is not None else [],
         )
 
     def _peer(self, node_id: str, address: str) -> RpcClient:
@@ -1581,9 +1585,27 @@ class NodeAgent:
                 self._report_queue = []
             report = self._merge_reports(batch)
             try:
-                self.head.call("ReportSeals", report, timeout=10.0)
+                # retry budget rides a head restart (seal/stream/finished
+                # entries are at-least-once; dropping them stranded
+                # consumers — a seal that never lands means a get() that
+                # never resolves)
+                self.head.call(
+                    "ReportSeals",
+                    report,
+                    timeout=10.0,
+                    retries=8,
+                    retry_interval=0.25,
+                )
             except RpcError:
-                logger.warning("head unreachable; dropping report")
+                if self._shutdown:
+                    return
+                # still unreachable after the in-call budget: requeue at
+                # the FRONT so merge order is preserved, and let the
+                # report loop's orphan timeout decide when to give up
+                logger.warning("head unreachable; requeueing report")
+                with self._report_cv:
+                    self._report_queue.insert(0, report)
+                time.sleep(0.5)
 
     # an orphaned agent (its head gone for good, e.g. a crashed test
     # driver) must not linger holding ports/arena/spill space forever; a

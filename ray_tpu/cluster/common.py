@@ -18,9 +18,10 @@ from ray_tpu.config import cfg
 INLINE_OBJECT_MAX = cfg.inline_object_max
 
 # Resource report cadence (raylet_report_resources_period_milliseconds=100,
-# ray_config_def.h:65) and health-check strikes (gcs_health_check_manager.h:60).
+# ray_config_def.h:65). Health detection reads cfg.health_timeout_s /
+# cfg.health_miss_threshold LIVE in head._health_loop — no import-time
+# binding, so runtime env overrides (tests, chaos soaks) take effect.
 REPORT_PERIOD_S = cfg.report_period_s
-HEALTH_TIMEOUT_S = cfg.health_timeout_s
 
 
 def new_id() -> str:
@@ -53,6 +54,11 @@ class NodeInfo:
     # {"actor_id", "name", "max_restarts"} — lets a restarted head re-attach
     # live actors (GCS FT resubscribe analog, gcs_init_data.cc)
     hosted_actors: List[dict] = field(default_factory=list)
+    # (object_id, size) inventory of this node's store — a restarted head
+    # re-seeds its object directory from these, so refs minted before the
+    # restart keep resolving (the directory died with the old head; the
+    # bytes didn't)
+    stored_objects: List[Tuple[str, int]] = field(default_factory=list)
 
 
 @dataclass

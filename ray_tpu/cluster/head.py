@@ -40,7 +40,6 @@ from ray_tpu.scheduler.device import (
 )
 
 from .common import (
-    HEALTH_TIMEOUT_S,
     INLINE_OBJECT_MAX,
     ActorInfo,
     LeaseRequest,
@@ -66,11 +65,28 @@ SCHED_TICK_S = cfg.sched_tick_s
 MAX_BATCH = cfg.sched_max_batch
 
 
+from ray_tpu.util.metrics import Counter as _MetricCounter
+
+# best-effort callbacks the head dropped (chaos runs watch this: a swallowed
+# recovery error is invisible in logs at default level but not in metrics)
+HEAD_DROPPED_CALLBACKS = _MetricCounter(
+    "head_dropped_callbacks",
+    "Best-effort head-side callbacks that raised and were swallowed.",
+    label_names=("callable",),
+)
+
+
 def _best_effort(fn, *args, **kwargs):
     try:
         fn(*args, **kwargs)
     except Exception:  # noqa: BLE001
-        pass
+        # label by callable (+ rpc method when fn is RpcClient.call): the
+        # name set is small and fixed, so metric cardinality stays bounded
+        name = getattr(fn, "__name__", None) or repr(fn)
+        if args and isinstance(args[0], str):
+            name = f"{name}:{args[0]}"
+        HEAD_DROPPED_CALLBACKS.inc(labels={"callable": name})
+        logger.debug("best-effort call %s dropped", name, exc_info=True)
 
 
 # One writer per persist path per process: restart_head() keeps the old and
@@ -190,6 +206,7 @@ class HeadServer:
         self._shutdown = False
         self._persist_path = persist_path
         self._persist_dirty = False
+        self._lineage_dirty_at = 0.0  # rate gate for per-lease dirtying
         self._wal_queue: deque = deque()
         # pluggable persistence (store_client analog): any object with
         # load/save_snapshot/wal_append/wal_replay; FilePersistence default
@@ -308,6 +325,10 @@ class HeadServer:
     # actor directory; live actors re-attach when agents re-register)
     # ------------------------------------------------------------------
     def _snapshot_state(self) -> dict:
+        # streams first, OUTSIDE self._lock: _snapshot_streams takes
+        # _stream_cv then (separately) _lock — nesting it under _lock here
+        # would invert _h_wait_stream's (_stream_cv -> _lock) order
+        streams_part = self._snapshot_streams()
         with self._lock:
             return {
                 "kv": dict(self._kv),
@@ -317,7 +338,53 @@ class HeadServer:
                 },
                 "actor_specs": dict(self._actor_specs),
                 "jobs": self.jobs.snapshot() if hasattr(self, "jobs") else [],
+                # lineage: the head is this design's ownership authority,
+                # so task lineage must survive it the way the reference's
+                # owner workers survive a GCS restart. Without this, an
+                # object whose only copy dies AFTER a head restart is
+                # unrecoverable (no spec to re-execute). Debounced with
+                # the rest of the snapshot; a hard crash can lose the
+                # last ~1s of lineage, a clean restart loses none.
+                "leases": {
+                    lid: spec
+                    for lid, spec in self._leases.items()
+                    if spec.kind == "task" and spec.return_ids
+                },
+            } | streams_part
+
+    def _snapshot_streams(self) -> dict:
+        """Streaming-generator state for the snapshot: a head restart with
+        unconsumed items must not strand the consumer's WaitStream loop.
+        Inline item values ride along (they live nowhere else — large
+        items re-advertise from node stores on agent re-registration)."""
+        with self._stream_cv:
+            streams = {
+                tid: {
+                    "items": list(st["items"]),
+                    "done": st["done"],
+                    "consumed": st["consumed"],
+                    "delivered": st["delivered"],
+                    "abandoned": bool(st.get("abandoned")),
+                }
+                for tid, st in self._streams.items()
             }
+            tombstones = list(self._stream_tombstone_order)
+        inline: Dict[str, tuple] = {}
+        with self._lock:
+            for st in streams.values():
+                for oid in st["items"]:
+                    e = self._objects.get(oid)
+                    if e is None:
+                        continue
+                    if e.inline is not None:
+                        inline[oid] = ("inline", e.inline)
+                    elif e.error is not None:
+                        inline[oid] = ("error", e.error)
+        return {
+            "streams": streams,
+            "stream_tombstones": tombstones,
+            "stream_inline": inline,
+        }
 
     def _wal(self, record: tuple) -> None:
         """Queue a durable registration for the WAL. Called UNDER
@@ -360,6 +427,32 @@ class HeadServer:
         self._kv = dict(snap.get("kv", {}))
         self._named_actors = dict(snap.get("named_actors", {}))
         self._actor_specs = dict(snap.get("actor_specs", {}))
+        # recovered lineage: pre-create directory entries wired to their
+        # creating leases (unsealed, no locations — agents re-advertise
+        # the bytes on re-registration). Untracked entries are GC-exempt,
+        # consistent with all refcount state that predates a restart.
+        for lid, spec in snap.get("leases", {}).items():
+            self._leases[lid] = spec
+            for rid in spec.return_ids:
+                entry = self._objects.setdefault(rid, _ObjEntry())
+                entry.creating_lease = lid
+        # streaming-generator state: restored so consumers' WaitStream
+        # loops pick up where they left off. Inline item values are
+        # re-seeded here; store-resident items regain locations when
+        # their agents re-register.
+        now = time.monotonic()
+        for tid, st in snap.get("streams", {}).items():
+            self._streams[tid] = {**st, "touched": now}
+        for tid in snap.get("stream_tombstones", []):
+            self._tombstone_stream(tid)
+        for oid, (kind, blob) in snap.get("stream_inline", {}).items():
+            entry = self._objects.setdefault(oid, _ObjEntry())
+            if kind == "error":
+                entry.error = blob
+            else:
+                entry.inline = blob
+                entry.size = len(blob)
+            entry.event.set()
         for actor_id, fields in snap.get("actors", {}).items():
             info = ActorInfo(**fields)
             # hosting agents re-register and re-attach; until then, unknown
@@ -456,6 +549,17 @@ class HeadServer:
     def mark_dirty(self) -> None:
         self._persist_dirty = True
 
+    def _mark_hot_dirty(self) -> None:
+        """Rate-gated mark_dirty for HOT paths (lease submission, stream
+        item flow): dirtying per event would re-pickle the whole live
+        lease/stream state at the 1s persist tick — O(in-flight) work per
+        second on head threads. ~5s staleness is fine: clean restarts
+        flush on shutdown; only a hard crash can lose the gap."""
+        now = time.monotonic()
+        if now - self._lineage_dirty_at > 5.0:
+            self._lineage_dirty_at = now
+            self.mark_dirty()
+
     def _persist_now(self) -> None:
         lock = _PERSIST_LOCKS[self._persist_path]
         with lock:
@@ -500,7 +604,16 @@ class HeadServer:
         with self._cond:
             self.nodes[info.node_id] = info
             old_client = self._clients.get(info.node_id)
-            self._clients[info.node_id] = RpcClient(info.address)
+            # breaker -> health path: a wedged/blackholed transport to this
+            # node opens its circuit and declares it unreachable in
+            # ~rpc_breaker_window_s instead of stalling every dispatch for
+            # its full timeout (the 600s accelerator-transport wedge class)
+            self._clients[info.node_id] = RpcClient(
+                info.address,
+                on_unreachable=lambda nid=info.node_id: (
+                    self._peer_unreachable(nid)
+                ),
+            )
             if old_client is not None:
                 # in-flight calls on the old channel fail with RpcError and
                 # take the normal retry paths; never leak channels on rejoin
@@ -536,8 +649,40 @@ class HeadServer:
             # _mark_actor_alive handles the DEAD case by tearing the
             # zombie instance down on the agent
             self._mark_actor_alive(actor_id, info.node_id, info.address)
+        # re-seed the object directory from the agent's store inventory
+        # (head-restart recovery: the directory died with the old head but
+        # the bytes live on in node stores). Entries new to this head stay
+        # untracked — exempt from GC exactly like any refcount state that
+        # predates a restart — while entries the head already tracks just
+        # regain a location.
+        if info.stored_objects:
+            self._apply_seals(
+                [
+                    SealInfo(
+                        object_id=oid, node_id=info.node_id, size=int(size)
+                    )
+                    for oid, size in info.stored_objects
+                ]
+            )
         logger.info("node %s registered at %s", info.node_id, info.address)
         return {"node_id": info.node_id, "head_address": self.address}
+
+    def _peer_unreachable(self, node_id: str) -> None:
+        """Circuit breaker opened on this peer: its transport has been
+        failing for the whole server-unavailable window. Feed the health
+        path immediately — leases requeue, actors restart, and the agent
+        (if actually alive behind a one-way partition) re-registers on its
+        next report once the path heals."""
+        if self._shutdown:
+            return
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+        logger.warning(
+            "rpc circuit to node %s opened; marking unreachable", node_id
+        )
+        self._on_node_death(node_id)
 
     def _h_node_report(self, report: NodeReport) -> dict:
         with self._cond:
@@ -556,16 +701,56 @@ class HeadServer:
         return {"alive": alive}
 
     def _health_loop(self) -> None:
+        """Strike-based liveness (gcs_health_check_manager.h analog:
+        period x failure_threshold): a node is dead only after
+        ``health_miss_threshold`` CONSECUTIVE missed windows of
+        ``health_timeout_s / threshold`` each — total detection latency
+        stays ~health_timeout_s, but one wall-clock gap (GC pause,
+        transfer storm on a loaded host) no longer kills a healthy node.
+        The poll period is jittered so co-located heads (tests, multi-head
+        hosts) don't phase-align their scans."""
+        import random as _random
+
+        rng = _random.Random(0x4EA17)
+        strikes: Dict[str, int] = {}
+        last_strike: Dict[str, float] = {}
         while not self._shutdown:
-            time.sleep(HEALTH_TIMEOUT_S / 4)
+            threshold = max(1, int(cfg.health_miss_threshold))
+            window = cfg.health_timeout_s / threshold
+            time.sleep(window / 2.0 * rng.uniform(0.7, 1.3))
             now = time.monotonic()
             dead = []
             with self._lock:
+                known = set(self.nodes)
                 for nid, node in self.nodes.items():
-                    if node.alive and now - self._last_report.get(nid, now) > HEALTH_TIMEOUT_S:
+                    if not node.alive:
+                        continue
+                    gap = now - self._last_report.get(nid, now)
+                    if gap <= window:
+                        strikes.pop(nid, None)
+                        last_strike.pop(nid, None)
+                        continue
+                    # one strike per window, not per poll: the poll runs
+                    # ~2x per window, and a single long gap must not be
+                    # double-counted into an instant death
+                    if now - last_strike.get(nid, 0.0) >= window * 0.9:
+                        strikes[nid] = strikes.get(nid, 0) + 1
+                        last_strike[nid] = now
+                    if strikes.get(nid, 0) >= threshold:
                         dead.append(nid)
+            for nid in list(strikes):
+                if nid not in known:
+                    strikes.pop(nid, None)
+                    last_strike.pop(nid, None)
             for nid in dead:
-                logger.warning("node %s missed health checks; marking dead", nid)
+                strikes.pop(nid, None)
+                last_strike.pop(nid, None)
+                logger.warning(
+                    "node %s missed %d consecutive health windows; "
+                    "marking dead",
+                    nid,
+                    threshold,
+                )
                 self._on_node_death(nid)
             self._gc_idle_streams()
 
@@ -607,6 +792,27 @@ class HeadServer:
             self._restart_or_kill_actor(info, f"node {node_id} died")
 
     def _retry_or_fail(self, spec: LeaseRequest, reason: str) -> None:
+        if spec.kind == "actor_creation":
+            # a creation lease lost to node death / unreachable agent is a
+            # SCHEDULING failure, not an actor failure: reschedule without
+            # consuming the actor's restart budget (GcsActorScheduler
+            # reschedule-on-node-death analog). Without this, an actor
+            # whose hosting node died mid-creation parked PENDING forever
+            # (found by the chaos soak's early kill_node fault).
+            info = self._actors.get(spec.actor_id)
+            if info is not None and info.state != "DEAD":
+                logger.info(
+                    "actor %s creation lost (%s); rescheduling",
+                    spec.actor_id,
+                    reason,
+                )
+                spec.target_node = None
+                with self._cond:
+                    self._pending.append(spec)
+                    self._cond.notify_all()
+                return
+            self._release_lease_pins(spec.task_id)
+            return
         if spec.kind == "actor_method":
             self._seal_error_ids(spec.return_ids, RuntimeError(reason))
             if spec.streaming:
@@ -662,6 +868,34 @@ class HeadServer:
         with self._cond:
             self._pending.append(spec)
             self._cond.notify_all()
+
+    def chaos_drop_object(self, object_id: str) -> bool:
+        """Chaos fault: destroy every stored copy of a sealed object and
+        drop its directory locations, then drive the normal lineage
+        recovery path (its creating lease requeues and re-seals the same
+        id). Returns False for objects that can't be meaningfully dropped
+        (unknown, inline-valued, or never sealed)."""
+        with self._lock:
+            e = self._objects.get(object_id)
+            if (
+                e is None
+                or e.inline is not None
+                or e.error is not None
+                or not e.locations
+            ):
+                return False
+            victims = [
+                (nid, self._clients.get(nid)) for nid in list(e.locations)
+            ]
+            e.locations.clear()
+            e.event.clear()
+        for nid, client in victims:
+            if client is not None:
+                _best_effort(
+                    client.call, "DeleteObjects", {"object_ids": [object_id]}
+                )
+        self._recover_object(object_id, "<chaos>", set())
+        return True
 
     def _restart_or_kill_actor(self, info: ActorInfo, reason: str) -> None:
         with self._lock:
@@ -882,6 +1116,7 @@ class HeadServer:
                 # the re-seal already refreshed its location; nothing to do
                 st["touched"] = time.monotonic()
             self._stream_cv.notify_all()
+        self._mark_hot_dirty()  # stream state rides the debounced snapshot
 
     def _apply_stream_done(self, dones: List[dict]) -> None:
         with self._stream_cv:
@@ -905,6 +1140,7 @@ class HeadServer:
                 st["done"] = True
                 st["touched"] = time.monotonic()
             self._stream_cv.notify_all()
+        self._mark_hot_dirty()
 
     def _fail_stream(self, spec: LeaseRequest, reason: str) -> None:
         """Lease-level failure (worker/node death, retries exhausted)."""
@@ -1356,6 +1592,8 @@ class HeadServer:
             # spec (and the arg refs its payload pins) can go too
             for lid in freed_leases:
                 self._leases.pop(lid, None)
+            if freed_leases:
+                self._persist_dirty = True  # lineage shrank
             clients = {
                 nid: self._clients.get(nid)
                 for nid in deletes
@@ -1390,6 +1628,9 @@ class HeadServer:
         self.events.record(
             spec.task_id, spec.name, "SUBMITTED", **_trace_args(spec)
         )
+        # lineage rides the debounced snapshot (no WAL: too hot per-lease)
+        if spec.kind == "task" and spec.return_ids:
+            self._mark_hot_dirty()
         return {"queued": True}
 
     def _h_client_batch(self, items: List[tuple]) -> None:
@@ -2465,20 +2706,29 @@ class HeadServer:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
-        if self._persist_path and self._persist_dirty:
+        if self._persist_path:
+            # UNCONDITIONAL final snapshot: hot-path dirtying is rate-gated
+            # (_mark_hot_dirty), so the dirty bit alone can't prove the
+            # last snapshot is current — a clean shutdown must never lose
+            # the gate window
             self._persist_dirty = False
-            self._persist_now()  # flush the last debounce window
+            self._persist_now()
         self.jobs.shutdown()
         if self.dashboard is not None:
             self.dashboard.stop()
+        with self._lock:
+            clients = list(self._clients.values())
         if stop_agents:
-            with self._lock:
-                clients = list(self._clients.values())
             for client in clients:
                 try:
                     client.call("Shutdown", timeout=1.0)
                 except RpcError:
                     pass
+        # close channels AND unregister this head's breaker callbacks: a
+        # successor head (restart_head keeps both in-process for a moment)
+        # must not see stale unreachable-callbacks fire into dead state
+        for client in clients:
+            _best_effort(client.close)
         self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
         self._server.stop()
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 import shutil
 import threading
 from collections import OrderedDict
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class SpillingStore:
@@ -225,6 +225,16 @@ class SpillingStore:
             except Exception:  # noqa: BLE001
                 pass
         self.backend.delete(oid)  # network call: outside the lock
+
+    def list_objects(self) -> List[Tuple[str, int]]:
+        """(oid, size) inventory of everything this node holds — arena
+        residents plus spilled entries. The agent advertises this on
+        (re-)registration so a restarted head can re-seed its object
+        directory."""
+        with self._lock:
+            out = list(self._resident.items())
+            out.extend(self._spilled.items())
+        return out
 
     def stats(self) -> dict:
         base = getattr(self.inner, "stats", None)

@@ -169,6 +169,108 @@ def test_oom_victim_is_newest_plain_task():
     assert a._pick_oom_victim() is None
 
 
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning"
+)
+def test_actor_max_task_retries_redelivery_on_chaos_kill():
+    """Chaos-kill the actor's node mid-call: in-flight calls with retry
+    budget redeliver after the restart IN SUBMISSION ORDER; every caller
+    still gets its result (actor.py mark_died redelivery machinery)."""
+    import threading
+
+    import ray_tpu as rtpu
+    from ray_tpu.core.runtime import get_runtime
+
+    log = []
+    first_run = threading.Event()
+
+    class Recorder:
+        def __init__(self):
+            log.append("start")
+
+        async def work(self, tag):
+            import asyncio
+
+            log.append(f"begin:{tag}")
+            if not first_run.is_set() and tag == "m1":
+                first_run.set()
+                # park until the chaos kill stops this instance's loop;
+                # the redelivered attempt takes the fast path
+                await asyncio.sleep(30)
+            log.append(f"end:{tag}")
+            return tag
+
+    rtpu.init(num_nodes=2, resources_per_node={"CPU": 4})
+    try:
+        Actor = rtpu.remote(Recorder)
+        a = Actor.options(
+            max_restarts=1, max_task_retries=1, max_concurrency=1
+        ).remote()
+        r1 = a.work.remote("m1")
+        deadline = time.monotonic() + 10
+        while not first_run.is_set():
+            assert time.monotonic() < deadline, "m1 never started"
+            time.sleep(0.01)
+        # queued behind the in-flight m1 (max_concurrency=1)
+        r2 = a.work.remote("m2")
+        r3 = a.work.remote("m3")
+        node = a._actor_state.node_id
+        get_runtime().kill_node(node)
+        assert rtpu.get(r1, timeout=30) == "m1"
+        assert rtpu.get(r2, timeout=30) == "m2"
+        assert rtpu.get(r3, timeout=30) == "m3"
+        # the actor restarted exactly once and redelivery preserved
+        # submission order: m1 (retried) before m2 before m3
+        assert log.count("start") == 2
+        post = log[log.index("start", 1) :]
+        order = [e for e in post if e.startswith("end:")]
+        assert order == ["end:m1", "end:m2", "end:m3"], log
+    finally:
+        rtpu.shutdown()
+
+
+def test_head_restart_with_unconsumed_stream_items(tmp_path):
+    """Head restart while a streaming generator has unconsumed items:
+    stream state rides the snapshot (items/done/consumed watermarks plus
+    inline item values), so the consumer drains every item instead of
+    parking forever on a stream the new head never heard of."""
+    c = Cluster(persist_path=str(tmp_path / "head_state.pkl"))
+    c.add_node({"CPU": 2.0}, num_workers=2)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+
+        def gen(n):
+            for i in range(n):
+                yield i * 10
+
+        g = (
+            ray_tpu.remote(gen)
+            .options(num_returns="streaming", max_retries=0)
+            .remote(6)
+        )
+        it = iter(g)
+        # consume two items, leave the rest unconsumed on the head
+        assert ray_tpu.get(next(it), timeout=60) == 0
+        assert ray_tpu.get(next(it), timeout=60) == 10
+        # let the executor finish sealing all items + done marker
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with c.head._stream_cv:
+                st = list(c.head._streams.values())
+            if st and st[0]["done"] and len(st[0]["items"]) == 6:
+                break
+            time.sleep(0.1)
+
+        c.restart_head()
+
+        got = [ray_tpu.get(r, timeout=60) for r in it]
+        assert got == [20, 30, 40, 50]
+    finally:
+        set_runtime(None)
+        c.shutdown()
+
+
 def test_wal_recovered_actor_resubmits_creation(tmp_path, monkeypatch):
     """An actor REGISTERED but never created when the head crashed (the
     WAL window) has no hosting agent to re-attach it — recovery must
