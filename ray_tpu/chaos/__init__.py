@@ -1,0 +1,26 @@
+"""Deterministic chaos engineering for the distributed runtime.
+
+A seeded fault plan (kills, head restarts, partitions, stragglers,
+object drops) executes against a live cluster interleaved with a
+verifiable workload; an invariant checker asserts convergence after
+every fault. The same seed replays the exact same schedule
+(``RAY_TPU_CHAOS_SEED``); see chaos/plan.py.
+"""
+from ray_tpu.config import cfg
+
+from .invariants import CheckResult, InvariantChecker, Snapshot  # noqa: F401
+from .orchestrator import (  # noqa: F401
+    ChaosOrchestrator,
+    ChaosRunResult,
+    FaultResult,
+)
+from .plan import DEFAULT_MIX, KINDS, ChaosPlan, FaultSpec, make_plan  # noqa: F401
+from .workload import ChaosCounter, ChaosWorkload  # noqa: F401
+
+
+def chaos_seed(default: int = 0) -> int:
+    """The run's chaos seed: ``RAY_TPU_CHAOS_SEED`` env (via config) or
+    ``default``. Print it in any failure report — it replays the exact
+    fault schedule."""
+    env = cfg.chaos_seed
+    return int(env) if env else int(default)

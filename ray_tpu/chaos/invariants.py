@@ -1,0 +1,186 @@
+"""Post-fault invariant checks.
+
+After every injected fault the orchestrator waits for the cluster to
+converge and then asserts, in order:
+
+1. **Membership** — every agent process that should be alive is ALIVE at
+   the head (killed nodes excluded; partitioned nodes re-register after
+   heal).
+2. **No acked-object loss** — a sample of results the driver already
+   observed still resolves to byte-identical values (lineage rebuilds
+   dropped copies; a restarted head re-seeds its directory from agent
+   store inventories).
+3. **Actor recovery** — every restartable workload actor is ALIVE at the
+   head AND answers a method call within the restart budget.
+4. **Lease drain** — in-flight submissions either complete or fail with
+   a definite exhausted-retry/dead-actor error; nothing hangs.
+5. **Durable-state match** — after a head restart, the recovered KV
+   entries and named-actor bindings equal the pre-fault snapshot.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .workload import ChaosWorkload
+
+
+@dataclass
+class Snapshot:
+    """Durable head state captured before a fault."""
+
+    kv: Dict[str, bytes] = field(default_factory=dict)
+    named_actors: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CheckResult:
+    ok: bool
+    failures: List[str] = field(default_factory=list)
+
+
+class InvariantChecker:
+    def __init__(
+        self,
+        cluster,
+        workload: ChaosWorkload,
+        actor_restart_budget_s: float = 60.0,
+        object_timeout_s: float = 60.0,
+    ):
+        self.cluster = cluster
+        self.workload = workload
+        self.actor_restart_budget_s = actor_restart_budget_s
+        self.object_timeout_s = object_timeout_s
+
+    # -- pre-fault ------------------------------------------------------
+    def snapshot(self) -> Snapshot:
+        head = self.cluster.head
+        with head._lock:
+            return Snapshot(
+                kv=dict(head._kv),
+                named_actors=dict(head._named_actors),
+            )
+
+    # -- convergence ----------------------------------------------------
+    def expected_alive(self) -> int:
+        """Agent processes still running = nodes that must be ALIVE."""
+        return sum(
+            1 for p in self.cluster._agents.values() if p.poll() is None
+        )
+
+    def wait_membership(self, deadline: float) -> Optional[str]:
+        want = self.expected_alive()
+        while time.monotonic() < deadline:
+            alive = sum(
+                1 for n in self.cluster.head.nodes.values() if n.alive
+            )
+            if alive >= want:
+                return None
+            time.sleep(0.1)
+        alive = sum(1 for n in self.cluster.head.nodes.values() if n.alive)
+        return f"membership: {alive}/{want} nodes alive at the head"
+
+    def wait_actors(self, deadline: float) -> List[str]:
+        import ray_tpu
+
+        failures: List[str] = []
+        for handle, aid in zip(
+            self.workload.actors, self.workload.actor_ids
+        ):
+            recovered = False
+            while time.monotonic() < deadline:
+                info = self.cluster.head._actors.get(aid)
+                state = info.state if info is not None else "UNKNOWN"
+                if state == "ALIVE":
+                    try:
+                        budget = max(1.0, deadline - time.monotonic())
+                        if (
+                            ray_tpu.get(
+                                handle.ping.remote(), timeout=budget
+                            )
+                            == "pong"
+                        ):
+                            recovered = True
+                            break
+                    except Exception:  # noqa: BLE001 - retry until budget
+                        pass
+                elif state == "DEAD":
+                    failures.append(
+                        f"actor {aid[:8]} is DEAD (restart budget was "
+                        "not exhausted by the plan)"
+                    )
+                    recovered = True  # definite, stop polling
+                    break
+                time.sleep(0.2)
+            if not recovered:
+                failures.append(
+                    f"actor {aid[:8]} not responsive within the "
+                    f"{self.actor_restart_budget_s}s restart budget"
+                )
+        return failures
+
+    def check_leases_drained(self, timeout: float) -> List[str]:
+        """Every pending submission resolves or fails definitively."""
+        self.workload.ack(timeout=timeout)
+        failures = [
+            f"lease for object {ref.hex[:8]} hung (neither completed "
+            "nor failed definitively)"
+            for ref, _ in self.workload.pending
+        ]
+        # definite failures are legal ONLY as exhausted-retry /
+        # dead-actor / cancelled errors
+        for h, reason in self.workload.failed_pending:
+            low = reason.lower()
+            if not any(
+                key in low
+                for key in (
+                    "retries exhausted",
+                    "retry",
+                    "died",
+                    "dead",
+                    "cancelled",
+                    "unreachable",
+                    "lost",
+                )
+            ):
+                failures.append(
+                    f"lease for object {h[:8]} failed with an "
+                    f"unexpected error: {reason}"
+                )
+        self.workload.failed_pending.clear()
+        return failures
+
+    def check_durable_state(self, pre: Snapshot) -> List[str]:
+        head = self.cluster.head
+        failures: List[str] = []
+        with head._lock:
+            kv = dict(head._kv)
+            named = dict(head._named_actors)
+        for key, value in pre.kv.items():
+            if kv.get(key) != value:
+                failures.append(
+                    f"durable kv {key!r} diverged after recovery"
+                )
+        for name, aid in pre.named_actors.items():
+            if named.get(name) != aid:
+                failures.append(
+                    f"named actor {name!r} lost its binding after recovery"
+                )
+        return failures
+
+    def check_convergence(self, pre: Snapshot) -> CheckResult:
+        deadline = time.monotonic() + self.actor_restart_budget_s
+        failures: List[str] = []
+        miss = self.wait_membership(deadline)
+        if miss:
+            failures.append(miss)
+        failures.extend(self.wait_actors(deadline))
+        failures.extend(
+            self.check_leases_drained(timeout=self.object_timeout_s)
+        )
+        failures.extend(
+            self.workload.verify_acked(timeout=self.object_timeout_s)
+        )
+        failures.extend(self.check_durable_state(pre))
+        return CheckResult(ok=not failures, failures=failures)

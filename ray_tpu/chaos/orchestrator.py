@@ -1,0 +1,268 @@
+"""Deterministic chaos orchestrator.
+
+Executes a seeded :class:`~ray_tpu.chaos.plan.ChaosPlan` against a live
+:class:`~ray_tpu.cluster.cluster_utils.Cluster`, interleaving faults with
+a verifiable workload and asserting invariant convergence after every
+injection. Fault kinds:
+
+- ``kill_node``      — SIGKILL an agent process; a replacement node joins
+                       so capacity (and actor restart targets) survive a
+                       long soak.
+- ``head_restart``   — restart the head mid-flight on the same port
+                       (requires a persist_path so durable state recovers).
+- ``partition``      — per-peer RPC blackhole from the control plane to
+                       one node for a bounded hold, then heal. Long holds
+                       open the circuit breaker into the health path;
+                       short holds exercise retry/spillback only.
+- ``straggler``      — delay ramp on one node's RPC path (injected
+                       latency rises, holds, falls back to zero).
+- ``object_drop``    — destroy every stored copy of an acked object and
+                       drop its directory entries; lineage must rebuild
+                       it.
+
+Every fault records recovery latency = time from injection until all
+invariants are green again; the run result carries p50/p95 plus objects
+reconstructed, for the bench chaos tier.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.util.metrics import Counter as _Counter
+from ray_tpu.util.metrics import Histogram as _Histogram
+
+from .invariants import InvariantChecker
+from .plan import ChaosPlan, FaultSpec
+from .workload import ChaosWorkload
+
+logger = logging.getLogger("ray_tpu.chaos")
+
+CHAOS_FAULTS = _Counter(
+    "chaos_faults_injected_total",
+    "Faults injected by the chaos orchestrator.",
+    label_names=("kind",),
+)
+CHAOS_INVARIANT_FAILURES = _Counter(
+    "chaos_invariant_failures_total",
+    "Invariant checks that failed after a fault converged.",
+    label_names=("kind",),
+)
+CHAOS_RECOVERY = _Histogram(
+    "chaos_recovery_seconds",
+    "Time from fault injection to all invariants green.",
+)
+
+
+@dataclass
+class FaultResult:
+    spec: FaultSpec
+    ok: bool
+    recovery_s: float
+    failures: List[str] = field(default_factory=list)
+    detail: str = ""
+
+
+@dataclass
+class ChaosRunResult:
+    seed: int
+    faults: List[FaultResult] = field(default_factory=list)
+    objects_reconstructed: int = 0
+    objects_acked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return all(f.ok for f in self.faults)
+
+    def recovery_percentiles(self) -> Dict[str, float]:
+        lat = sorted(f.recovery_s for f in self.faults)
+        if not lat:
+            return {"p50": 0.0, "p95": 0.0}
+        return {
+            "p50": lat[len(lat) // 2],
+            "p95": lat[min(len(lat) - 1, int(len(lat) * 0.95))],
+        }
+
+    def summary(self) -> dict:
+        counts: Dict[str, int] = {}
+        for f in self.faults:
+            counts[f.spec.kind] = counts.get(f.spec.kind, 0) + 1
+        return {
+            "seed": self.seed,
+            "ok": self.ok,
+            "faults_injected": len(self.faults),
+            "fault_counts": counts,
+            "objects_acked": self.objects_acked,
+            "objects_reconstructed": self.objects_reconstructed,
+            "recovery_latency_s": self.recovery_percentiles(),
+            "failures": [
+                {"fault": f.spec.index, "kind": f.spec.kind, "why": f.failures}
+                for f in self.faults
+                if not f.ok
+            ],
+        }
+
+
+class ChaosOrchestrator:
+    def __init__(
+        self,
+        cluster,
+        workload: ChaosWorkload,
+        plan: ChaosPlan,
+        *,
+        node_resources: Optional[dict] = None,
+        workers_per_node: int = 2,
+        tasks_per_step: int = 4,
+        partition_hold_s: float = 1.0,
+        straggler_peak_s: float = 0.3,
+        convergence_budget_s: float = 60.0,
+    ):
+        self.cluster = cluster
+        self.workload = workload
+        self.plan = plan
+        self.node_resources = dict(node_resources or {"CPU": 2.0})
+        self.workers_per_node = workers_per_node
+        self.tasks_per_step = tasks_per_step
+        self.partition_hold_s = partition_hold_s
+        self.straggler_peak_s = straggler_peak_s
+        self.checker = InvariantChecker(
+            cluster,
+            workload,
+            actor_restart_budget_s=convergence_budget_s,
+            object_timeout_s=convergence_budget_s,
+        )
+        # runtime randomness (victim picks among equivalent live nodes)
+        # derives from the plan seed too: full-run determinism modulo
+        # scheduler placement
+        self._rng = random.Random(plan.seed ^ 0x5EED)
+
+    # -- node selection -------------------------------------------------
+    def _live_nodes(self) -> List[str]:
+        return sorted(
+            nid
+            for nid, info in self.cluster.head.nodes.items()
+            if info.alive
+            and self.cluster._agents.get(nid) is not None
+            and self.cluster._agents[nid].poll() is None
+        )
+
+    def _pick_node(self, spec: FaultSpec) -> Optional[str]:
+        live = self._live_nodes()
+        if not live:
+            return None
+        return live[spec.target % len(live)]
+
+    # -- fault injection ------------------------------------------------
+    def _inject(self, spec: FaultSpec) -> str:
+        kind = spec.kind
+        CHAOS_FAULTS.inc(labels={"kind": kind})
+        if kind == "kill_node":
+            nid = self._pick_node(spec)
+            if nid is None:
+                return "skipped: no live node to kill"
+            self.cluster.kill_node(nid)
+            # backfill so capacity and restart targets survive the soak
+            self.cluster.add_node(
+                dict(self.node_resources),
+                num_workers=self.workers_per_node,
+                wait=False,
+            )
+            return f"killed {nid}, replacement joining"
+        if kind == "head_restart":
+            if not self.cluster._persist_path:
+                return "skipped: no persist_path (head restart needs one)"
+            self.cluster.restart_head()
+            return "head restarted on the same port"
+        if kind == "partition":
+            nid = self._pick_node(spec)
+            if nid is None:
+                return "skipped: no live node to partition"
+            hold = self.partition_hold_s * (0.5 + spec.magnitude)
+            self.cluster.partition_node(nid)
+            time.sleep(hold)
+            self.cluster.heal_node(nid)
+            return f"partitioned {nid} for {hold:.2f}s"
+        if kind == "straggler":
+            nid = self._pick_node(spec)
+            if nid is None:
+                return "skipped: no live node to slow down"
+            peak = self.straggler_peak_s * (0.5 + spec.magnitude)
+            # ramp up, hold, ramp down — a drifting slow node, not a cliff
+            for frac in (0.33, 0.66, 1.0):
+                self.cluster.set_node_delay(nid, peak * frac)
+                time.sleep(0.1)
+            time.sleep(0.2)
+            self.cluster.set_node_delay(nid, 0.0)
+            return f"straggler ramp on {nid} peaking at {peak:.2f}s"
+        if kind == "object_drop":
+            ref = self.workload.sample_acked_ref(self._rng)
+            if ref is None:
+                return "skipped: nothing acked to drop yet"
+            if not self.cluster.head.chaos_drop_object(ref.hex):
+                return f"skipped: {ref.hex[:8]} not droppable (inline?)"
+            self._dropped_hex = ref.hex
+            return f"dropped all copies of {ref.hex[:8]}"
+        raise ValueError(f"unknown fault kind {kind!r}")
+
+    # -- the run --------------------------------------------------------
+    def run(self) -> ChaosRunResult:
+        result = ChaosRunResult(seed=self.plan.seed)
+        try:
+            for spec in self.plan.faults:
+                self.workload.step(self.tasks_per_step)
+                time.sleep(spec.delay_s)
+                pre = self.checker.snapshot()
+                t0 = time.monotonic()
+                self._dropped_hex: Optional[str] = None
+                detail = self._inject(spec)
+                logger.info(
+                    "chaos #%d %s: %s", spec.index, spec.kind, detail
+                )
+                check = self.checker.check_convergence(pre)
+                if self._dropped_hex is not None:
+                    # the drop's specific victim must rebuild (the sampled
+                    # acked sweep may not have included it)
+                    miss = self.workload.verify_ref(
+                        self._dropped_hex,
+                        timeout=self.checker.object_timeout_s,
+                    )
+                    if miss:
+                        check.ok = False
+                        check.failures.append(miss)
+                recovery = time.monotonic() - t0
+                CHAOS_RECOVERY.observe(recovery)
+                if not check.ok:
+                    CHAOS_INVARIANT_FAILURES.inc(
+                        len(check.failures), labels={"kind": spec.kind}
+                    )
+                    logger.error(
+                        "chaos #%d %s invariants FAILED (seed=%d): %s",
+                        spec.index,
+                        spec.kind,
+                        self.plan.seed,
+                        check.failures,
+                    )
+                if (
+                    spec.kind == "object_drop"
+                    and detail.startswith("dropped")
+                    and check.ok
+                ):
+                    # every copy was destroyed and the invariant pass
+                    # re-got the value: lineage rebuilt exactly one object
+                    result.objects_reconstructed += 1
+                result.faults.append(
+                    FaultResult(
+                        spec=spec,
+                        ok=check.ok,
+                        recovery_s=recovery,
+                        failures=check.failures,
+                        detail=detail,
+                    )
+                )
+        finally:
+            self.cluster.heal_all()
+        result.objects_acked = self.workload.objects_acked
+        return result

@@ -1,0 +1,86 @@
+"""Deterministic fault plans.
+
+The reference validates fault tolerance with a chaos release suite
+(node-killer actors injected while invariant checks run,
+release/nightly_tests chaos_test/* and python/ray/_private/test_utils.py
+RayletKiller). Ours is deterministic end-to-end: a plan is a pure
+function of ``(seed, num_faults, mix)`` — replaying the same seed
+reproduces the exact same fault schedule, so any soak failure is
+replayable with ``RAY_TPU_CHAOS_SEED``.
+
+Targets are drawn as raw integers at plan time and resolved modulo the
+live node set at injection time: the schedule stays fixed even though
+cluster membership changes as faults kill and replace nodes.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+# fault kind -> default mix weight
+DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
+    ("partition", 3.0),
+    ("straggler", 3.0),
+    ("object_drop", 3.0),
+    ("kill_node", 2.0),
+    ("head_restart", 1.0),
+)
+
+KINDS = tuple(k for k, _ in DEFAULT_MIX)
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault. ``target`` picks a node (modulo the live set
+    at injection time); ``magnitude`` in [0,1) scales kind-specific
+    parameters (partition hold, straggler delay peak); ``delay_s`` is the
+    pause after the previous fault converges."""
+
+    index: int
+    kind: str
+    delay_s: float
+    target: int
+    magnitude: float
+
+
+@dataclass
+class ChaosPlan:
+    seed: int
+    faults: List[FaultSpec] = field(default_factory=list)
+
+    def counts(self) -> dict:
+        out: dict = {}
+        for f in self.faults:
+            out[f.kind] = out.get(f.kind, 0) + 1
+        return out
+
+
+def make_plan(
+    seed: int,
+    num_faults: int,
+    mix: Sequence[Tuple[str, float]] = DEFAULT_MIX,
+    allow: Optional[Sequence[str]] = None,
+    min_delay_s: float = 0.05,
+    max_delay_s: float = 0.5,
+) -> ChaosPlan:
+    """Deterministic plan: same arguments -> identical schedule."""
+    pairs = [
+        (k, w) for k, w in mix if allow is None or k in allow
+    ]
+    if not pairs:
+        raise ValueError("fault mix is empty after applying allow-list")
+    kinds = [k for k, _ in pairs]
+    weights = [w for _, w in pairs]
+    rng = random.Random(seed)
+    faults = [
+        FaultSpec(
+            index=i,
+            kind=rng.choices(kinds, weights=weights)[0],
+            delay_s=rng.uniform(min_delay_s, max_delay_s),
+            target=rng.randrange(1 << 30),
+            magnitude=rng.random(),
+        )
+        for i in range(num_faults)
+    ]
+    return ChaosPlan(seed=seed, faults=faults)

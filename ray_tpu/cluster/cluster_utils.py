@@ -111,6 +111,53 @@ class Cluster:
             proc.kill()
             proc.wait(timeout=10)
 
+    # ------------------------------------------------------------------
+    # chaos fault surface (ray_tpu.chaos rides these)
+    # ------------------------------------------------------------------
+    def agent_address(self, node_id: str) -> Optional[str]:
+        info = self.head.nodes.get(node_id)
+        return info.address if info is not None else None
+
+    def partition_node(self, node_id: str) -> bool:
+        """Blackhole the control plane's path TO this node (one-way
+        partition): every head->agent RPC fails at transport level, the
+        per-peer circuit breaker opens within its window, and the
+        node-unreachable callback feeds the health path. The agent itself
+        keeps running — on heal it re-registers and rejoins."""
+        from .rpc import FAULTS
+
+        addr = self.agent_address(node_id)
+        if addr is None:
+            return False
+        FAULTS.blackhole(addr)
+        return True
+
+    def heal_node(self, node_id: str) -> bool:
+        from .rpc import FAULTS
+
+        addr = self.agent_address(node_id)
+        if addr is None:
+            return False
+        FAULTS.heal(addr)
+        return True
+
+    def set_node_delay(self, node_id: str, seconds: float) -> bool:
+        """Straggler injection: every head->agent RPC to this node waits
+        ``seconds`` before hitting the wire (delay ramps come from the
+        chaos plan calling this repeatedly)."""
+        from .rpc import FAULTS
+
+        addr = self.agent_address(node_id)
+        if addr is None:
+            return False
+        FAULTS.set_delay(addr, seconds)
+        return True
+
+    def heal_all(self) -> None:
+        from .rpc import FAULTS
+
+        FAULTS.clear()
+
     def client(self) -> RemoteRuntime:
         return RemoteRuntime(self.address)
 

@@ -1,0 +1,140 @@
+"""Deterministic chaos orchestrator: plan determinism, fast fault tier,
+and the full seeded soak (slow).
+
+Reference analog: the chaos release suite (release/nightly_tests
+chaos_test/*, RayletKiller in _private/test_utils.py) — node killers
+injected while invariants are checked. Ours is seeded end-to-end:
+``RAY_TPU_CHAOS_SEED`` replays any failure's exact fault schedule.
+"""
+import os
+import tempfile
+
+import pytest
+
+from ray_tpu.chaos import chaos_seed, make_plan
+
+# seed 51's first three faults under this allow-list are one partition,
+# one object_drop, one straggler — all three fast kinds in one smoke
+FAST_SEED = 51
+
+
+def test_plan_is_deterministic_per_seed():
+    p1 = make_plan(42, 50)
+    p2 = make_plan(42, 50)
+    p3 = make_plan(43, 50)
+    assert p1 == p2, "same seed must reproduce the same fault schedule"
+    assert p1 != p3, "different seeds must differ"
+    assert len(p1.faults) == 50
+    # every fault kind shows up in a 50-fault default-mix plan
+    assert set(p1.counts()) == {
+        "partition",
+        "straggler",
+        "object_drop",
+        "kill_node",
+        "head_restart",
+    }
+
+
+def test_plan_allow_list_filters_kinds():
+    p = make_plan(7, 30, allow=("straggler", "object_drop"))
+    assert set(p.counts()) <= {"straggler", "object_drop"}
+
+
+def test_chaos_seed_env_round_trip(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CHAOS_SEED", "909")
+    assert chaos_seed() == 909
+    monkeypatch.delenv("RAY_TPU_CHAOS_SEED")
+    assert chaos_seed(default=5) == 5
+
+
+def _run_chaos(
+    num_faults: int,
+    allow,
+    seed: int,
+    num_nodes: int = 1,
+    convergence_budget_s: float = 45.0,
+    partition_hold_s: float = 0.5,
+):
+    import ray_tpu  # noqa: F401
+    from ray_tpu.chaos import ChaosOrchestrator, ChaosWorkload
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+
+    tmp = tempfile.mkdtemp(prefix="chaos_test_")
+    cluster = Cluster(
+        use_device_scheduler=False,
+        persist_path=os.path.join(tmp, "head_state.pkl"),
+    )
+    for _ in range(num_nodes):
+        cluster.add_node({"CPU": 2.0}, num_workers=2)
+    rt = cluster.client()
+    set_runtime(rt)
+    try:
+        workload = ChaosWorkload(rt, payload_bytes=150_000, num_actors=1)
+        plan = make_plan(seed, num_faults, allow=allow)
+        orch = ChaosOrchestrator(
+            cluster,
+            workload,
+            plan,
+            node_resources={"CPU": 2.0},
+            partition_hold_s=partition_hold_s,
+            straggler_peak_s=0.2,
+            convergence_budget_s=convergence_budget_s,
+        )
+        return orch.run()
+    finally:
+        set_runtime(None)
+        try:
+            rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
+def test_fast_deterministic_chaos_tier():
+    """Tier-1 smoke: a fixed-seed 3-fault plan (no process kills — those
+    live in the slow soak) converges with every invariant green."""
+    result = _run_chaos(
+        num_faults=3,
+        allow=("straggler", "object_drop", "partition"),
+        seed=FAST_SEED,
+        convergence_budget_s=30.0,
+    )
+    assert result.ok, (
+        f"invariants failed (replay with RAY_TPU_CHAOS_SEED={FAST_SEED}): "
+        f"{result.summary()}"
+    )
+    assert len(result.faults) == 3
+    assert result.objects_acked > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_twenty_faults_zero_acked_loss(monkeypatch):
+    """The acceptance soak: >=20 faults across every kind (kills,
+    partitions, head restarts included) against a running workload —
+    zero acked-object loss, all restartable actors recovered, all
+    invariant checks green."""
+    # tight-but-real failure detection: the soak spends its wall clock on
+    # faults, not on twenty 8s death timeouts
+    monkeypatch.setenv("RAY_TPU_HEALTH_TIMEOUT_S", "4.0")
+    monkeypatch.setenv("RAY_TPU_RPC_BREAKER_WINDOW_S", "2.0")
+    seed = chaos_seed(default=20260803)
+    result = _run_chaos(
+        num_faults=20,
+        allow=None,  # full default mix
+        seed=seed,
+        num_nodes=2,
+        convergence_budget_s=60.0,
+        partition_hold_s=1.0,
+    )
+    assert len(result.faults) == 20
+    assert result.ok, (
+        f"soak failed — replay with RAY_TPU_CHAOS_SEED={seed}: "
+        f"{result.summary()}"
+    )
+    counts = result.summary()["fault_counts"]
+    assert counts.get("kill_node", 0) >= 1
+    assert counts.get("partition", 0) >= 1
+    assert result.objects_acked >= 20
+    # replaying the seed reproduces the same schedule
+    assert make_plan(seed, 20) == make_plan(seed, 20)
