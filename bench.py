@@ -399,6 +399,29 @@ def _noop():
     return None
 
 
+def _agent_pool_stats(cluster) -> dict:
+    """Aggregate warm-pool counters across the cluster's agents (the
+    DebugState 'pool' block: idle-pool hit rate, scrub-reuse count,
+    fork vs cold spawn split)."""
+    from ray_tpu.cluster.rpc import RpcClient
+
+    agg = {"hits": 0, "misses": 0, "reused": 0, "forked": 0, "cold_spawned": 0}
+    for info in list(cluster.head.nodes.values()):
+        client = RpcClient(info.address)
+        try:
+            st = client.call("DebugState", timeout=10.0)
+        except Exception:  # noqa: BLE001 - agent may be gone
+            continue
+        finally:
+            client.close()
+        pool = st.get("pool") or {}
+        for k in agg:
+            agg[k] += int(pool.get(k) or 0)
+    total = agg["hits"] + agg["misses"]
+    agg["hit_rate"] = round(agg["hits"] / total, 4) if total else None
+    return agg
+
+
 def _inc_batch(b):
     return {"data": b["data"] + 1}
 
@@ -526,6 +549,16 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
         per_core = async_calls_per_s / cores
         baseline_per_core = BASELINE_NN_ASYNC_CALLS_PER_S / 64.0
 
+        # release the async-tier actors before the churn tiers (same
+        # hygiene as the DAG chain above): tier 6 measures creation
+        # against an otherwise-idle cluster, and their scrubbed workers
+        # return to the pool instead of sitting pinned
+        for h_ in actors:
+            try:
+                ray_tpu.kill(h_)
+            except Exception:  # noqa: BLE001
+                pass
+
         # tier 6: actor-creation throughput (many_actors.json analog) —
         # create N tiny actors, wait until every one answered a ping
         # (state ALIVE + method served), then release them
@@ -546,6 +579,35 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
                 ray_tpu.kill(h_)
             except Exception:  # noqa: BLE001
                 pass
+        # per-creation latency against a warm (fork-server + reuse) pool:
+        # sequential create→first-reply round trips, p50 over a small
+        # sample — the number a Serve replica scale-up or Data actor-pool
+        # ramp actually feels per actor
+        create_lat_ms = []
+        for _ in range(7):
+            t_c = time.perf_counter()
+            a = Echo.options(num_cpus=0.01, max_restarts=0).remote()
+            ray_tpu.get(a.ping.remote(0), timeout=120)
+            create_lat_ms.append((time.perf_counter() - t_c) * 1e3)
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        actor_metrics = {
+            "actor_creation_p50_ms": round(
+                float(np.percentile(create_lat_ms, 50)), 1
+            ),
+            "worker_pool": _agent_pool_stats(c),
+        }
+        # env-tunable regression floor (off by default): CI sets
+        # RAY_TPU_BENCH_ACTORS_FLOOR_PER_S to fail the bench run loudly
+        # when actor churn regresses below it
+        floor = float(
+            os.environ.get("RAY_TPU_BENCH_ACTORS_FLOOR_PER_S", "0") or 0.0
+        )
+        if floor > 0:
+            actor_metrics["actors_floor_per_s"] = floor
+            actor_metrics["actors_floor_ok"] = bool(actors_per_s >= floor)
 
         # tier 7: placement-group create/removal pairs (microbenchmark.json
         # placement_group_create/removal analog): each pair runs the JAX
@@ -623,6 +685,7 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
                 str(N): round(async_calls_per_s, 1),
             },
             "actor_creations_per_s": round(actors_per_s, 2),
+            **actor_metrics,
             "actors_vs_baseline": round(
                 actors_per_s / BASELINE_ACTORS_PER_S, 4
             ),
@@ -1034,6 +1097,12 @@ def main():
             }
         )
     )
+    if out.get("actors_floor_ok") is False:
+        # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S):
+        # the JSON above still published; exit nonzero so CI notices
+        import sys
+
+        sys.exit(1)
 
 
 if __name__ == "__main__":

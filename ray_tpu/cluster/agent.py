@@ -38,13 +38,39 @@ from .common import (
     new_id,
 )
 from .rpc import HANDLER_STATS, RpcClient, RpcError, RpcServer
+from .zygote import ZygoteClient, fork_available
 
 
 from ray_tpu.config import cfg
+from ray_tpu.util.metrics import Counter as _Counter
+from ray_tpu.util.metrics import Gauge as _Gauge
+from ray_tpu.util.metrics import Histogram as _Histogram
 
 logger = logging.getLogger("ray_tpu.cluster.agent")
 
 _EPS = 1e-9
+
+# worker-lifecycle instruments (worker_pool.cc stats analog). Process-wide
+# like every metric in util.metrics; per-agent counts live in
+# NodeAgent.pool_stats and surface through DebugState.
+WORKER_SPAWN_MS = _Histogram(
+    "worker_spawn_ms",
+    "Worker spawn-to-register latency; path=fork (zygote) vs spawn (cold).",
+    boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000),
+    label_names=("path",),
+)
+WORKER_POOL_HITS = _Counter(
+    "worker_pool_hits_total",
+    "Lease dispatches served immediately by an idle pooled worker.",
+)
+WORKER_POOL_MISSES = _Counter(
+    "worker_pool_misses_total",
+    "Lease dispatches that found the idle pool empty and had to wait.",
+)
+WORKER_PRESTART_INFLIGHT = _Gauge(
+    "worker_prestart_inflight",
+    "Prestarted workers spawned on a head hint, not yet registered.",
+)
 
 
 class _MemStore:
@@ -147,15 +173,21 @@ class _AdmissionSlot:
 
 
 class _WorkerHandle:
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    def __init__(self, worker_id: str, proc):
         self.worker_id = worker_id
-        self.proc = proc
+        self.proc = proc  # subprocess.Popen or zygote.ForkedProc
         self.client: Optional[RpcClient] = None
         self.ready = threading.Event()
         self.actor_id: Optional[str] = None  # pinned for an actor
         self.pip_key: Optional[str] = None  # bound to a pip runtime env
         self.idle_since: float = 0.0  # env workers: reap when idle long
         self.lock = threading.Lock()  # serializes pushes (actor ordering)
+        self.spawned_at: float = 0.0  # monotonic spawn time (spawn_ms metric)
+        self.spawn_path: str = "spawn"  # "fork" (zygote) | "spawn" (cold)
+        self.spawn_pending: bool = False  # spawned, not yet registered
+        self.prestart_pending: bool = False  # head-hinted, not yet registered
+        # actor creation applied a persisted runtime env here: reuse denied
+        self.env_tainted: bool = False
         # task_id -> dispatch time of in-flight plain tasks (OOM victim
         # selection: the memory monitor kills the NEWEST task first)
         self.running: Dict[str, float] = {}
@@ -248,6 +280,7 @@ class NodeAgent:
             "RollbackBundles": self._h_rollback_bundles,
             "ReturnBundles": self._h_return_bundles,
             "KillActor": self._h_kill_actor,
+            "PrestartWorkers": self._h_prestart_workers,
             "ActorWorkerAddress": self._h_actor_worker_address,
             "CancelLease": self._h_cancel_lease,
             "DagInstall": lambda r: self._forward_to_actor_worker(
@@ -284,8 +317,36 @@ class NodeAgent:
         self._async_buf: Dict[str, deque] = {}
         self._async_draining: set = set()
         self._num_workers = num_workers
-        for _ in range(num_workers):
-            self._spawn_worker()
+        # pool observability (DebugState "pool"): per-agent counts behind
+        # the process-wide Prometheus instruments above
+        self.pool_stats: Dict[str, int] = {
+            "hits": 0,
+            "misses": 0,
+            "reused": 0,
+            "forked": 0,
+            "cold_spawned": 0,
+        }
+        self._prestart_inflight = 0
+        # ALL spawns not yet registered (prestarted or not): the backfill
+        # and prestart sizing both count these as future-free capacity, so
+        # N concurrent creations cannot each trigger their own spawn for
+        # the same hole (the overspawn burned ~100ms of fork+init CPU per
+        # duplicate on a loaded host)
+        self._spawns_pending = 0
+        # fork-server: one zygote pays the worker import graph once; new
+        # workers fork from it in milliseconds (cold spawn stays the
+        # fallback — see zygote.py)
+        self._zygote: Optional[ZygoteClient] = None
+        self._zygote_restarts = 0
+        if cfg.fork_server and fork_available():
+            self._start_zygote()
+        # initial pool fill happens OFF the construction path: the first
+        # fork blocks on the zygote's one-time import warmup (~seconds
+        # with jax), and head registration must not wait behind it —
+        # leases arriving early just park in _pop_idle_worker meanwhile
+        threading.Thread(
+            target=self._fill_pool, name="agent-pool-fill", daemon=True
+        ).start()
 
         # remote-fetch client cache (peer addresses come from head lookups)
         self._peer_clients: Dict[str, RpcClient] = {}
@@ -377,10 +438,92 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # worker pool
     # ------------------------------------------------------------------
+    def _fill_pool(self) -> None:
+        for _ in range(self._num_workers):
+            if self._shutdown:
+                return
+            try:
+                self._spawn_worker()
+            except Exception:  # noqa: BLE001 - report loop backfills later
+                logger.exception("initial worker spawn failed")
+
+    def _start_zygote(self) -> None:
+        env = dict(os.environ)
+        env["RAY_TPU_HEAD_ADDRESS"] = self.head_address
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        try:
+            self._zygote = ZygoteClient(self.address, self.store_path, env)
+        except OSError:
+            logger.exception("zygote start failed; using cold spawn")
+            self._zygote = None
+
+    def _zygote_for_fork(self) -> Optional[ZygoteClient]:
+        """Live zygote client, restarting a broken one (bounded) —
+        repeated breakage means fork doesn't work here; stop trying."""
+        z = self._zygote
+        if z is None or not z.broken:
+            return z
+        with self._lock:
+            if self._zygote is z:
+                z.close()
+                self._zygote_restarts += 1
+                if self._zygote_restarts > 3:
+                    logger.warning(
+                        "zygote broke %d times; cold spawn from now on",
+                        self._zygote_restarts,
+                    )
+                    self._zygote = None
+                else:
+                    self._start_zygote()
+            return self._zygote
+
+    def _h_prestart_workers(self, req: dict) -> dict:
+        """Head hint: N actor-creation leases are headed here — warm the
+        pool while they are in flight (worker_pool.cc PrestartWorkers).
+        Bounded by prestart_max_workers above the steady pool size, and
+        discounted by workers already idle or warming."""
+        want = int(req.get("count", 0))
+        if want <= 0 or self._shutdown:
+            return {"spawned": 0}
+        with self._idle_cv:
+            free = len(self._idle) + self._spawns_pending
+            cap = (
+                self._num_workers
+                + cfg.prestart_max_workers
+                - len(self._workers)
+            )
+        # target: enough warm capacity for every inbound creation AND a
+        # full free pool after they pin — the creations' 1:1 backfills
+        # would spawn the same workers anyway, just later (trailing the
+        # churn instead of overlapping the leases' flight time)
+        n = min(max(0, want + self._num_workers - free), max(0, cap))
+        spawned = 0
+        for _ in range(n):
+            try:
+                self._spawn_worker(prestart=True)
+                spawned += 1
+            except Exception:  # noqa: BLE001 - fork pressure
+                logger.exception("prestart spawn failed")
+                break
+        return {"spawned": spawned}
+
     def _spawn_worker(
-        self, pip_env: Optional[Tuple] = None
+        self, pip_env: Optional[Tuple] = None, prestart: bool = False
     ) -> _WorkerHandle:
         worker_id = new_id()
+        t0 = time.monotonic()
+        if pip_env is None:
+            # fast path: fork from the warm zygote (ms) instead of a cold
+            # interpreter + import (seconds). Env-bound workers keep the
+            # cold path: their interpreter/sys.path differ by design.
+            zc = self._zygote_for_fork()
+            if zc is not None:
+                forked = zc.fork_worker(worker_id)
+                if forked is not None:
+                    handle = _WorkerHandle(worker_id, forked)
+                    handle.spawned_at = t0
+                    handle.spawn_path = "fork"
+                    return self._track_spawn(handle, prestart)
         env = dict(os.environ)
         env["RAY_TPU_HEAD_ADDRESS"] = self.head_address
         env["RAY_TPU_NODE_ID"] = self.node_id
@@ -426,19 +569,62 @@ class NodeAgent:
             env=env,
         )
         handle = _WorkerHandle(worker_id, proc)
+        handle.spawned_at = t0
         if pip_env is not None:
             handle.pip_key = pip_env[0]
-        with self._lock:
-            self._workers[worker_id] = handle
+        return self._track_spawn(handle, prestart)
+
+    def _track_spawn(
+        self, handle: _WorkerHandle, prestart: bool
+    ) -> _WorkerHandle:
+        self.pool_stats[
+            "forked" if handle.spawn_path == "fork" else "cold_spawned"
+        ] += 1
+        with self._idle_cv:
+            if prestart:
+                handle.prestart_pending = True
+                self._prestart_inflight += 1
+                WORKER_PRESTART_INFLIGHT.inc()
+            if handle.pip_key is None:
+                # pip-bound workers register into _pip_idle, never the
+                # plain pool — counting them here would let an env build
+                # storm suppress plain-worker backfill
+                handle.spawn_pending = True
+                self._spawns_pending += 1
+            self._workers[handle.worker_id] = handle
         return handle
 
+    def _prestart_done_locked(self, handle: _WorkerHandle) -> None:
+        """Clear spawn/prestart reservations exactly once (register or
+        death). Caller holds self._idle_cv."""
+        if handle.spawn_pending:
+            handle.spawn_pending = False
+            self._spawns_pending -= 1
+        if handle.prestart_pending:
+            handle.prestart_pending = False
+            self._prestart_inflight -= 1
+            WORKER_PRESTART_INFLIGHT.dec()
+
     def _h_register_worker(self, req: dict) -> dict:
+        # channel construction stays OUTSIDE the idle lock: a burst of
+        # registrations (prestart landing) must not serialize grpc
+        # channel setup under the lock every _pop_idle_worker needs
+        client = RpcClient(req["address"])
         with self._idle_cv:
             handle = self._workers.get(req["worker_id"])
             if handle is None:
+                client.close()
                 return {"ok": False}
-            handle.client = RpcClient(req["address"])
+            handle.client = client
             handle.ready.set()
+            handle.idle_since = time.monotonic()
+            self._prestart_done_locked(handle)
+            if handle.spawned_at:
+                WORKER_SPAWN_MS.observe(
+                    (time.monotonic() - handle.spawned_at) * 1000.0,
+                    labels={"path": handle.spawn_path},
+                )
+                handle.spawned_at = 0.0
             if handle.pip_key is not None:
                 handle.idle_since = time.monotonic()
                 self._pip_idle.setdefault(handle.pip_key, []).append(
@@ -452,6 +638,12 @@ class NodeAgent:
     def _pop_idle_worker(self, timeout: float = 60.0) -> Optional[_WorkerHandle]:
         deadline = time.monotonic() + timeout
         with self._idle_cv:
+            if self._idle:
+                self.pool_stats["hits"] += 1
+                WORKER_POOL_HITS.inc()
+                return self._workers[self._idle.pop()]
+            self.pool_stats["misses"] += 1
+            WORKER_POOL_MISSES.inc()
             while not self._idle:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._shutdown:
@@ -459,11 +651,22 @@ class NodeAgent:
                 self._idle_cv.wait(timeout=min(remaining, 0.5))
             return self._workers[self._idle.pop()]
 
+    @staticmethod
+    def _close_worker_client(handle: _WorkerHandle) -> None:
+        """Release a dead/reaped worker's channel (and its breaker-registry
+        hold — worker ports are ephemeral, so leaving these behind grows
+        process state with every churn cycle)."""
+        if handle.client is not None:
+            try:
+                handle.client.close()
+            except Exception:  # noqa: BLE001 - already torn down
+                pass
+
     def _return_worker(self, handle: _WorkerHandle) -> None:
         with self._idle_cv:
             if handle.actor_id is None and handle.worker_id in self._workers:
+                handle.idle_since = time.monotonic()
                 if handle.pip_key is not None:
-                    handle.idle_since = time.monotonic()
                     self._pip_idle.setdefault(handle.pip_key, []).append(
                         handle.worker_id
                     )
@@ -479,6 +682,8 @@ class NodeAgent:
             # sweep): the pop result marks the FIRST observer, which alone
             # releases once-only state like the pip env refcount
             first = self._workers.pop(handle.worker_id, None) is not None
+            if first:
+                self._prestart_done_locked(handle)
             if handle.worker_id in self._idle:
                 self._idle.remove(handle.worker_id)
             if handle.pip_key is not None:
@@ -499,6 +704,7 @@ class NodeAgent:
             handle.proc.kill()
         except OSError:
             pass
+        self._close_worker_client(handle)
         report: Dict[str, Any] = {"node_id": self.node_id}
         # the dead process's holder counts die with it
         report["holders_gone"] = [handle.worker_id]
@@ -972,6 +1178,9 @@ class NodeAgent:
         if spec.kind == "actor_creation":
             with self._lock:
                 handle.actor_id = spec.actor_id
+                if spec.runtime_env:
+                    # env persists for the actor's life: deny later reuse
+                    handle.env_tainted = True
                 self._actor_workers[spec.actor_id] = handle.worker_id
                 # kept for head-restart re-registration (_node_info):
                 # the head rebuilds ActorInfo/name bindings from this
@@ -981,9 +1190,11 @@ class NodeAgent:
             # starts dedicated worker processes per actor on demand,
             # worker_pool.cc StartWorkerProcess) — the previous total-count
             # cap starved the Nth actor creation once N-1 actors held all
-            # the workers
+            # the workers. Workers still warming (prestarted or a peer
+            # creation's backfill) count as free: the hole they will fill
+            # is already covered.
             with self._idle_cv:
-                free = len(self._idle)
+                free = len(self._idle) + self._spawns_pending
             if free < self._num_workers:
                 self._spawn_worker()
         self._run_on_worker(spec, handle, alloc)
@@ -1049,6 +1260,7 @@ class NodeAgent:
         if spec.kind == "actor_creation":
             with self._lock:
                 handle.actor_id = spec.actor_id
+                handle.env_tainted = True  # env-bound worker: never reuse
                 self._actor_workers[spec.actor_id] = handle.worker_id
                 self._actor_meta[spec.actor_id] = dict(spec.actor_meta or {})
         self._run_on_worker(spec, handle, alloc)
@@ -1084,8 +1296,10 @@ class NodeAgent:
                 self._idle_cv.wait(timeout=min(remaining, 0.5))
 
     def _pip_gc_loop(self) -> None:
-        """Reap env workers idle past the threshold and GC unreferenced
-        env dirs (the reference's runtime-env GC on idle)."""
+        """Reap env workers idle past the threshold, GC unreferenced env
+        dirs (the reference's runtime-env GC on idle), and trim the PLAIN
+        idle pool back to num_workers — prestart/backfill surplus from a
+        churn burst must not hold extra worker processes forever."""
         from ray_tpu.config import cfg
 
         while not self._shutdown:
@@ -1107,16 +1321,47 @@ class NodeAgent:
                         self._pip_idle[key] = keep
                     else:
                         self._pip_idle.pop(key, None)
+                # plain-pool trim: stalest first (pops take from the end,
+                # so the front of the list has been idle longest)
+                excess = len(self._idle) - self._num_workers
+                if excess > 0:
+                    for wid in list(self._idle):
+                        if excess <= 0:
+                            break
+                        h = self._workers.get(wid)
+                        if (
+                            h is not None
+                            and now - h.idle_since
+                            > cfg.runtime_env_idle_gc_s
+                        ):
+                            self._idle.remove(wid)
+                            self._workers.pop(wid, None)
+                            victims.append(h)
+                            excess -= 1
+            pip_victims = 0
             for h in victims:
-                with self._idle_cv:
-                    first = self._workers.pop(h.worker_id, None) is not None
-                if first:  # may race a concurrent death observation
-                    self._pip_mgr.release(h.pip_key)
+                if h.pip_key is not None:
+                    with self._idle_cv:
+                        first = (
+                            self._workers.pop(h.worker_id, None) is not None
+                        )
+                    if first:  # may race a concurrent death observation
+                        self._pip_mgr.release(h.pip_key)
+                    pip_victims += 1
                 try:
                     h.proc.terminate()
                 except OSError:
                     pass
+                self._close_worker_client(h)
             if victims:
+                # the reaped processes' borrow counts die with them
+                self._report_to_head(
+                    {
+                        "node_id": self.node_id,
+                        "holders_gone": [h.worker_id for h in victims],
+                    }
+                )
+            if pip_victims:
                 self._pip_mgr.gc()
 
     def _push_req(self, spec: LeaseRequest, accel_env=None) -> dict:
@@ -1607,6 +1852,11 @@ class NodeAgent:
                     self._report_queue.insert(0, report)
                 time.sleep(0.5)
 
+    # a spawned worker gets this long to come up and register before its
+    # reservation is reclaimed and the process killed (cold spawns pay a
+    # full interpreter + import; generous beats flapping)
+    SPAWN_REGISTER_TIMEOUT_S = 120.0
+
     # an orphaned agent (its head gone for good, e.g. a crashed test
     # driver) must not linger holding ports/arena/spill space forever; a
     # restarting head recovers in seconds, so a long grace is safe
@@ -1623,12 +1873,24 @@ class NodeAgent:
             time.sleep(REPORT_PERIOD_S)
             version += 1
             # respawn workers that died outside a push (including ones that
-            # crashed at startup before ever registering)
+            # crashed at startup before ever registering). A spawn that
+            # never registers within the timeout counts as dead too — a
+            # wedged startup (e.g. accelerator transport hang) would
+            # otherwise hold its _spawns_pending reservation forever and
+            # suppress backfill/prestart for the rest of the agent's life.
+            if self._zygote is not None:
+                self._zygote.drain_exits()
             with self._lock:
+                now = time.monotonic()
                 dead = [
                     h
                     for h in self._workers.values()
                     if h.proc.poll() is not None
+                    or (
+                        h.spawn_pending
+                        and h.spawned_at
+                        and now - h.spawned_at > self.SPAWN_REGISTER_TIMEOUT_S
+                    )
                 ]
             for h in dead:
                 self._on_worker_death(h, [])
@@ -1828,22 +2090,67 @@ class NodeAgent:
         return handle.client.call(method, req, timeout=60.0)
 
     def _h_kill_actor(self, req: dict) -> None:
+        aid = req["actor_id"]
         with self._lock:
-            worker_id = self._actor_workers.get(req["actor_id"])
-            handle = self._workers.pop(worker_id, None) if worker_id else None
-            self._drop_actor_state(req["actor_id"])
-        if handle is not None:
+            worker_id = self._actor_workers.get(aid)
+            handle = self._workers.get(worker_id) if worker_id else None
+            self._drop_actor_state(aid)
+            # clean actor exit → scrub + reuse the worker instead of a
+            # kill/respawn cycle (worker_pool.cc idle-worker reuse).
+            # Denied across runtime envs: pip/conda workers run a
+            # different interpreter/sys.path, and a persisted plain env
+            # marked the process (env_tainted) — both die instead.
+            reusable = (
+                handle is not None
+                and cfg.actor_worker_reuse
+                and not self._shutdown
+                and handle.pip_key is None
+                and not handle.env_tainted
+                and handle.client is not None
+                and handle.proc.poll() is None
+            )
+            if handle is not None and not reusable:
+                self._workers.pop(worker_id, None)
+        if handle is None:
+            return
+        if reusable:
             try:
-                handle.proc.kill()
-            except OSError:
-                pass
-            if not self._shutdown:
-                self._spawn_worker()
+                reply = handle.client.call(
+                    "ScrubActor", {"actor_id": aid}, timeout=30.0
+                )
+            except RpcError:
+                reply = None
+            if reply is not None and reply.get("ok"):
+                with self._idle_cv:
+                    handle.actor_id = None
+                    self.pool_stats["reused"] += 1
+                self._return_worker(handle)
+                return
+            if reply is not None:
+                logger.info(
+                    "worker %s not reusable (%s); re-forking",
+                    handle.worker_id[:8],
+                    reply.get("reason", "scrub failed"),
+                )
+            with self._lock:
+                # may race a concurrent death observation — pop decides
+                if self._workers.pop(handle.worker_id, None) is None:
+                    return
+        try:
+            handle.proc.kill()
+        except OSError:
+            pass
+        self._close_worker_client(handle)
+        if not self._shutdown:
+            self._spawn_worker()
 
     def _h_debug_state(self, req=None) -> dict:
         """Operator/debugging introspection (node_manager DebugString
         analog, node_manager.cc HandleGetNodeStats)."""
         with self._lock:
+            hits = self.pool_stats["hits"]
+            misses = self.pool_stats["misses"]
+            total = hits + misses
             return {
                 "task_buf": [s.task_id for s, _ in self._task_buf],
                 "dep_waiting": {
@@ -1852,6 +2159,24 @@ class NodeAgent:
                 "async_pending": sorted(self._async_pending),
                 "idle_workers": list(self._idle),
                 "num_workers": len(self._workers),
+                # warm-pool effectiveness, alongside idle_workers: hit
+                # rate of the idle pool plus spawn/reuse/prestart counts
+                "pool": {
+                    **self.pool_stats,
+                    "hit_rate": round(hits / total, 4) if total else None,
+                    "prestart_inflight": self._prestart_inflight,
+                    "zygote_alive": bool(
+                        self._zygote is not None and not self._zygote.broken
+                    ),
+                    # process-wide spawn latency (shared across co-located
+                    # agents in tests; authoritative on a real node)
+                    "spawn_ms_fork": WORKER_SPAWN_MS.summary(
+                        {"path": "fork"}
+                    ),
+                    "spawn_ms_spawn": WORKER_SPAWN_MS.summary(
+                        {"path": "spawn"}
+                    ),
+                },
                 "available": self.ledger.avail_map(),
                 "store": self.store.stats(),
                 "oom_kills": self.metrics_oom_kills,
@@ -1877,6 +2202,8 @@ class NodeAgent:
                 handle.proc.terminate()
             except OSError:
                 pass
+        if self._zygote is not None:
+            self._zygote.close()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
         try:
             self.store.close(unlink=True)

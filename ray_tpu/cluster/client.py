@@ -397,7 +397,17 @@ class _PipelinedSender:
         )
         self._thread.start()
 
-    def enqueue(self, kind: str, payload: Any, wait: bool = False) -> None:
+    def enqueue(
+        self,
+        kind: str,
+        payload: Any,
+        wait: bool = False,
+        wait_timeout: Optional[float] = None,
+    ) -> None:
+        """Queue one control item. ``wait=True`` blocks until the head has
+        processed it; ``wait_timeout`` bounds that wait — on expiry an
+        RpcError raises (the item STAYS queued and delivers when the head
+        returns; only this caller's synchronous view gives up)."""
         with self._cv:
             if self._stop:
                 return
@@ -406,8 +416,21 @@ class _PipelinedSender:
             ticket = self._enqueued
             self._cv.notify_all()
         if wait:
+            deadline = (
+                None
+                if wait_timeout is None
+                else time.monotonic() + wait_timeout
+            )
             with self._cv:
                 while self._acked < ticket and not self._stop:
+                    if (
+                        deadline is not None
+                        and time.monotonic() >= deadline
+                    ):
+                        raise RpcError(
+                            f"head unreachable: {kind} not acknowledged "
+                            f"within {wait_timeout}s (still queued)"
+                        )
                     self._cv.wait(timeout=0.5)
 
     def _loop(self) -> None:
@@ -455,15 +478,20 @@ class _PipelinedSender:
 
                     if sys.is_finalizing():
                         return  # interpreter exit: nobody to deliver for
-                    with self._cv:
-                        if self._stop:
-                            return
                     if attempts <= 2 or attempts % 60 == 0:
                         log.warning(
                             "head unreachable; retrying %d control items",
                             len(batch),
                         )
-                    time.sleep(0.5)
+                    # event-driven pause (the long-poll pattern the rest
+                    # of the client uses, e.g. wait_many): park on the
+                    # queue's condition variable so a stop() — or new
+                    # work signalling the head may be back — wakes the
+                    # retry immediately instead of sleeping blind.
+                    with self._cv:
+                        if self._stop:
+                            return
+                        self._cv.wait(timeout=0.5)
             with self._cv:
                 self._acked += len(batch)
                 self._cv.notify_all()
@@ -1171,18 +1199,27 @@ class RemoteRuntime:
             arg_ids=sorted(arg_ids),
             client_id=self.client_id,
         )
-        self.head.call(
-            "CreateActor",
-            {
-                "spec": lease,
-                "name": name,
-                "class_name": cls.__name__,
-                "max_restarts": max_restarts,
-                "max_concurrency": max_concurrency,
-                "concurrency_groups": dict(concurrency_groups or {}),
-                "lifetime": lifetime,
-            },
-        )
+        req = {
+            "spec": lease,
+            "name": name,
+            "class_name": cls.__name__,
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
+            "lifetime": lifetime,
+        }
+        if name is None:
+            # control-plane fast path: unnamed creations ride the ordered
+            # client pipeline (one ClientBatch can carry many creations),
+            # so a churn loop never serializes on per-creation replies
+            # from a loaded head. The actor id is client-minted, so the
+            # handle is valid immediately; WaitActor tolerates the
+            # message still being in flight.
+            self._sender.enqueue("create_actor", req)
+        else:
+            # named creation stays synchronous: the name-taken error must
+            # surface to this caller, not vanish into the pipeline
+            self.head.call("CreateActor", req)
         return RemoteActorHandle(self, actor_id, cls)
 
     def get_actor(self, name: str) -> RemoteActorHandle:
@@ -1190,8 +1227,16 @@ class RemoteRuntime:
         return RemoteActorHandle(self, info.actor_id, object)
 
     def kill_actor(self, handle: RemoteActorHandle, no_restart: bool = True) -> None:
-        self.head.call(
-            "KillActor", {"actor_id": handle._actor_id, "no_restart": no_restart}
+        # rides the same ordered pipeline as creations so a create→kill
+        # pair can never arrive reversed; wait=True keeps the
+        # "processed by the head when this returns" semantics, and the
+        # bounded wait keeps the pre-pipeline contract that a kill
+        # against an unreachable head RAISES instead of hanging forever
+        self._sender.enqueue(
+            "kill_actor",
+            {"actor_id": handle._actor_id, "no_restart": no_restart},
+            wait=True,
+            wait_timeout=30.0,
         )
 
     def actor_location(self, actor_id: str):
@@ -1212,11 +1257,20 @@ class RemoteRuntime:
         deadline = time.monotonic() + timeout
         while True:
             window = min(5.0, max(0.1, deadline - time.monotonic()))
-            info = self._read(
-                "WaitActor",
-                {"actor_id": handle._actor_id, "timeout": window},
-                timeout=window + 15.0,
-            )
+            try:
+                info = self._read(
+                    "WaitActor",
+                    {"actor_id": handle._actor_id, "timeout": window},
+                    timeout=window + 15.0,
+                )
+            except ValueError:
+                # creations ride the pipelined client batch: this poll can
+                # legitimately beat the creation message to the head (or
+                # span a head restart that hasn't replayed it yet) — keep
+                # waiting out OUR deadline before declaring it unknown
+                if time.monotonic() >= deadline:
+                    raise
+                continue
             if info.state == "ALIVE":
                 return info
             if info.state == "DEAD":

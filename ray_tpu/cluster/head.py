@@ -1634,13 +1634,26 @@ class HeadServer:
         return {"queued": True}
 
     def _h_client_batch(self, items: List[tuple]) -> None:
-        """Pipelined client control stream: ordered lease submissions +
-        refcount updates coalesced into one RPC (see client._PipelinedSender)."""
+        """Pipelined client control stream: ordered lease submissions,
+        refcount updates, and actor create/kill coalesced into one RPC
+        (see client._PipelinedSender). Actor churn riding the pipeline is
+        the control-plane fast path: the driver never blocks a creation
+        behind a loaded head's reply, and create→kill order is preserved
+        by the single queue."""
         for kind, payload in items:
             if kind == "lease":
                 self._h_submit_lease(payload)
             elif kind == "ref":
                 self._h_ref_update(payload)
+            elif kind == "create_actor":
+                # swallowed, not re-raised: the sender retries a failed
+                # ClientBatch forever, so one poison creation must not
+                # wedge every lease queued behind it (unnamed creations
+                # have no name-taken failure mode; anything else here is
+                # a bug surfaced via head_dropped_callbacks)
+                _best_effort(self._h_create_actor, payload)
+            elif kind == "kill_actor":
+                _best_effort(self._h_kill_actor, payload)
 
     @property
     def device_state(self):
@@ -2022,8 +2035,25 @@ class HeadServer:
                     self._pending.extend(specs)
                     self._cond.notify_all()
                 continue
+            self._prestart_hint(client, specs)
             self._dispatch_pool.submit(
                 self._dispatch_batch_blocking, specs, node_id, client
+            )
+
+    def _prestart_hint(
+        self, client: RpcClient, specs: List[LeaseRequest]
+    ) -> None:
+        """Actor creations pin workers for life: tell the target agent how
+        many are inbound so replacement capacity warms WHILE the leases
+        are in flight instead of after each one pins its worker
+        (worker_pool.cc PrestartWorkers semantics)."""
+        n = sum(1 for s in specs if s.kind == "actor_creation")
+        if n:
+            self._dispatch_pool.submit(
+                _best_effort,
+                client.call,
+                "PrestartWorkers",
+                {"count": n},
             )
 
     def _pick_labeled_node(self, strat, resources) -> Optional[str]:
@@ -2163,6 +2193,10 @@ class HeadServer:
                 self._actor_sending.add(spec.actor_id)
             self._dispatch_pool.submit(self._drain_actor_sends, spec.actor_id)
             return
+        if spec.kind == "actor_creation":
+            # constrained routes (PG / affinity / labels) bypass
+            # _send_grants; they still warrant a warm-pool hint
+            self._prestart_hint(client, [spec])
         self._dispatch_pool.submit(self._dispatch_blocking, spec, node_id, client)
 
     def _drain_actor_sends(self, actor_id: str) -> None:
@@ -2251,6 +2285,12 @@ class HeadServer:
     # ------------------------------------------------------------------
     def _h_create_actor(self, req: dict) -> dict:
         spec: LeaseRequest = req["spec"]
+        with self._cond:
+            if spec.actor_id in self._actors:
+                # at-least-once redelivery (a pipelined ClientBatch whose
+                # reply was lost re-sends): creating twice would run ctor
+                # side effects twice and leak a pinned worker
+                return {"actor_id": spec.actor_id}
         name = req.get("name")
         info = ActorInfo(
             actor_id=spec.actor_id,
@@ -2407,18 +2447,22 @@ class HeadServer:
     def _h_wait_actor(self, req: dict) -> ActorInfo:
         """Long-poll an actor's state: blocks server-side until it leaves
         PENDING/RESTARTING or the window closes (publisher.h actor-state
-        channel analog; replaces 20 Hz GetActor polling from clients)."""
+        channel analog; replaces 20 Hz GetActor polling from clients).
+        An actor UNKNOWN at poll start is waited for within the window
+        too: creations ride the pipelined client batch, so a fast caller
+        (first method's direct-channel resolve) can legitimately long-poll
+        before its creation message lands."""
         actor_id = req["actor_id"]
         deadline = time.monotonic() + min(float(req.get("timeout") or 2.0), 10.0)
         with self._cond:
             while True:
                 info = self._actors.get(actor_id)
-                if info is None:
-                    raise ValueError(f"unknown actor {actor_id}")
-                if info.state in ("ALIVE", "DEAD"):
+                if info is not None and info.state in ("ALIVE", "DEAD"):
                     return info
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    if info is None:
+                        raise ValueError(f"unknown actor {actor_id}")
                     return info
                 self._cond.wait(remaining)
 
@@ -2477,10 +2521,27 @@ class HeadServer:
             node_id = info.node_id
             client = self._clients.get(node_id) if node_id else None
         if client is not None:
-            try:
-                client.call("KillActor", {"actor_id": info.actor_id})
-            except RpcError:
-                pass
+            if no_restart:
+                # permanent kill (the churn path, and what the pipelined
+                # client batch carries): the actor id can never rebind to
+                # a new worker, so the agent-side teardown can run off
+                # this thread — a batched kill must not head-of-line
+                # block the lease stream behind an agent round trip
+                self._dispatch_pool.submit(
+                    _best_effort,
+                    client.call,
+                    "KillActor",
+                    {"actor_id": info.actor_id},
+                )
+            else:
+                # restartable kill: the teardown must land BEFORE the
+                # restart's creation lease can rebind this actor id on
+                # the same agent, or a late KillActor would tear down
+                # the replacement worker
+                try:
+                    client.call("KillActor", {"actor_id": info.actor_id})
+                except RpcError:
+                    pass
         self._restart_or_kill_actor(info, "killed by user")
 
     # ------------------------------------------------------------------
@@ -2540,15 +2601,19 @@ class HeadServer:
         if not success:
             return False
         chosen = [self.view.node_id(int(r)) for r in rows]
-        # 2PC: prepare on every involved agent, commit if all granted
-        # (PrepareBundleResources/CommitBundleResources,
-        # gcs_placement_group_scheduler.cc:192,219).
+        # Pipelined 2PC (PrepareBundleResources/CommitBundleResources,
+        # gcs_placement_group_scheduler.cc:192,219): prepares go out to
+        # every involved agent CONCURRENTLY and the PG turns ready as soon
+        # as the full quorum of prepare acks is in; commits are fired
+        # asynchronously after that (agents admit leases against prepared
+        # entries, so the commit flip is bookkeeping, not a gate). The old
+        # serial prepare→serial commit chain cost one RPC round trip per
+        # node per phase on the scheduler thread.
         by_node: Dict[str, List[int]] = {}
         for i, nid in enumerate(chosen):
             by_node.setdefault(nid, []).append(i)
-        prepared: List[Tuple[str, List[int]]] = []
-        ok = True
-        for nid, idxs in by_node.items():
+
+        def prepare(nid: str, idxs: List[int]) -> bool:
             client = self._clients.get(nid)
             try:
                 reply = client.call(
@@ -2558,27 +2623,44 @@ class HeadServer:
                         "bundles": {i: state.bundles[i] for i in idxs},
                     },
                 )
-                if not reply.get("ok"):
-                    ok = False
-                    break
-                prepared.append((nid, idxs))
+                return bool(reply.get("ok"))
             except (RpcError, AttributeError):
-                ok = False
-                break
-        if not ok:
-            for nid, _ in prepared:
-                try:
-                    self._clients[nid].call(
-                        "RollbackBundles", {"pg_id": state.pg_id}
+                return False
+
+        items = list(by_node.items())
+        if len(items) == 1:
+            acks = [prepare(*items[0])]
+        else:
+            futs = [
+                self._dispatch_pool.submit(prepare, nid, idxs)
+                for nid, idxs in items
+            ]
+            acks = [f.result() for f in futs]
+        prepared = [nid for (nid, _), ack in zip(items, acks) if ack]
+        if not all(acks):
+            # rollback stays SYNCHRONOUS: a retry of this PG can start the
+            # moment we return False, and a stale async rollback landing
+            # after the retry's successful prepare would destroy the new
+            # prepared entry on the agent (failure-path latency is free;
+            # only the happy path needed pipelining)
+            for nid in prepared:
+                client = self._clients.get(nid)
+                if client is not None:
+                    _best_effort(
+                        client.call,
+                        "RollbackBundles",
+                        {"pg_id": state.pg_id},
                     )
-                except RpcError:
-                    pass
             return False
-        for nid, _ in prepared:
-            try:
-                self._clients[nid].call("CommitBundles", {"pg_id": state.pg_id})
-            except RpcError:
-                pass
+        for nid in prepared:
+            client = self._clients.get(nid)
+            if client is not None:
+                self._dispatch_pool.submit(
+                    _best_effort,
+                    client.call,
+                    "CommitBundles",
+                    {"pg_id": state.pg_id},
+                )
         with self._lock:
             for i, nid in enumerate(chosen):
                 self.view.subtract(self.view.row_of(nid), bundles[i])

@@ -225,6 +225,11 @@ class CircuitBreaker:
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}
+# clients per address: breakers for ephemeral peers (worker processes get
+# a fresh port per spawn — thousands over an agent's life under actor
+# churn) are evicted when their last client closes, instead of growing
+# the registry forever
+_BREAKER_REFS: Dict[str, int] = {}
 _BREAKERS_LOCK = threading.Lock()
 
 
@@ -233,22 +238,41 @@ def get_breaker(address: str) -> CircuitBreaker:
         br = _BREAKERS.get(address)
         if br is None:
             br = _BREAKERS[address] = CircuitBreaker(address)
+        _BREAKER_REFS[address] = _BREAKER_REFS.get(address, 0) + 1
         return br
 
 
-def reset_breakers() -> None:
-    """Drop all breaker state (tests / chaos teardown). Clears IN PLACE:
-    live clients hold direct references to their breakers (reset those to
-    closed too), and stale imports of _BREAKERS must keep seeing the
-    shared registry object."""
+def release_breaker(address: str) -> None:
+    """Drop one client's hold on ``address``'s breaker; the registry entry
+    is evicted with the last hold (its trip counters have already been
+    exported through the BREAKER_* metrics)."""
     with _BREAKERS_LOCK:
-        for br in _BREAKERS.values():
+        n = _BREAKER_REFS.get(address, 0) - 1
+        if n <= 0:
+            _BREAKER_REFS.pop(address, None)
+            _BREAKERS.pop(address, None)
+        else:
+            _BREAKER_REFS[address] = n
+
+
+def reset_breakers() -> None:
+    """Reset all breaker STATE (tests / chaos teardown) in place: live
+    clients hold direct references to their breakers, and stale imports
+    of _BREAKERS must keep seeing the shared registry object. Entries
+    still referenced by open clients stay registered (with their
+    refcounts) — dropping them would split per-peer breaker state the
+    moment a new client re-registered the address; only ref-less
+    entries are evicted."""
+    with _BREAKERS_LOCK:
+        for addr, br in list(_BREAKERS.items()):
             with br._lock:
                 br.state = br.CLOSED
                 br._first_failure = None
                 br._fail_count = 0
                 br._probe_in_flight = False
-        _BREAKERS.clear()
+            if _BREAKER_REFS.get(addr, 0) <= 0:
+                _BREAKERS.pop(addr, None)
+                _BREAKER_REFS.pop(addr, None)
 _OPTIONS = [
     ("grpc.max_send_message_length", _MAX_MSG),
     ("grpc.max_receive_message_length", _MAX_MSG),
@@ -456,6 +480,7 @@ class RpcClient:
         self.address = address
         self._channel = grpc.insecure_channel(address, options=_OPTIONS)
         self._methods: Dict[str, Any] = {}
+        self._closed = False
         self._breaker = get_breaker(address)
         if on_unreachable is not None:
             self._breaker.add_callback(self, on_unreachable)
@@ -580,5 +605,9 @@ class RpcClient:
             time.sleep(backoff)
 
     def close(self) -> None:
+        if self._closed:  # idempotent: the breaker hold releases once
+            return
+        self._closed = True
         self._breaker.remove_callback(self)
         self._channel.close()
+        release_breaker(self.address)

@@ -191,6 +191,7 @@ class Worker:
                 "PushTask": self._h_push_task,
                 "PushTaskBatch": self._h_push_task_batch,
                 "KillActor": self._h_kill_actor,
+                "ScrubActor": self._h_scrub_actor,
                 "DagInstall": self._h_dag_install,
                 "DagTeardown": self._h_dag_teardown,
                 "DirectPushBatch": self._h_direct_push_batch,
@@ -199,6 +200,19 @@ class Worker:
             port=0,
             max_workers=8,
         )
+        # pristine-state baseline for actor-worker reuse (ScrubActor):
+        # everything user code adds past this point is what a scrub must
+        # be able to undo — or the scrub is refused and the worker dies
+        self._baseline_modules = frozenset(sys.modules)
+        self._baseline_env = dict(os.environ)
+        self._baseline_sys_path = list(sys.path)
+        # strong refs to the Thread OBJECTS (idents recycle after a
+        # thread exits; an object identity can't while we hold it)
+        self._baseline_threads = frozenset(threading.enumerate())
+        try:
+            self._baseline_cwd = os.getcwd()
+        except OSError:
+            self._baseline_cwd = None
         self.agent.call(
             "RegisterWorker",
             {"worker_id": worker_id, "address": self._server.address},
@@ -1379,6 +1393,126 @@ class Worker:
             except RuntimeError:
                 pass
 
+    # C-extension packages whose re-import after a sys.modules purge is
+    # undefined (numpy refuses outright); an actor that pulled one in past
+    # the baseline makes this process unscrubbabe — refuse, and the agent
+    # re-forks a pristine worker instead (ms-scale via the zygote).
+    SCRUB_RISKY_ROOTS = frozenset(
+        {"jax", "jaxlib", "numpy", "scipy", "pandas", "torch",
+         "tensorflow", "grpc", "pyarrow"}
+    )
+
+    # threads the framework itself starts lazily after registration —
+    # these exit on their own or serve the next actor; anything else
+    # alive past the baseline refuses the scrub
+    SCRUB_THREAD_OK = (
+        "direct-",          # per-actor FIFO executors (self-exiting)
+        "task-done",        # done-pool workers
+        "task-batch",       # batch-pool workers
+        "ThreadPoolExecutor",  # grpc server / stdlib pool workers
+        "asyncio_",         # asyncio default-executor workers
+    )
+    # NOTE: "actor-loop-" is intentionally absent — those threads are
+    # JOINED during the scrub, so a survivor (loop that refused to drain)
+    # lands in the stray list and refuses the reuse.
+
+    def _h_scrub_actor(self, req: dict) -> dict:
+        """Reset this worker to its registration-time state after its
+        actor exited cleanly, so the agent can return it to the idle pool
+        (worker_pool.cc idle-worker reuse; the reference only reuses TASK
+        workers — the scrub contract is what makes actor reuse sound
+        here). Refuses (ok=False) whenever pristine state cannot be
+        restored; the caller then kills + re-forks instead."""
+        aid = req["actor_id"]
+        self._h_kill_actor({"actor_id": aid})
+        reasons = []
+        if self._dag_programs:
+            reasons.append("compiled-DAG programs still installed")
+        if self._actors:
+            reasons.append("other actors resident")
+        # thread hygiene: the killed actor's event loop drains async
+        # (KillActor cancels + stops it via call_soon_threadsafe) — wait
+        # for those loop threads to actually exit, then refuse if any
+        # OTHER non-framework thread born after registration survives:
+        # a user daemon thread is live actor state no scrub can undo.
+        for t in threading.enumerate():
+            if (
+                t not in self._baseline_threads
+                and t.name.startswith("actor-loop-")
+            ):
+                t.join(timeout=5.0)
+        stray = sorted(
+            t.name
+            for t in threading.enumerate()
+            if t.is_alive()
+            and t is not threading.current_thread()
+            and t not in self._baseline_threads
+            and not t.name.startswith(self.SCRUB_THREAD_OK)
+        )
+        if stray:
+            reasons.append(f"non-framework threads alive: {','.join(stray[:3])}")
+        # module-state reset, scoped to WHOLLY NEW package roots (user
+        # code shipped/imported by the actor): those are dropped so the
+        # next actor re-imports a fresh copy and mutated module globals
+        # cannot leak across reuses. Lazily-loaded SUBmodules of packages
+        # already present at registration (grpc/cloudpickle/asyncio
+        # internals the framework touches on demand) and stdlib roots are
+        # kept — purging them would break the live framework, and actor
+        # code does not own their state.
+        stdlib = getattr(sys, "stdlib_module_names", ())
+        baseline_roots = {m.split(".", 1)[0] for m in self._baseline_modules}
+        new_mods = [
+            m for m in list(sys.modules) if m not in self._baseline_modules
+        ]
+        fresh_roots = (
+            {m.split(".", 1)[0] for m in new_mods}
+            - baseline_roots
+            - set(stdlib)
+        )
+        risky = sorted(fresh_roots & self.SCRUB_RISKY_ROOTS)
+        if risky:
+            reasons.append(f"unreloadable modules imported: {','.join(risky)}")
+        if reasons:
+            return {"ok": False, "reason": "; ".join(reasons)}
+        purge = [m for m in new_mods if m.split(".", 1)[0] in fresh_roots]
+        for m in purge:
+            sys.modules.pop(m, None)
+        if purge:
+            importlib.invalidate_caches()
+        # sys.path restore: user code that inserted its own entries
+        # (working-dir style) must not leak import resolution into the
+        # next actor
+        if sys.path != self._baseline_sys_path:
+            sys.path[:] = self._baseline_sys_path
+            importlib.invalidate_caches()
+        # env + cwd restore (covers persisted actor accel env and any
+        # os.environ writes by user code)
+        for k in list(os.environ):
+            if k not in self._baseline_env:
+                del os.environ[k]
+        for k, v in self._baseline_env.items():
+            if os.environ.get(k) != v:
+                os.environ[k] = v
+        if self._baseline_cwd is not None:
+            try:
+                if os.getcwd() != self._baseline_cwd:
+                    os.chdir(self._baseline_cwd)
+            except OSError:
+                return {"ok": False, "reason": "cwd unrestorable"}
+        self._fn_cache.clear()
+        self._fn_cache_order.clear()
+        self._dag_actor_locks.pop(aid, None)
+        with self._direct_fifo_cv:
+            self._direct_fifo.pop(aid, None)
+            self._direct_fifo_cv.notify_all()
+        with self._env_cv:
+            # a persisted actor runtime_env's discarded undo left the gate
+            # signature dangling; reuse starts clean
+            if self._env_active == 0:
+                self._env_sig = None
+                self._env_undo = lambda: None
+        return {"ok": True}
+
     def serve_forever(self) -> None:
         while True:
             time.sleep(1.0)
@@ -1386,22 +1520,16 @@ class Worker:
                 os._exit(0)
 
 
-def main() -> None:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--agent", required=True)
-    parser.add_argument("--worker-id", required=True)
-    parser.add_argument("--store", default="")
-    args = parser.parse_args()
+def run_worker(agent_address: str, worker_id: str, store_path: str) -> None:
+    """Process entry shared by the cold spawn path (``main``) and the
+    zygote fork path (``zygote._child_main``): platform pin, diagnostics
+    hooks, then the Worker loop. Never returns."""
     # An inherited JAX_PLATFORMS env var must be enforced via jax.config:
     # accelerator plugin hooks (e.g. the axon TPU tunnel) can initialize
     # their backend during ANY jax call regardless of the env var, and a
     # wedged transport then hangs the worker's first user jax call forever.
     # config.update pins the platform set before any backend comes up.
-    pip_dir = os.environ.get("RAY_TPU_PIP_ENV_DIR")
-    if pip_dir:
-        # pip runtime env: the agent built this --target dir for the env
-        # this worker serves; it shadows base site-packages (pip_env.py)
-        sys.path.insert(0, pip_dir)
+    # (Idempotent for forked workers: the zygote already pinned it.)
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         try:
@@ -1416,7 +1544,7 @@ def main() -> None:
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
-    worker = Worker(args.agent, args.worker_id, args.store)
+    worker = Worker(agent_address, worker_id, store_path)
     prof_dir = os.environ.get("RAY_TPU_PROFILE_WORKER")
     if prof_dir:
         # perf diagnosis: dump per-worker cProfile stats on SIGUSR2
@@ -1428,11 +1556,27 @@ def main() -> None:
 
         def _dump(_sig_no, _frm):
             _pr.dump_stats(
-                os.path.join(prof_dir, f"worker-{args.worker_id}.prof")
+                os.path.join(prof_dir, f"worker-{worker_id}.prof")
             )
 
         _sig.signal(_sig.SIGUSR2, _dump)
     worker.serve_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--agent", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--store", default="")
+    args = parser.parse_args()
+    pip_dir = os.environ.get("RAY_TPU_PIP_ENV_DIR")
+    if pip_dir:
+        # pip runtime env: the agent built this --target dir for the env
+        # this worker serves; it shadows base site-packages (pip_env.py).
+        # Cold-spawn only — env workers never fork from the zygote (its
+        # sys.path/modules are already bound to base site-packages).
+        sys.path.insert(0, pip_dir)
+    run_worker(args.agent, args.worker_id, args.store)
 
 
 if __name__ == "__main__":

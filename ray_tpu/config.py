@@ -296,6 +296,42 @@ define(
 )
 
 # ---------------------------------------------------------------------------
+# worker lifecycle (fork-server + warm pool)
+# ---------------------------------------------------------------------------
+define(
+    "fork_server",
+    True,
+    "Fork new workers from a per-agent zygote process that imported "
+    "ray_tpu (and jax, when JAX_PLATFORMS is set) once, instead of a "
+    "cold interpreter spawn per worker (reference worker_pool.cc "
+    "prestart + Python fork-server semantics). Falls back to cold "
+    "spawn automatically when fork is unavailable, the zygote dies, or "
+    "a pip/conda runtime env demands its own interpreter.",
+)
+define(
+    "zygote_ready_timeout_s",
+    30.0,
+    "How long a fork request waits for the zygote's one-time import "
+    "warmup before falling back to cold spawn for good.",
+)
+define(
+    "prestart_max_workers",
+    16,
+    "Cap on extra workers an agent prestarts above num_workers in "
+    "response to head PrestartWorkers hints (worker_pool.cc "
+    "PrestartWorkers analog).",
+)
+define(
+    "actor_worker_reuse",
+    True,
+    "Return a worker whose actor exited cleanly to the idle pool after "
+    "a scrub (module/env/cwd reset) instead of killing it. Reuse is "
+    "denied across pip/conda or persisted runtime envs, and when the "
+    "scrub cannot restore pristine state (heavyweight modules imported "
+    "by actor code) — those workers are killed and re-forked.",
+)
+
+# ---------------------------------------------------------------------------
 # direct actor calls
 # ---------------------------------------------------------------------------
 define(

@@ -456,6 +456,9 @@ class ActorHandle:
         self._runtime = runtime
         self._actor_id = actor_id
         self._cls = cls
+        # per-name ActorMethod memo: a.f.remote() in a hot loop resolves
+        # the class attribute + options once instead of per call
+        self._methods: Dict[str, ActorMethod] = {}
 
     @property
     def _actor_state(self) -> ActorState:
@@ -466,13 +469,23 @@ class ActorHandle:
         # attribute errors so pickling/copy protocols don't get hijacked
         if name.startswith("__") and name != "__call__":
             raise AttributeError(name)
+        # __dict__ access (not attribute access): an instance materialized
+        # without __init__ (copy/unpickle protocols) must not recurse here
+        methods = self.__dict__.get("_methods")
+        if methods is not None:
+            cached = methods.get(name)
+            if cached is not None:
+                return cached
         fn = getattr(self._cls, name, None)
         if fn is None or not callable(fn):
             raise AttributeError(
                 f"actor class {self._cls.__name__} has no method {name!r}"
             )
         opts = getattr(fn, "_ray_tpu_method_options", {})
-        return ActorMethod(self, name, opts.get("num_returns", 1))
+        m = ActorMethod(self, name, opts.get("num_returns", 1))
+        if methods is not None:
+            methods[name] = m
+        return m
 
     def _invoke(self, method_name, args, kwargs, num_returns):
         if num_returns == "streaming":

@@ -47,6 +47,11 @@ class PlacementGroupState:
         runtime.store.create(self.ready_ref)
         self._lock = threading.Lock()
         self.removed = False
+        # dense demand matrix memo: a pending PG retries try_schedule on
+        # every view change — restacking the (immutable) bundle demands
+        # per attempt was pure overhead (keyed by view width, which can
+        # grow when new resource names appear)
+        self._dense: Optional[tuple] = None  # (width, np.ndarray)
 
     # -- scheduling (called from the scheduler thread) ------------------
     def try_schedule(self) -> bool:
@@ -56,7 +61,12 @@ class PlacementGroupState:
         if rt.view.num_nodes == 0:
             return False
         width = totals.shape[1]
-        mat = np.stack([b.request.dense(width) for b in self.bundles])
+        if self._dense is None or self._dense[0] != width:
+            self._dense = (
+                width,
+                np.stack([b.request.dense(width) for b in self.bundles]),
+            )
+        mat = self._dense[1]
         nodes_idx, success, _ = schedule_bundles(
             totals, avail, alive, mat, strategy=self.strategy
         )

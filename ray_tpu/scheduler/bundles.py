@@ -186,15 +186,36 @@ def schedule_bundles(
     bundles: np.ndarray,
     strategy: str = "PACK",
 ):
-    """Host entry point: sort, dispatch to the strategy kernel, unsort.
+    """Host entry point: sort, pad, dispatch to the strategy kernel, unsort.
 
     Returns (node_per_bundle int32[B] in *original* bundle order, success,
     avail_out). Mirrors ClusterResourceScheduler::Schedule
     (cluster_resource_scheduler.cc:397) + SortSchedulingResult.
+
+    Compile caching: the bundle axis is padded to the next power of two
+    with zero-demand rows, so PG churn across varying bundle counts hits
+    a handful of cached XLA executables instead of re-tracing each
+    distinct B (a ~100ms trace per new shape — the dominant cost of a
+    create/remove pair before jit warms). Pads sort last (zero demand),
+    place for free on any alive node, and consume nothing; success is
+    computed over the real rows only, so a STRICT_SPREAD short on nodes
+    for its PADS (but not its real bundles) still succeeds.
     """
     bundles = np.asarray(bundles, dtype=np.float32)
+    b = bundles.shape[0]
+    if b == 0:
+        return np.zeros(0, dtype=np.int32), True, avail
     order = sort_bundles(bundles)
-    sorted_bundles = jnp.asarray(bundles[order])
+    sorted_host = bundles[order]
+    padded = 1 << max(0, (b - 1).bit_length())
+    if padded > b:
+        sorted_host = np.concatenate(
+            [
+                sorted_host,
+                np.zeros((padded - b, bundles.shape[1]), dtype=np.float32),
+            ]
+        )
+    sorted_bundles = jnp.asarray(sorted_host)
     if strategy == "PACK":
         res = pack_bundles(totals, avail, alive, sorted_bundles)
     elif strategy == "SPREAD":
@@ -205,7 +226,7 @@ def schedule_bundles(
         res = strict_pack_bundles(totals, avail, alive, sorted_bundles)
     else:
         raise ValueError(f"unknown placement strategy: {strategy}")
-    nodes_sorted = np.asarray(res.node)
+    nodes_sorted = np.asarray(res.node)[:b]
     nodes = np.full_like(nodes_sorted, -1)
     nodes[order] = nodes_sorted
-    return nodes, bool(res.success), res.avail_out
+    return nodes, bool((nodes_sorted >= 0).all()), res.avail_out

@@ -47,6 +47,13 @@ class _Metric:
                 for k, v in self._values.items()
             ] or [f"{self.name} 0"]
 
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        """Current value for one label set (0.0 if never touched) —
+        programmatic readout for tests and debug surfaces, sparing them a
+        prometheus_text() parse."""
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -95,6 +102,19 @@ class Histogram(_Metric):
             b[bisect_right(self.boundaries, value)] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._counts[k] = self._counts.get(k, 0) + 1
+
+    def summary(self, labels: Optional[Dict[str, str]] = None) -> Dict[str, float]:
+        """(count, sum, mean) for one label set — observability surfaces
+        (agent DebugState, bench) read spawn-latency aggregates here."""
+        k = self._key(labels)
+        with self._lock:
+            count = self._counts.get(k, 0)
+            total = self._sums.get(k, 0.0)
+        return {
+            "count": count,
+            "sum": total,
+            "mean": (total / count) if count else 0.0,
+        }
 
     def samples(self) -> List[str]:
         out: List[str] = []
