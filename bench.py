@@ -426,6 +426,12 @@ def _inc_batch(b):
     return {"data": b["data"] + 1}
 
 
+def _touch_block(arr):
+    """Transfer-tier probe: resolving ``arr`` is the measured read; the
+    body touches one element so the view can't be optimized away."""
+    return float(arr[0])
+
+
 def cluster_bench(num_tasks: int = 10_000) -> dict:
     import ray_tpu
     from ray_tpu.cluster import Cluster
@@ -623,6 +629,48 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
             ray_tpu.remove_placement_group(pg)
         pg_pairs_per_s = n_pairs / (time.perf_counter() - t0)
 
+        # tier 8: object-transfer throughput (zero-copy data plane):
+        # put a 1 MB and a 32 MB numpy block, then compare a same-node
+        # worker read (shm arena view — task arg resolution) against the
+        # pickled-RPC path (driver get via head locate + agent fetch).
+        # The acceptance bar: shm >= 10x rpc for the 32 MB block.
+        def _transfer_tier() -> dict:
+            out: dict = {}
+            probe = ray_tpu.remote(_touch_block).options(num_cpus=0.01)
+            for label, n_elem, iters in (
+                ("1mb", 1 << 17, 12),
+                ("32mb", 4 << 20, 6),
+            ):
+                arr = np.arange(n_elem, dtype=np.float64)
+                ref = ray_tpu.put(arr)
+                nbytes = arr.nbytes
+                ray_tpu.get(probe.remote(ref), timeout=180)  # warm path
+                t0 = time.perf_counter()
+                ray_tpu.get(
+                    [probe.remote(ref) for _ in range(iters)], timeout=300
+                )
+                shm_mb_s = iters * nbytes / (time.perf_counter() - t0) / 2**20
+                t0 = time.perf_counter()
+                for _ in range(max(2, iters // 2)):
+                    ray_tpu.get(ref, timeout=180)
+                rpc_mb_s = (
+                    max(2, iters // 2)
+                    * nbytes
+                    / (time.perf_counter() - t0)
+                    / 2**20
+                )
+                out[f"object_transfer_mb_per_s_{label}"] = {
+                    "shm": round(shm_mb_s, 1),
+                    "rpc": round(rpc_mb_s, 1),
+                    "shm_vs_rpc": round(shm_mb_s / rpc_mb_s, 1),
+                }
+            return out
+
+        try:
+            transfer_metrics = _transfer_tier()
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            transfer_metrics = {"object_transfer_error": repr(exc)}
+
         # tier 5: Data actor-pool map_batches over many blocks — the
         # BASELINE.json config "map_batches over 50k blocks, actor-pool
         # scheduling" (reference: actor_pool_map_operator.py). Block
@@ -663,8 +711,19 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
             "data_actor_pool_num_blocks": n_blocks,
             "data_actor_pool_elapsed_s": round(data_elapsed, 1),
         }
+        # env-tunable regression floor, mirroring the PR 2 actor floor:
+        # CI sets RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S to fail the run
+        # loudly when Data-tier throughput regresses below it
+        data_floor = float(
+            os.environ.get("RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S", "0")
+            or 0.0
+        )
+        if data_floor > 0:
+            data_metrics["data_floor_blocks_per_s"] = data_floor
+            data_metrics["data_floor_ok"] = bool(steady_rate >= data_floor)
         return {
             **data_metrics,
+            **transfer_metrics,
             "cluster_tasks_per_s": round(tasks_per_s, 1),
             "cluster_tasks_per_s_steady": round(steady_tasks_per_s, 1),
             "steady_vs_baseline": round(
@@ -1097,9 +1156,13 @@ def main():
             }
         )
     )
-    if out.get("actors_floor_ok") is False:
-        # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S):
-        # the JSON above still published; exit nonzero so CI notices
+    if (
+        out.get("actors_floor_ok") is False
+        or out.get("data_floor_ok") is False
+    ):
+        # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
+        # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S): the JSON above still
+        # published; exit nonzero so CI notices
         import sys
 
         sys.exit(1)

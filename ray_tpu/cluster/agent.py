@@ -37,6 +37,13 @@ from .common import (
     SealInfo,
     new_id,
 )
+from .object_plane import (
+    CHUNKED_PULLS_INFLIGHT,
+    OBJECT_TRANSFER_BYTES,
+    TRANSFER_CHUNK_MS,
+    ChunkFetchError,
+    fetch_chunked,
+)
 from .rpc import HANDLER_STATS, RpcClient, RpcError, RpcServer
 from .zygote import ZygoteClient, fork_available
 
@@ -87,6 +94,14 @@ class _MemStore:
     def get_bytes(self, oid: str) -> bytes:
         with self._lock:
             return self._data[oid]
+
+    def get_range(self, oid: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            return self._data[oid][offset : offset + length]
+
+    def object_size(self, oid: str) -> int:
+        with self._lock:
+            return len(self._data[oid])
 
     def contains(self, oid: str) -> bool:
         with self._lock:
@@ -228,6 +243,17 @@ class NodeAgent:
             f"ray_tpu_store_{self.node_id}_{os.getpid()}.shm",
         )
         try:
+            # a killed agent (chaos kill tier) never reaches the unlink in
+            # shutdown(): sweep arenas/spill dirs whose owning pid is dead
+            # so /tmp does not accrete one orphaned arena per kill
+            from ray_tpu.native.shm_store import sweep_orphan_stores
+
+            swept = sweep_orphan_stores()
+            if swept:
+                logger.info("swept %d orphaned store files", len(swept))
+        except Exception:  # noqa: BLE001 - hygiene, never fatal
+            logger.debug("orphan store sweep failed", exc_info=True)
+        try:
             from ray_tpu.native import NativeObjectStore
 
             inner = NativeObjectStore(
@@ -264,6 +290,8 @@ class NodeAgent:
             "StoreObject": self._h_store_object,
             "FetchObject": self._h_fetch_object,
             "FetchObjectBatch": self._h_fetch_object_batch,
+            "FetchObjectMeta": self._h_fetch_object_meta,
+            "FetchObjectChunk": self._h_fetch_object_chunk,
             "DeleteObjects": self._h_delete_objects,
             "GetObjectForWorker": self._h_get_object_for_worker,
             "WorkerPut": self._h_worker_put,
@@ -908,12 +936,10 @@ class NodeAgent:
                     if nid == self.node_id or self.store.contains(oid):
                         return
                     try:
-                        data = self._peer(nid, addr).call(
-                            "FetchObject",
-                            {"object_id": oid, "purpose": "task_args"},
-                            timeout=60.0,
+                        data = fetch_chunked(
+                            self._peer(nid, addr), oid, purpose="task_args"
                         )
-                    except (RpcError, KeyError, TimeoutError):
+                    except (RpcError, KeyError, TimeoutError, ChunkFetchError):
                         continue
                     try:
                         self.store.put_bytes(oid, data)
@@ -1609,11 +1635,33 @@ class NodeAgent:
 
     def _h_fetch_object(self, req: dict) -> bytes:
         with self._push_adm(req.get("purpose", "task_args")):
-            return self.store.get_bytes(req["object_id"])
+            data = self.store.get_bytes(req["object_id"])
+            OBJECT_TRANSFER_BYTES.inc(len(data), labels={"path": "rpc"})
+            return data
 
     def _h_fetch_object_batch(self, req: dict) -> List[bytes]:
         with self._push_adm(req.get("purpose", "task_args")):
-            return [self.store.get_bytes(oid) for oid in req["object_ids"]]
+            out = [self.store.get_bytes(oid) for oid in req["object_ids"]]
+            OBJECT_TRANSFER_BYTES.inc(
+                sum(len(d) for d in out), labels={"path": "rpc"}
+            )
+            return out
+
+    def _h_fetch_object_meta(self, req: dict) -> dict:
+        """Chunked-pull handshake: size without bytes (KeyError when the
+        object left this node — the puller tries the next replica)."""
+        return {"size": self.store.object_size(req["object_id"])}
+
+    def _h_fetch_object_chunk(self, req: dict) -> bytes:
+        """One window of an object (push_manager chunk analog). Each
+        chunk passes admission separately so a multi-GB pull cannot park
+        a transfer slot for its whole duration."""
+        with self._push_adm(req.get("purpose", "task_args")):
+            data = self.store.get_range(
+                req["object_id"], int(req["offset"]), int(req["length"])
+            )
+            OBJECT_TRANSFER_BYTES.inc(len(data), labels={"path": "rpc"})
+            return data
 
     def _h_delete_objects(self, req: dict) -> None:
         logger.debug(
@@ -1727,15 +1775,24 @@ class NodeAgent:
                             return self._local_reply(oid)
                         continue
                     try:
-                        data = self._peer(nid, addr).call(
-                            "FetchObject",
-                            {"object_id": oid, "purpose": purpose},
-                            timeout=60.0,
+                        # streamed, chunked, resumable pull: bounded
+                        # in-flight windows; a dropped chunk re-requests
+                        # alone instead of restarting the object
+                        data = fetch_chunked(
+                            self._peer(nid, addr),
+                            oid,
+                            purpose=purpose,
+                            deadline=(
+                                None
+                                if wait_s is None
+                                else time.monotonic() + wait_s
+                            ),
                         )
-                    except (RpcError, KeyError, TimeoutError):
+                    except (RpcError, KeyError, TimeoutError, ChunkFetchError):
                         # KeyError: peer dropped it; TimeoutError: its
-                        # push admission saturated — try the next copy,
-                        # then the locate loop
+                        # push admission saturated; ChunkFetchError: a
+                        # chunk died past its retry budget — try the next
+                        # copy, then the locate loop
                         continue
                     try:
                         self.store.put_bytes(oid, data)
@@ -1768,7 +1825,9 @@ class NodeAgent:
         pages), ship the bytes inline."""
         if self.store_path and self.store.restore_to_arena(oid):
             return {"status": "local"}
-        return {"status": "inline", "data": self.store.get_bytes(oid)}
+        data = self.store.get_bytes(oid)
+        OBJECT_TRANSFER_BYTES.inc(len(data), labels={"path": "inline"})
+        return {"status": "inline", "data": data}
 
     def _node_info(self) -> NodeInfo:
         with self._lock:
@@ -2179,10 +2238,33 @@ class NodeAgent:
                 },
                 "available": self.ledger.avail_map(),
                 "store": self.store.stats(),
+                # zero-copy data-plane health: arena fill, chunked pulls
+                # in flight, and bytes moved per path (process-wide —
+                # co-located agents in tests share the counters)
+                "object_plane": self._object_plane_state(),
                 "oom_kills": self.metrics_oom_kills,
                 # instrumented_io_context analog: every handler counted+timed
                 "rpc_handlers": HANDLER_STATS.snapshot(),
             }
+
+    def _object_plane_state(self) -> dict:
+        from ray_tpu.native.spill import SHM_EVICTIONS
+
+        st = self.store.stats()
+        cap = st.get("capacity") or 0
+        return {
+            "arena_fill_pct": (
+                round(100.0 * st.get("used", 0) / cap, 2) if cap else None
+            ),
+            "chunked_pulls_inflight": int(CHUNKED_PULLS_INFLIGHT.value()),
+            "transfer_bytes": {
+                path: int(OBJECT_TRANSFER_BYTES.value({"path": path}))
+                for path in ("shm", "shm_copy", "inline", "rpc")
+            },
+            "transfer_chunk_ms": TRANSFER_CHUNK_MS.summary(),
+            "shm_evictions": int(SHM_EVICTIONS.value()),
+            "spilled_objects": st.get("spilled_objects", 0),
+        }
 
     def _h_shutdown(self, req=None) -> None:
         threading.Thread(target=self.shutdown, daemon=True).start()

@@ -3,9 +3,12 @@
 The reference runs every control-plane boundary over gRPC with protoc-generated
 services (/root/reference/src/ray/rpc/grpc_server.h, src/ray/protobuf/*.proto).
 We keep gRPC as the wire (HTTP/2 framing, flow control, connection reuse) but
-register *generic* unary handlers dispatched by method name with cloudpickle
+register *generic* unary handlers dispatched by method name with pickled
 payloads — the framework's control messages are Python dataclasses, and a
 dynamic schema keeps the RPC layer to one file instead of 36 .proto files.
+Messages ride the pickle-5 out-of-band frame format (serialization.py):
+numpy buffers inside any request/reply travel as raw frame segments and
+deserialize as zero-copy views over the received message.
 
 Every handler runs server-side in a thread pool; exceptions are pickled and
 re-raised at the caller (the RetryableGrpcClient contract,
@@ -14,7 +17,6 @@ src/ray/rpc/retryable_grpc_client.h — retries here are explicit via
 """
 from __future__ import annotations
 
-import pickle
 import threading
 import time
 from concurrent import futures
@@ -22,6 +24,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 import grpc
+
+from . import serialization as wire
 
 _MAX_MSG = 256 * 1024 * 1024
 
@@ -414,8 +418,8 @@ class _GenericHandler(grpc.GenericRpcHandler):
         def unary(request_bytes, context):
             t0 = time.perf_counter()
             try:
-                req = cloudpickle.loads(request_bytes)
-                return cloudpickle.dumps((True, fn(req)))
+                req = wire.loads(request_bytes)
+                return wire.dumps((True, fn(req)))
             except BaseException as exc:  # noqa: BLE001 - shipped to caller
                 try:
                     return cloudpickle.dumps((False, exc))
@@ -516,7 +520,7 @@ class RpcClient:
 
         from ray_tpu.config import cfg
 
-        data = cloudpickle.dumps(payload)
+        data = wire.dumps(payload)
         attempt = 0
         deadline = (
             None if deadline_s is None else time.monotonic() + deadline_s
@@ -572,7 +576,7 @@ class RpcClient:
                             else min(timeout, remaining)
                         )
                     raw = self._method(method)(data, timeout=att_timeout)
-                    ok, value = pickle.loads(raw)
+                    ok, value = wire.loads(raw)
                     br.on_success()
                     if not ok:
                         raise value

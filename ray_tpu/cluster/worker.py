@@ -30,7 +30,9 @@ from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
+from . import serialization as wire
 from .common import INLINE_OBJECT_MAX, SealInfo
+from .object_plane import OBJECT_TRANSFER_BYTES, SHM_HITS, SHM_MISSES
 from .rpc import RpcClient, RpcError, RpcServer
 
 logger = logging.getLogger("ray_tpu.cluster.worker")
@@ -228,6 +230,23 @@ class Worker:
 
         return loads_tracking(self._flusher, data)
 
+    def _read_local(self, hex_id: str) -> Any:
+        """Same-node read: a zero-copy READ-ONLY view mapped over the
+        shared arena page (numpy payloads reconstruct as views — no
+        bytes ever cross a socket). cfg.worker_shm_reads=0 falls back to
+        the copying read for debugging / A-B perf comparison."""
+        from ray_tpu.config import cfg
+
+        if cfg.worker_shm_reads:
+            view = self.store.get_view(hex_id)
+            OBJECT_TRANSFER_BYTES.inc(view.nbytes, labels={"path": "shm"})
+            return self._loads_tracking(view)
+        # distinct label so the A/B the flag exists for stays readable:
+        # these bytes came from the arena but paid the copy
+        data = self.store.get_bytes(hex_id)
+        OBJECT_TRANSFER_BYTES.inc(len(data), labels={"path": "shm_copy"})
+        return self._loads_tracking(data)
+
     def get_object(
         self,
         hex_id: str,
@@ -236,9 +255,11 @@ class Worker:
     ) -> Any:
         if self.store is not None:
             try:
-                return self._loads_tracking(self.store.get_bytes(hex_id))
+                value = self._read_local(hex_id)
+                SHM_HITS.inc()
+                return value
             except (KeyError, BlockingIOError):
-                pass
+                SHM_MISSES.inc()
         reply = self.agent.call(
             "GetObjectForWorker",
             {"object_id": hex_id, "timeout": timeout, "purpose": purpose},
@@ -248,15 +269,22 @@ class Worker:
         if status == "local":
             if self.store is not None:
                 try:
-                    return self._loads_tracking(self.store.get_bytes(hex_id))
+                    # no SHM_HITS here: this logical read already counted
+                    # as a miss above (the agent restored/located it) —
+                    # counting a hit too would skew the hit rate
+                    return self._read_local(hex_id)
                 except (KeyError, BlockingIOError):
                     pass  # spilled/evicted between reply and read: fall back
             # our shm read failed but the agent can serve the bytes
             data = self.agent.call(
                 "FetchObject", {"object_id": hex_id}, timeout=120.0
             )
+            OBJECT_TRANSFER_BYTES.inc(len(data), labels={"path": "rpc"})
             return self._loads_tracking(data)
         if status == "inline":
+            OBJECT_TRANSFER_BYTES.inc(
+                len(reply["data"]), labels={"path": "inline"}
+            )
             return self._loads_tracking(reply["data"])
         if status == "error":
             raise pickle.loads(reply["error"])
@@ -265,11 +293,16 @@ class Worker:
     def put_value(self, object_id: str, value: Any) -> SealInfo:
         from ray_tpu.core.refcount import collect_serialized
 
+        # pickle-5 out-of-band: numpy buffers stay separate frames — a
+        # large block is ONE gather-copy into the shared arena, never a
+        # monolithic pickle byte string re-copied per hop
         with collect_serialized() as contained:
-            data = cloudpickle.dumps(value)
+            parts, total = wire.dumps_parts(value)
         contained_ids = sorted(contained)
         _flush_nested_deferred(contained_ids)
-        if len(data) <= INLINE_OBJECT_MAX:
+        if total <= INLINE_OBJECT_MAX:
+            data = wire.join_parts(parts)
+            OBJECT_TRANSFER_BYTES.inc(len(data), labels={"path": "inline"})
             return SealInfo(
                 object_id=object_id,
                 node_id=self.node_id,
@@ -280,18 +313,22 @@ class Worker:
         stored = False
         if self.store is not None:
             try:
-                self.store.put_bytes(object_id, data)
+                self.store.put_frames(object_id, parts)
+                OBJECT_TRANSFER_BYTES.inc(total, labels={"path": "shm"})
                 stored = True
             except Exception:  # noqa: BLE001 - arena full
                 pass
         if not stored:
             self.agent.call(
-                "WorkerPut", {"object_id": object_id, "data": data}, timeout=60.0
+                "WorkerPut",
+                {"object_id": object_id, "data": wire.join_parts(parts)},
+                timeout=60.0,
             )
+            OBJECT_TRANSFER_BYTES.inc(total, labels={"path": "rpc"})
         return SealInfo(
             object_id=object_id,
             node_id=self.node_id,
-            size=len(data),
+            size=total,
             contained_ids=contained_ids,
         )
 
@@ -509,7 +546,7 @@ class Worker:
                 prev_env = {k: os.environ.get(k) for k in accel_env}
                 os.environ.update(accel_env)
             if kind == "actor_creation":
-                cls, args, kwargs = cloudpickle.loads(req["payload"])
+                cls, args, kwargs = wire.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
                 from ray_tpu.core.actor import _coroutine_method_names
 
@@ -536,7 +573,7 @@ class Worker:
                 creation_ok = True
                 result_values: List[Any] = []
             elif kind == "actor_method":
-                method, args, kwargs = cloudpickle.loads(req["payload"])
+                method, args, kwargs = wire.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
                 aid = req["actor_id"]
                 instance = self._actors[aid]
@@ -597,9 +634,9 @@ class Worker:
                     fn = self._fn_from_blob(
                         req.get("fn_id", ""), fn_blob, req.get("fn_cache")
                     )
-                    args, kwargs = cloudpickle.loads(req["payload"])
+                    args, kwargs = wire.loads(req["payload"])
                 else:
-                    fn, args, kwargs = cloudpickle.loads(req["payload"])
+                    fn, args, kwargs = wire.loads(req["payload"])
                 args, kwargs = self._resolve(args, kwargs)
                 if req.get("streaming"):
                     # owns ALL user-code exceptions (sealed as the final
@@ -893,7 +930,7 @@ class Worker:
         from ray_tpu.core.object_store import ObjectRef
 
         loop, sems = entry
-        method, args, kwargs = cloudpickle.loads(item["payload"])
+        method, args, kwargs = wire.loads(item["payload"])
 
         has_refs = any(isinstance(a, ObjectRef) for a in args) or any(
             isinstance(v, ObjectRef) for v in kwargs.values()
@@ -1029,7 +1066,7 @@ class Worker:
                 item, fut = q.popleft()
             try:
                 instance = self._actors[actor_id]
-                method, args, kwargs = cloudpickle.loads(item["payload"])
+                method, args, kwargs = wire.loads(item["payload"])
                 args, kwargs = self._resolve(args, kwargs)
                 from ray_tpu.util import tracing
 
@@ -1070,10 +1107,11 @@ class Worker:
         oid = item["ref"]
         owner = item["client_id"]
         with collect_serialized() as contained:
-            data = cloudpickle.dumps(value)
+            parts, total = wire.dumps_parts(value)
         contained_ids = sorted(contained)
         _flush_nested_deferred(contained_ids)
-        if len(data) <= INLINE_OBJECT_MAX:
+        data = wire.join_parts(parts) if total <= INLINE_OBJECT_MAX else b""
+        if total <= INLINE_OBJECT_MAX:
             seal = SealInfo(
                 object_id=oid,
                 node_id=self.node_id,
@@ -1104,18 +1142,22 @@ class Worker:
         stored = False
         if self.store is not None:
             try:
-                self.store.put_bytes(oid, data)
+                self.store.put_frames(oid, parts)
+                OBJECT_TRANSFER_BYTES.inc(total, labels={"path": "shm"})
                 stored = True
             except Exception:  # noqa: BLE001 - arena full
                 pass
         if not stored:
             self.agent.call(
-                "WorkerPut", {"object_id": oid, "data": data}, timeout=60.0
+                "WorkerPut",
+                {"object_id": oid, "data": wire.join_parts(parts)},
+                timeout=60.0,
             )
+            OBJECT_TRANSFER_BYTES.inc(total, labels={"path": "rpc"})
         seal = SealInfo(
             object_id=oid,
             node_id=self.node_id,
-            size=len(data),
+            size=total,
             contained_ids=contained_ids,
             owner=owner,
         )

@@ -284,6 +284,26 @@ define(
     "(pull_manager admission; same-object pulls coalesce regardless).",
 )
 define(
+    "transfer_chunk_bytes",
+    4 << 20,
+    "Peer object transfers larger than this pull in chunks of this size "
+    "(object_manager chunked-push analog) instead of one monolithic "
+    "FetchObject reply; a dropped chunk retries alone.",
+)
+define(
+    "transfer_max_inflight_chunks",
+    4,
+    "Concurrent in-flight chunks per chunked peer pull (push_manager "
+    "in-flight cap analog, per transfer).",
+)
+define(
+    "worker_shm_reads",
+    True,
+    "Workers resolve same-node objects as zero-copy read-only views over "
+    "the shared-memory arena. Off: every read round-trips the agent as "
+    "pickled bytes (debug / perf-comparison fallback).",
+)
+define(
     "memory_monitor_interval_s",
     1.0,
     "Agent memory-pressure check period; 0 disables OOM killing.",

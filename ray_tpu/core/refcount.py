@@ -352,14 +352,16 @@ class RefFlusher:
                         pass
 
 
-def loads_tracking(flusher: "RefFlusher", data: bytes):
+def loads_tracking(flusher: "RefFlusher", data):
     """Deserialize a fetched value, registering any ObjectRefs inside it as
     borrows with the head *before* user code sees them (while the containing
-    object's pin still protects them)."""
-    import pickle
+    object's pin still protects them). ``data`` may be bytes or a zero-copy
+    memoryview (shm arena page); the out-of-band wire format deserializes
+    numpy payloads as views over it."""
+    from ray_tpu.cluster import serialization as wire
 
     with collect_deserialized() as borrowed:
-        value = pickle.loads(data)
+        value = wire.loads(data)
     if borrowed:
         flusher.sync_incref(sorted(borrowed))
     return value

@@ -207,9 +207,12 @@ class _ActorPool:
             local = [a for a in cands if a.node_id in block_locations]
             if local:
                 cands = local
-        # refresh unknown node ids lazily (actor may have been pending)
         best = min(cands, key=lambda a: a.ongoing)
-        if best.node_id is None:
+        # refresh unknown node ids lazily — but only when locality is in
+        # play: host-resident blocks have no locations, and polling
+        # WaitActor per pick for them was a measurable RPC storm during
+        # pool ramp (pending actors answer slowly)
+        if best.node_id is None and block_locations:
             loc = getattr(self._rt, "actor_location", None)
             if loc is not None:
                 try:
@@ -221,6 +224,26 @@ class _ActorPool:
     def submit(self, actor: _PoolActor, op_kwargs: dict, block):
         actor.ongoing += 1
         return actor.handle.apply.remote(op_kwargs, block)
+
+    def submit_window(
+        self, actor: _PoolActor, op_kwargs: dict, blocks: List[Any]
+    ) -> List[Any]:
+        """Submit a window of blocks to ONE actor in one batched pass —
+        rides the runtime's ordered submission batch (one bookkeeping
+        lock + one channel wakeup per window instead of per block).
+        Falls back to per-block submission on runtimes without the
+        batch API (the in-process local runtime)."""
+        batch = getattr(self._rt, "submit_actor_method_batch", None)
+        actor.ongoing += len(blocks)
+        if batch is None:
+            return [
+                actor.handle.apply.remote(op_kwargs, b) for b in blocks
+            ]
+        return batch(
+            actor.handle._actor_id,
+            "apply",
+            [((op_kwargs, b), {}) for b in blocks],
+        )
 
     def complete(self, actor: _PoolActor) -> None:
         actor.ongoing -= 1
@@ -301,17 +324,54 @@ class StreamingExecutor:
             if isinstance(st.stage, ActorStage):
                 st.pool = _ActorPool(st.stage, self._rt)
         self._locations: Dict[str, List[str]] = {}
+        # refs MINTED by this pipeline (stage outputs fed downstream):
+        # owned exclusively by the executor, so the moment the consuming
+        # task completes they are garbage — freed eagerly in batches so a
+        # 50k-block run doesn't accrete dead blocks in the stores until
+        # the Python GC happens to run
+        self._intermediate: set = set()
+        self._free_batch: List[ray_tpu.ObjectRef] = []
+
+    def _note_consumed(self, block: Any) -> None:
+        # Same semantics as dropping the executor's last ObjectRef (the
+        # head runs the identical free cascade, lineage release included,
+        # when the decref lands) — just eager and batched instead of
+        # waiting on Python GC + the flusher. Downstream blocks whose
+        # reconstruction would need a freed input were equally
+        # unreconstructable under the drop-ref path.
+        if (
+            isinstance(block, ray_tpu.ObjectRef)
+            and block.hex in self._intermediate
+        ):
+            self._intermediate.discard(block.hex)
+            self._free_batch.append(block)
+
+    def _flush_frees(self, force: bool = False) -> None:
+        if not self._free_batch or (len(self._free_batch) < 64 and not force):
+            return
+        batch, self._free_batch = self._free_batch, []
+        free = getattr(self._rt, "free_objects", None)
+        if free is None:
+            return
+        try:
+            free(batch)
+        except Exception:  # noqa: BLE001 - GC is advisory
+            pass
 
     def _measure_block(
         self, ref: ray_tpu.ObjectRef, fetch_timeout: float = 5.0
     ) -> int:
         """Real byte size of a completed block: seal size from the object
-        directory (cluster) or one sampled pickle (local runtime)."""
+        directory. On a cluster runtime the directory answer is FINAL —
+        the old fallback pulled the entire remote block to the driver
+        just to size it (a multi-MB fetch per stage calibration); when
+        the seal size is unknown the conservative window default stands.
+        Only the in-process local runtime (no directory, objects already
+        in this heap) still samples one pickle."""
         sizes_fn = getattr(self._rt, "object_sizes", None)
         if sizes_fn is not None:
             size = sizes_fn([ref]).get(ref.hex, 0)
-            if size:
-                return int(size)
+            return int(size) if size else 0
         try:
             return _est_bytes(self._rt.get_object(ref, fetch_timeout))
         except Exception:  # noqa: BLE001
@@ -344,22 +404,40 @@ class StreamingExecutor:
             task = _apply_chain.options(**opts) if opts else _apply_chain
             ref = task.remote(block, st.stage.ops)
         else:
+            return self._dispatch_actor_window(si, st, budget=1) > 0
+        st.in_flight[ref.hex] = (ref, si, None, block)
+        st.queue.popleft()
+        return True
+
+    def _dispatch_actor_window(
+        self, si: int, st: _StageState, budget: int
+    ) -> int:
+        """Dispatch up to ``budget`` queued blocks onto pool actors, a
+        per-actor WINDOW per submission batch: each window rides one
+        batched submit (one channel wakeup / one pipelined message)
+        instead of a per-block round through the submission path."""
+        dispatched = 0
+        cap = st.stage.pool.max_tasks_in_flight
+        while st.queue and dispatched < budget:
+            head = st.queue[0]
             locs = (
-                self._locations.get(block.hex, [])
-                if isinstance(block, ray_tpu.ObjectRef)
+                self._locations.get(head.hex, [])
+                if isinstance(head, ray_tpu.ObjectRef)
                 else []
             )
             actor = st.pool.pick(locs)
             if actor is None:
                 st.pool.maybe_scale_up(len(st.queue))
-                return False
-            ref = st.pool.submit(actor, st.stage.kwargs, block)
-            st.in_flight[ref.hex] = (ref, si, actor)
-            st.queue.popleft()
-            return True
-        st.in_flight[ref.hex] = (ref, si, None)
-        st.queue.popleft()
-        return True
+                break
+            window = min(
+                budget - dispatched, max(1, cap - actor.ongoing), len(st.queue)
+            )
+            blocks = [st.queue.popleft() for _ in range(window)]
+            refs = st.pool.submit_window(actor, st.stage.kwargs, blocks)
+            for ref, block in zip(refs, blocks):
+                st.in_flight[ref.hex] = (ref, si, actor, block)
+            dispatched += window
+        return dispatched
 
     def _stage_capacity(self, st: _StageState) -> int:
         cap = st.window() - len(st.in_flight)
@@ -386,13 +464,16 @@ class StreamingExecutor:
                         ]
                         self._locate(refs)
                     budget = self._stage_capacity(st)
-                    while st.queue and budget > 0:
-                        if not self._dispatch_one(si, st):
-                            break
-                        budget -= 1
                     if st.pool is not None:
+                        if budget > 0:
+                            self._dispatch_actor_window(si, st, budget)
                         st.pool.maybe_scale_up(len(st.queue))
                         st.pool.reap_idle()
+                    else:
+                        while st.queue and budget > 0:
+                            if not self._dispatch_one(si, st):
+                                break
+                            budget -= 1
                 all_inflight = [
                     meta[0]
                     for st in stages
@@ -426,6 +507,9 @@ class StreamingExecutor:
                             continue
                         if meta[2] is not None:
                             st.pool.complete(meta[2])
+                        # the consuming task is done with its input: an
+                        # executor-owned intermediate block is garbage NOW
+                        self._note_consumed(meta[3])
                         # calibrate the byte budget from the first MEASURED
                         # output of this stage (seal size from the
                         # directory; local fallback re-pickles one block) —
@@ -448,6 +532,10 @@ class StreamingExecutor:
                                 tgt.est_measured = True
                         nxt = si + 1
                         if nxt < len(stages):
+                            # executor-minted ref flowing downstream: we
+                            # are its only holder — eligible for the
+                            # eager free once its consumer completes
+                            self._intermediate.add(ref.hex)
                             stages[nxt].queue.append(ref)
                             if stages[nxt].est_block_bytes is None:
                                 stages[nxt].est_block_bytes = (
@@ -456,7 +544,9 @@ class StreamingExecutor:
                         else:
                             yield ref
                         break
+                self._flush_frees()
         finally:
+            self._flush_frees(force=True)
             for st in stages:
                 if st.pool is not None:
                     st.pool.shutdown()

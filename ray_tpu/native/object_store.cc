@@ -24,11 +24,15 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5254505553544f52ull;  // "RTPUSTOR"
+constexpr uint64_t kMagic = 0x5254505553544f53ull;  // "RTPUSTOS" (v2: zombies)
 constexpr uint32_t kIdLen = 28;                     // hex id length (like ObjectID)
 constexpr uint32_t kEntryEmpty = 0;
 constexpr uint32_t kEntryUsed = 1;
 constexpr uint32_t kEntryTombstone = 2;
+// deleted while readers still hold a pin (zero-copy views): the arena
+// space is retained until the last release drops the refcount to zero —
+// a mapped numpy view in another process must never see its pages reused
+constexpr uint32_t kEntryZombie = 3;
 
 struct Entry {
   char id[kIdLen];
@@ -84,10 +88,28 @@ Entry* find_entry(Store* s, const char* id, bool for_insert) {
     if (e->state == kEntryUsed && memcmp(e->id, id, kIdLen) == 0) return e;
     if (e->state == kEntryTombstone && for_insert && !first_tomb)
       first_tomb = e;
+    // zombies are invisible to lookups and NOT insertable (they still own
+    // arena space); probing continues past them
     if (e->state == kEntryEmpty)
       return for_insert ? (first_tomb ? first_tomb : e) : nullptr;
   }
   return for_insert ? first_tomb : nullptr;
+}
+
+// Locate a used-or-zombie entry by id + arena offset (offsets are unique
+// per live allocation, so a zombie and its same-id successor never
+// collide). Entries never move, so the hash probe still finds them.
+Entry* find_entry_at(Store* s, const char* id, uint64_t offset) {
+  uint64_t slots = s->hdr->table_slots;
+  uint64_t h = hash_id(id) % slots;
+  for (uint64_t probe = 0; probe < slots; probe++) {
+    Entry* e = &s->table[(h + probe) % slots];
+    if ((e->state == kEntryUsed || e->state == kEntryZombie) &&
+        e->offset == offset && memcmp(e->id, id, kIdLen) == 0)
+      return e;
+    if (e->state == kEntryEmpty) return nullptr;
+  }
+  return nullptr;
 }
 
 // first-fit allocation from the free list; splits blocks.
@@ -281,17 +303,41 @@ int rtpu_store_get(void* handle, const char* id, uint64_t* offset,
   return rc;
 }
 
+static void release_entry(Store* s, Entry* e) {
+  if (e->refcount > 0) e->refcount--;
+  if (e->state == kEntryZombie && e->refcount <= 0) {
+    // last pinned reader of a deleted object: free for real now
+    arena_free(s, e->offset, e->size);
+    e->state = kEntryTombstone;
+  }
+}
+
 int rtpu_store_release(void* handle, const char* id) {
   Store* s = (Store*)handle;
   if (lock_hdr(s->hdr) != 0) return -4;
   Entry* e = find_entry(s, id, false);
   int rc = e ? 0 : -1;
-  if (e && e->refcount > 0) e->refcount--;
+  if (e) release_entry(s, e);
   pthread_mutex_unlock(&s->hdr->mutex);
   return rc;
 }
 
-// Delete when refcount drops to the caller's share; frees arena space.
+// Release a long-held pin (zero-copy view) precisely: the (id, offset)
+// pair survives a delete (zombie) and is never confused with a same-id
+// successor allocation.
+int rtpu_store_release_at(void* handle, const char* id, uint64_t offset) {
+  Store* s = (Store*)handle;
+  if (lock_hdr(s->hdr) != 0) return -4;
+  Entry* e = find_entry_at(s, id, offset);
+  int rc = e ? 0 : -1;
+  if (e) release_entry(s, e);
+  pthread_mutex_unlock(&s->hdr->mutex);
+  return rc;
+}
+
+// Delete: frees arena space when only the creator's share remains;
+// otherwise the entry turns zombie and the space is reclaimed by the
+// last reader's release (a mapped view must never see reused pages).
 int rtpu_store_delete(void* handle, const char* id) {
   Store* s = (Store*)handle;
   if (lock_hdr(s->hdr) != 0) return -4;
@@ -299,9 +345,14 @@ int rtpu_store_delete(void* handle, const char* id) {
   int rc = 0;
   if (e == nullptr) {
     rc = -1;
-  } else {
+  } else if (e->refcount <= 1) {
     arena_free(s, e->offset, e->size);
     e->state = kEntryTombstone;
+    e->sealed = 0;
+    s->hdr->num_objects--;
+  } else {
+    e->refcount--;  // consume the creator's share
+    e->state = kEntryZombie;
     e->sealed = 0;
     s->hdr->num_objects--;
   }

@@ -1,9 +1,15 @@
 """ctypes binding for the C++ shared-memory object store.
 
-Zero-copy path: ``put_numpy`` writes the array into the mmap arena;
-``get_numpy`` returns an ndarray VIEW over the same shared pages — any
+Zero-copy path: ``put_numpy``/``put_frames`` write into the mmap arena;
+``get_numpy``/``get_view`` return VIEWS over the same shared pages — any
 process that opens the same store file sees the bytes without a copy (the
 plasma fd-passing model, by shared file instead of fd fling).
+
+View lifetime: ``get_view`` pins the object (shared-memory refcount, so
+the pin is visible across processes); a ``delete`` that lands while views
+are outstanding defers the arena free until the last view's finalizer
+releases the pin (zombie entries, object_store.cc) — a mapped numpy view
+can never observe its pages being reused.
 """
 from __future__ import annotations
 
@@ -11,7 +17,8 @@ import ctypes
 import json
 import os
 import tempfile
-from typing import Optional, Tuple
+import weakref
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -45,6 +52,12 @@ class NativeObjectStore:
         for fn in ("rtpu_store_seal", "rtpu_store_release", "rtpu_store_delete"):
             getattr(lib, fn).restype = ctypes.c_int
             getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_store_release_at.restype = ctypes.c_int
+        lib.rtpu_store_release_at.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
         lib.rtpu_store_get.restype = ctypes.c_int
         lib.rtpu_store_get.argtypes = [
             ctypes.c_void_p,
@@ -66,6 +79,7 @@ class NativeObjectStore:
             tempfile.gettempdir(), f"ray_tpu_store_{os.getpid()}.shm"
         )
         self._owns_file = path is None
+        self._unlinked = False
         self._h = lib.rtpu_store_open(
             self.path.encode(), capacity, table_slots, 1 if create else 0
         )
@@ -83,17 +97,37 @@ class NativeObjectStore:
         return b
 
     def put_bytes(self, object_id: str, data: bytes) -> None:
+        self.put_frames(object_id, [data])
+
+    def put_frames(self, object_id: str, frames: Sequence) -> int:
+        """Scatter-write ``frames`` (bytes / memoryviews) as one object —
+        the out-of-band wire format streams straight into shared memory
+        with a single gather copy. Returns the object's total size."""
+        sizes = [
+            f.nbytes if isinstance(f, memoryview) else len(f) for f in frames
+        ]
+        total = sum(sizes)
         oid = self._norm_id(object_id)
-        off = self._lib.rtpu_store_create(self._h, oid, len(data))
+        off = self._lib.rtpu_store_create(self._h, oid, total)
         if off == -2:
             raise KeyError(f"object {object_id} already in store")
         if off < 0:
             raise MemoryError(f"native store allocation failed ({off})")
         base = self._lib.rtpu_store_base(self._h)
-        ctypes.memmove(
-            ctypes.addressof(base.contents) + off, data, len(data)
-        )
+        dest = memoryview(
+            (ctypes.c_char * total).from_address(
+                ctypes.addressof(base.contents) + off
+            )
+        ).cast("B")
+        pos = 0
+        for f, n in zip(frames, sizes):
+            if n == 0:
+                continue
+            src = f if isinstance(f, memoryview) else memoryview(f)
+            dest[pos : pos + n] = src.cast("B")
+            pos += n
         self._lib.rtpu_store_seal(self._h, oid)
+        return total
 
     def get_buffer(self, object_id: str) -> Tuple[int, int]:
         oid = self._norm_id(object_id)
@@ -111,11 +145,57 @@ class NativeObjectStore:
         return off.value, size.value
 
     def get_bytes(self, object_id: str) -> bytes:
+        # every release in this class goes through release_at: an id-only
+        # release cannot find an entry that went zombie under our pin (a
+        # concurrent delete/spill) and could decrement a same-id
+        # SUCCESSOR's creator share instead — (id, offset) is precise
         off, size = self.get_buffer(object_id)
         base = self._lib.rtpu_store_base(self._h)
         out = ctypes.string_at(ctypes.addressof(base.contents) + off, size)
-        self._lib.rtpu_store_release(self._h, self._norm_id(object_id))
+        self._lib.rtpu_store_release_at(self._h, self._norm_id(object_id), off)
         return out
+
+    def get_range(self, object_id: str, offset: int, length: int) -> bytes:
+        """One chunk of an object (peer transfer slicing) — copies only
+        the requested window."""
+        off, size = self.get_buffer(object_id)
+        try:
+            if offset >= size:
+                return b""
+            n = min(length, size - offset)
+            base = self._lib.rtpu_store_base(self._h)
+            return ctypes.string_at(
+                ctypes.addressof(base.contents) + off + offset, n
+            )
+        finally:
+            self._lib.rtpu_store_release_at(
+                self._h, self._norm_id(object_id), off
+            )
+
+    def get_view(self, object_id: str) -> memoryview:
+        """Read-only zero-copy view over the object's shared pages.
+
+        The object stays pinned (shared refcount) until every view/array
+        derived from the returned memoryview is garbage-collected; a
+        concurrent delete defers the arena free until then."""
+        oid = self._norm_id(object_id)
+        off, size = self.get_buffer(object_id)  # pins
+        base = self._lib.rtpu_store_base(self._h)
+        raw = (ctypes.c_uint8 * size).from_address(
+            ctypes.addressof(base.contents) + off
+        )
+        # finalizer releases the pin when the LAST derived view dies (the
+        # memoryview chain keeps `raw` alive); release_at is (id, offset)-
+        # precise so a same-id reput can never absorb this release
+        weakref.finalize(raw, self._release_pin, oid, off)
+        return memoryview(raw).toreadonly()
+
+    def _release_pin(self, oid: bytes, off: int) -> None:
+        if self._h:
+            try:
+                self._lib.rtpu_store_release_at(self._h, oid, off)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
 
     # -- zero-copy numpy ------------------------------------------------
     def put_numpy(self, object_id: str, arr: np.ndarray) -> None:
@@ -123,26 +203,25 @@ class NativeObjectStore:
         header = json.dumps(
             {"dtype": arr.dtype.str, "shape": list(arr.shape)}
         ).encode()
-        payload = (
-            len(header).to_bytes(4, "little") + header + arr.tobytes()
+        self.put_frames(
+            object_id,
+            [len(header).to_bytes(4, "little"), header, memoryview(arr).cast("B")],
         )
-        # one memcpy into shared memory; readers are zero-copy
-        self.put_bytes(object_id, payload)
 
     def get_numpy(self, object_id: str) -> np.ndarray:
         """Returns a read-only view over the shared pages (no copy)."""
-        off, size = self.get_buffer(object_id)
-        base = self._lib.rtpu_store_base(self._h)
-        addr = ctypes.addressof(base.contents) + off
-        raw = (ctypes.c_uint8 * size).from_address(addr)
-        mv = memoryview(raw)
+        mv = self.get_view(object_id)
         hlen = int.from_bytes(mv[:4], "little")
         meta = json.loads(bytes(mv[4 : 4 + hlen]))
         arr = np.frombuffer(
             mv, dtype=np.dtype(meta["dtype"]), offset=4 + hlen
         ).reshape(meta["shape"])
-        arr.flags.writeable = False
         return arr
+
+    def object_size(self, object_id: str) -> int:
+        off, size = self.get_buffer(object_id)
+        self._lib.rtpu_store_release_at(self._h, self._norm_id(object_id), off)
+        return size
 
     def delete(self, object_id: str) -> None:
         self._lib.rtpu_store_delete(self._h, self._norm_id(object_id))
@@ -150,7 +229,9 @@ class NativeObjectStore:
     def contains(self, object_id: str) -> bool:
         try:
             off, _ = self.get_buffer(object_id)
-            self._lib.rtpu_store_release(self._h, self._norm_id(object_id))
+            self._lib.rtpu_store_release_at(
+                self._h, self._norm_id(object_id), off
+            )
             return True
         except (KeyError, BlockingIOError):
             return False
@@ -172,7 +253,11 @@ class NativeObjectStore:
         if self._h:
             self._lib.rtpu_store_close(self._h)
             self._h = None
-        if unlink or self._owns_file:
+        # unlink exactly once: close(unlink=True) + __del__ used to race
+        # a second unlink, and a path-sharing reader (worker) closing its
+        # mapping must never take the agent's arena file with it
+        if (unlink or self._owns_file) and not self._unlinked:
+            self._unlinked = True
             try:
                 os.unlink(self.path)
             except OSError:
@@ -183,3 +268,48 @@ class NativeObjectStore:
             self.close()
         except Exception:  # noqa: BLE001
             pass
+
+
+def sweep_orphan_stores(tmpdir: Optional[str] = None) -> List[str]:
+    """Remove ``ray_tpu_store_*.shm`` arenas / ``ray_tpu_spill_*`` dirs
+    left by killed agents (chaos kills skip the unlink path). A file is
+    an orphan when the pid embedded in its name is no longer alive; run
+    at agent start so /tmp does not accrete a dead agent's arena per
+    kill. Returns the paths removed."""
+    import re
+    import shutil
+
+    tmpdir = tmpdir or tempfile.gettempdir()
+    removed: List[str] = []
+    try:
+        names = os.listdir(tmpdir)
+    except OSError:
+        return removed
+    pat = re.compile(r"^ray_tpu_(store|spill)_.*?(\d+)(\.shm)?$")
+    for name in names:
+        m = pat.match(name)
+        if not m:
+            continue
+        pid = int(m.group(2))
+        if pid <= 0 or _pid_alive(pid):
+            continue
+        path = os.path.join(tmpdir, name)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.unlink(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True

@@ -22,7 +22,14 @@ from __future__ import annotations
 import shutil
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.util.metrics import Counter as _Counter
+
+SHM_EVICTIONS = _Counter(
+    "shm_store_evictions_total",
+    "Arena residents spilled to disk to make room (LRU eviction).",
+)
 
 
 class SpillingStore:
@@ -126,6 +133,7 @@ class SpillingStore:
                 self._spilled[oid] = size
                 self.metrics["spilled_objects"] += 1
                 self.metrics["spilled_bytes"] += size
+                SHM_EVICTIONS.inc()
 
     # -- store interface ----------------------------------------------
     def put_bytes(self, oid: str, data: bytes) -> None:
@@ -152,6 +160,60 @@ class SpillingStore:
             self._spilled[oid] = len(data)
             self.metrics["spilled_objects"] += 1
             self.metrics["spilled_bytes"] += len(data)
+
+    def put_frames(self, oid: str, frames: Sequence) -> None:
+        """Scatter-put of the out-of-band wire frames: writes straight
+        into the arena when it fits — including after an LRU spill pass
+        when it is full (the zero-copy seal path must not degrade to a
+        monolithic join exactly under memory pressure). Only an object
+        that cannot fit even after eviction takes the joined put_bytes
+        route (which owns the spill-to-disk fallback)."""
+        putf = getattr(self.inner, "put_frames", None)
+        if putf is not None:
+            total = sum(
+                f.nbytes if isinstance(f, memoryview) else len(f)
+                for f in frames
+            )
+            for attempt in range(2):
+                with self._lock:
+                    if self.inner.contains(oid) or oid in self._spilled:
+                        return
+                    try:
+                        putf(oid, frames)
+                        self._resident[oid] = total
+                        self._resident.move_to_end(oid)
+                        return
+                    except MemoryError:
+                        pass
+                    except KeyError:
+                        return  # duplicate put: already stored
+                if attempt == 0:
+                    self._make_room(total)
+        data = b"".join(
+            bytes(f) if isinstance(f, memoryview) else f for f in frames
+        )
+        self.put_bytes(oid, data)
+
+    def get_range(self, oid: str, offset: int, length: int) -> bytes:
+        """One window of an object (chunked peer transfers): arena
+        residents slice in place. A spilled object is RESTORED to the
+        arena first so a 256-chunk pull reads the backend once, not 256
+        times; only when it cannot fit back does each chunk slice a full
+        backend read (bounded by the chunk count, and the transfer is
+        already in degraded-capacity territory)."""
+        ranger = getattr(self.inner, "get_range", None)
+        if ranger is not None:
+            with self._lock:
+                if self.inner.contains(oid):
+                    self._touch(oid)
+                    return ranger(oid, offset, length)
+            if self.restore_to_arena(oid):
+                with self._lock:
+                    if self.inner.contains(oid):
+                        self._touch(oid)
+                        return ranger(oid, offset, length)
+        data = self.get_bytes(oid)
+        return data[offset : offset + length]
 
     def get_bytes(self, oid: str) -> bytes:
         with self._lock:
@@ -207,6 +269,20 @@ class SpillingStore:
             self.metrics["restored"] += 1
         self.backend.delete(oid)
         return True
+
+    def object_size(self, oid: str) -> int:
+        """Byte size of a stored object (KeyError when absent) — the
+        chunked-fetch handshake sizes the pull without shipping bytes."""
+        with self._lock:
+            n = self._resident.get(oid)
+            if n is None:
+                n = self._spilled.get(oid)
+            if n is not None:
+                return n
+            sizer = getattr(self.inner, "object_size", None)
+            if sizer is not None and self.inner.contains(oid):
+                return sizer(oid)
+        return len(self.get_bytes(oid))
 
     def contains(self, oid: str) -> bool:
         with self._lock:
