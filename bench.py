@@ -458,9 +458,35 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
         # steady state a long-running cluster sustains (observed ~1.5x
         # pass 1 on this host). The HEADLINE stays pass 1 — the same
         # cold-ish semantics as the reference's many_tasks run — with
-        # steady state published alongside.
+        # steady state published alongside. Under task leases the steady
+        # pass streams same-shape tasks straight to cached worker leases
+        # (no head hop); the cache counters below quantify that.
         tasks_per_s = one_pass(num_tasks)
         steady_tasks_per_s = one_pass(num_tasks)
+        lease_hits = int(client.metrics.get("lease_cache_hits", 0))
+        lease_misses = int(client.metrics.get("lease_cache_misses", 0))
+        lease_total = lease_hits + lease_misses
+        task_metrics = {
+            "lease_cache_hits": lease_hits,
+            "lease_cache_misses": lease_misses,
+            "lease_cache_hit_rate": (
+                round(lease_hits / lease_total, 4) if lease_total else None
+            ),
+            "lease_spillbacks": int(
+                client.metrics.get("lease_spillbacks", 0)
+            ),
+        }
+        # env-tunable regression floor, mirroring the actors/data floors:
+        # CI sets RAY_TPU_BENCH_TASKS_FLOOR_PER_S to fail the run loudly
+        # when steady task throughput regresses below it
+        tasks_floor = float(
+            os.environ.get("RAY_TPU_BENCH_TASKS_FLOOR_PER_S", "0") or 0.0
+        )
+        if tasks_floor > 0:
+            task_metrics["tasks_floor_per_s"] = tasks_floor
+            task_metrics["tasks_floor_ok"] = bool(
+                steady_tasks_per_s >= tasks_floor
+            )
 
         # tier 4: compiled DAG — 3 actors pipelined through shm ring
         # channels vs the eager .remote() chain (compiled_dag_node.py
@@ -726,6 +752,7 @@ def cluster_bench(num_tasks: int = 10_000) -> dict:
             **transfer_metrics,
             "cluster_tasks_per_s": round(tasks_per_s, 1),
             "cluster_tasks_per_s_steady": round(steady_tasks_per_s, 1),
+            **task_metrics,
             "steady_vs_baseline": round(
                 steady_tasks_per_s / BASELINE_E2E_TASKS_PER_S, 3
             ),
@@ -869,6 +896,44 @@ def _run_tpu_child(env_extra: dict, budgets: dict) -> tuple:
     return marks, failure, tail
 
 
+def _device_preflight(timeout_s: float = 10.0) -> tuple:
+    """(ok, reason): a tiny jit put/execute/readback in its own
+    subprocess under its own timeout. BENCH_r05 burned 180+180+600s on
+    three full-budget children timing out in backend init ("accelerator
+    transport wedged?"); a wedged tunnel fails this probe in <=10s, so
+    the tier skips immediately with the reason recorded instead."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        "x = jnp.arange(8.0)\n"
+        "y = jax.jit(lambda a: (a * 2.0).sum())(x)\n"
+        "print('PREFLIGHT_OK', float(np.asarray(y)))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"device preflight timed out after {timeout_s:.0f}s "
+            "(accelerator transport wedged)"
+        )
+    except OSError as exc:
+        return False, f"device preflight could not launch: {exc!r}"
+    if proc.returncode != 0 or "PREFLIGHT_OK" not in proc.stdout:
+        return False, (
+            f"device preflight failed (rc={proc.returncode}): "
+            + (proc.stderr or proc.stdout or "")[-300:]
+        )
+    return True, ""
+
+
 class _TpuTiers:
     """Kernel + model tiers with attempts SPREAD ACROSS the whole bench run.
 
@@ -890,6 +955,7 @@ class _TpuTiers:
         self.attempts: list = []
         self.marks: dict = {}
         self.failure = None
+        self.skip_reason = None  # last device-preflight failure, if any
         self.tail = ""
         self.spent_s = 0.0
         # total wall-clock across ALL attempts: a backend that comes up
@@ -918,7 +984,10 @@ class _TpuTiers:
         self, label: str, backend_budget: float = 180.0, small: bool = False
     ) -> None:
         """One child run; no-op once both tiers have clean numbers (or
-        the total attempt budget is spent)."""
+        the total attempt budget is spent). Gated by a cheap (<=10s)
+        device preflight: a wedged accelerator transport skips the
+        attempt immediately instead of timing out three full stage
+        budgets."""
         if self.done():
             return
         if self.spent_s >= self.total_budget_s:
@@ -927,6 +996,21 @@ class _TpuTiers:
                     "label": label,
                     "outcome": "skipped: total TPU-tier budget spent "
                     f"({self.spent_s:.0f}s >= {self.total_budget_s:.0f}s)",
+                }
+            )
+            return
+        t_pre = time.monotonic()
+        ok, reason = _device_preflight()
+        self.spent_s += time.monotonic() - t_pre
+        if not ok:
+            self.skip_reason = reason
+            self.attempts.append(
+                {
+                    "label": label,
+                    "at_utc": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                    "outcome": f"skipped by preflight: {reason}",
                 }
             )
             return
@@ -992,6 +1076,8 @@ class _TpuTiers:
         # the attempt log ALWAYS publishes: timestamped evidence of when
         # the tunnel was probed, wedged or not
         out["tpu_tier_attempts"] = self.attempts
+        if self.skip_reason and not self.done():
+            out["tpu_tier_skipped_reason"] = self.skip_reason
         if not self.done() and self.tail:
             out["tpu_stderr_tail"] = self.tail[-800:]
         if not self.kernel_ok():
@@ -1159,9 +1245,11 @@ def main():
     if (
         out.get("actors_floor_ok") is False
         or out.get("data_floor_ok") is False
+        or out.get("tasks_floor_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
-        # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S): the JSON above still
+        # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
+        # RAY_TPU_BENCH_TASKS_FLOOR_PER_S): the JSON above still
         # published; exit nonzero so CI notices
         import sys
 

@@ -194,6 +194,7 @@ class _WorkerHandle:
         self.client: Optional[RpcClient] = None
         self.ready = threading.Event()
         self.actor_id: Optional[str] = None  # pinned for an actor
+        self.lease_id: Optional[str] = None  # pinned for a task lease
         self.pip_key: Optional[str] = None  # bound to a pip runtime env
         self.idle_since: float = 0.0  # env workers: reap when idle long
         self.lock = threading.Lock()  # serializes pushes (actor ordering)
@@ -310,6 +311,7 @@ class NodeAgent:
             "KillActor": self._h_kill_actor,
             "PrestartWorkers": self._h_prestart_workers,
             "ActorWorkerAddress": self._h_actor_worker_address,
+            "ReturnWorkerLease": self._h_return_worker_lease,
             "CancelLease": self._h_cancel_lease,
             "DagInstall": lambda r: self._forward_to_actor_worker(
                 "DagInstall", r
@@ -331,6 +333,16 @@ class NodeAgent:
         self._idle: List[str] = []
         self._idle_cv = threading.Condition(self._lock)
         self._actor_workers: Dict[str, str] = {}  # actor_id -> worker_id
+        # task leases held by this node's workers (worker_lease grants):
+        # lease_id -> {worker_id, alloc, owner, granted_at}. The lease pins
+        # its worker out of the idle pool like an actor does, and the pool
+        # backfills 1:1 for the same reason.
+        self._task_leases: Dict[str, dict] = {}
+        self._lease_stats: Dict[str, int] = {
+            "granted": 0,
+            "returned": 0,
+            "lost": 0,
+        }
         self._actor_meta: Dict[str, dict] = {}  # actor_id -> {name, max_restarts}
         self._actor_allocs: Dict[str, Any] = {}  # actor_id -> held lease alloc
         self._actor_fifo: Dict[str, list] = {}  # actor_id -> ordered methods
@@ -692,7 +704,11 @@ class NodeAgent:
 
     def _return_worker(self, handle: _WorkerHandle) -> None:
         with self._idle_cv:
-            if handle.actor_id is None and handle.worker_id in self._workers:
+            if (
+                handle.actor_id is None
+                and handle.lease_id is None
+                and handle.worker_id in self._workers
+            ):
                 handle.idle_since = time.monotonic()
                 if handle.pip_key is not None:
                     self._pip_idle.setdefault(handle.pip_key, []).append(
@@ -728,12 +744,33 @@ class NodeAgent:
             actor_id = handle.actor_id
             if actor_id:
                 self._drop_actor_state(actor_id)
+            lease_id = handle.lease_id
+            lease_entry = None
+            if lease_id:
+                handle.lease_id = None
+                lease_entry = self._task_leases.pop(lease_id, None)
+                if lease_entry is not None:
+                    self._lease_stats["lost"] += 1
         try:
             handle.proc.kill()
         except OSError:
             pass
         self._close_worker_client(handle)
+        if lease_entry is not None:
+            self._release(lease_entry["alloc"])
         report: Dict[str, Any] = {"node_id": self.node_id}
+        if lease_id and lease_entry is not None:
+            # the owner's channel discovers the death by RPC failure and
+            # spills its queue; this report lets the head drop the lease
+            # from its table (revoked) without waiting for TTL expiry
+            report["task_leases"] = [
+                {
+                    "lease_id": lease_id,
+                    "ok": False,
+                    "reason": "worker died",
+                    "lost": True,
+                }
+            ]
         # the dead process's holder counts die with it
         report["holders_gone"] = [handle.worker_id]
         if actor_id:
@@ -799,6 +836,27 @@ class NodeAgent:
                     return {"status": "granted"}
                 self._actor_draining.add(spec.actor_id)
             self._exec_pool.submit(self._drain_actor_fifo, spec.actor_id)
+            return {"status": "granted"}
+        if spec.kind == "worker_lease":
+            # task-lease grant: allocate the shape ONCE and pin one worker
+            # for the owner's direct dispatch; grant-or-reject against the
+            # authoritative ledger like any lease. Worker pinning happens
+            # off the admission thread (it can wait on the pool).
+            if not self.ledger.try_allocate(req):
+                return {
+                    "status": "reject",
+                    "available": self.ledger.avail_map(),
+                }
+            assign = self.accel.allocate(spec.resources)
+            if assign is None:
+                self.ledger.release(req)
+                return {
+                    "status": "reject",
+                    "available": self.ledger.avail_map(),
+                }
+            self._exec_pool.submit(
+                self._activate_task_lease, spec, ("ledger", req, assign)
+            )
             return {"status": "granted"}
         if spec.kind == "task" and spec.deps and not self._args_ready(spec):
             # dependency-aware dispatch: wait for args BEFORE taking
@@ -1835,6 +1893,7 @@ class NodeAgent:
                 {"actor_id": aid, **self._actor_meta.get(aid, {})}
                 for aid in self._actor_workers
             ]
+            held_leases = list(self._task_leases)
         lister = getattr(self.store, "list_objects", None)
         return NodeInfo(
             node_id=self.node_id,
@@ -1845,6 +1904,10 @@ class NodeAgent:
             # store inventory: a restarted head re-seeds its object
             # directory from this, so pre-restart refs keep resolving
             stored_objects=list(lister()) if lister is not None else [],
+            # a restarted head reconciles these against its lease table
+            # and releases any it no longer tracks (pinned-worker leak
+            # guard across unpersisted head restarts)
+            held_task_leases=held_leases,
         )
 
     def _peer(self, node_id: str, address: str) -> RpcClient:
@@ -2136,6 +2199,99 @@ class NodeAgent:
                 "async_actor": req["actor_id"] in self._async_actors,
             }
 
+    # ------------------------------------------------------------------
+    # task leases (worker_lease grants): pin a worker to an owner so it
+    # can stream same-shape tasks caller->worker with no head hop. The
+    # reference's raylet does the same per-task worker lease
+    # (local_lease_manager.h); here the lease is long-lived and
+    # multiplexed, and the head schedules GRANTS, not tasks.
+    # ------------------------------------------------------------------
+    def _activate_task_lease(self, spec: LeaseRequest, alloc) -> None:
+        """Resources are allocated; now pin an idle worker and report the
+        lease (worker address + chip env) to the head, which relays it to
+        the waiting owner."""
+        handle = self._pop_idle_worker(timeout=10.0)
+        if handle is None or self._shutdown:
+            if handle is not None:
+                self._return_worker(handle)
+            self._release(alloc)
+            self._report_to_head(
+                {
+                    "node_id": self.node_id,
+                    "available": self.ledger.avail_map(),
+                    "task_leases": [
+                        {
+                            "lease_id": spec.task_id,
+                            "ok": False,
+                            "reason": "no idle worker",
+                        }
+                    ],
+                }
+            )
+            return
+        with self._lock:
+            handle.lease_id = spec.task_id
+            self._task_leases[spec.task_id] = {
+                "worker_id": handle.worker_id,
+                "alloc": alloc,
+                "owner": spec.client_id,
+                "granted_at": time.monotonic(),
+            }
+            self._lease_stats["granted"] += 1
+        # the lease pins its worker like an actor: backfill 1:1 so the
+        # free pool never shrinks below num_workers (warming spawns count)
+        with self._idle_cv:
+            free = len(self._idle) + self._spawns_pending
+        if free < self._num_workers and not self._shutdown:
+            try:
+                self._spawn_worker()
+            except Exception:  # noqa: BLE001 - report loop backfills later
+                logger.exception("lease backfill spawn failed")
+        self._report_to_head(
+            {
+                "node_id": self.node_id,
+                "available": self.ledger.avail_map(),
+                "task_leases": [
+                    {
+                        "lease_id": spec.task_id,
+                        "ok": True,
+                        "node_id": self.node_id,
+                        "worker_id": handle.worker_id,
+                        "worker_address": handle.client.address,
+                        "accel_env": self._alloc_env(alloc),
+                    }
+                ],
+            }
+        )
+
+    def _h_return_worker_lease(self, req: dict) -> dict:
+        """Release a task lease (owner returned it on queue drain / idle
+        TTL, or the head revoked it): free the shape allocation, tell the
+        worker to drain + drop lease state, and return it to the idle
+        pool."""
+        lease_id = req["lease_id"]
+        with self._lock:
+            entry = self._task_leases.pop(lease_id, None)
+            handle = (
+                self._workers.get(entry["worker_id"]) if entry else None
+            )
+            if entry is not None:
+                self._lease_stats["returned"] += 1
+        if entry is None:
+            return {"ok": False}
+        self._release(entry["alloc"])
+        if handle is not None and handle.lease_id == lease_id:
+            handle.lease_id = None
+            if handle.client is not None:
+                try:
+                    handle.client.call(
+                        "LeaseRelease", {"lease_id": lease_id}, timeout=10.0
+                    )
+                except RpcError:
+                    pass  # dying worker: the death path respawns it
+            self._return_worker(handle)
+        return {"ok": True}
+
     def _forward_to_actor_worker(self, method: str, req: dict) -> Any:
         """Relay a compiled-DAG program RPC to the worker process pinned to
         the actor (the driver only knows the agent's address)."""
@@ -2235,6 +2391,24 @@ class NodeAgent:
                     "spawn_ms_spawn": WORKER_SPAWN_MS.summary(
                         {"path": "spawn"}
                     ),
+                },
+                # task-lease dispatch plane: active leases (who holds
+                # which worker) + grant/return/loss lifecycle counts.
+                # Per-task inflight lives owner-side by design (the whole
+                # point is that the hot path never touches this agent).
+                "dispatch": {
+                    "task_leases": [
+                        {
+                            "lease_id": lid,
+                            "worker_id": e["worker_id"],
+                            "owner": e["owner"],
+                            "age_s": round(
+                                time.monotonic() - e["granted_at"], 1
+                            ),
+                        }
+                        for lid, e in self._task_leases.items()
+                    ],
+                    **self._lease_stats,
                 },
                 "available": self.ledger.avail_map(),
                 "store": self.store.stats(),

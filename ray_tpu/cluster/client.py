@@ -34,6 +34,24 @@ from . import serialization as wire
 from .common import INLINE_OBJECT_MAX, LeaseRequest, new_id
 from .rpc import RpcClient, RpcDeadlineError, RpcError, RpcServer
 
+from ray_tpu.util.metrics import Counter as _Counter
+
+# lease-cached direct dispatch (owner-side): cache effectiveness + the
+# spillbacks that route leased work back through head scheduling
+LEASE_CACHE_HITS = _Counter(
+    "task_lease_cache_hits_total",
+    "Task submissions streamed straight to a cached worker lease.",
+)
+LEASE_CACHE_MISSES = _Counter(
+    "task_lease_cache_misses_total",
+    "Task submissions that took the per-task head path (no usable lease).",
+)
+LEASE_SPILLBACKS = _Counter(
+    "task_lease_spillbacks_total",
+    "Leased tasks re-routed to head scheduling (lease loss, stall "
+    "recall, or worker rejection).",
+)
+
 _BY_VALUE_REGISTERED: set = set()
 
 
@@ -90,7 +108,7 @@ class _RemoteStore:
         t_start = time.monotonic()
         while pending and len(ready) < num_returns:
             # direct-call results resolve locally without a head round trip
-            if self._rt._direct_enabled:
+            if self._rt._push_enabled:
                 still: List[ObjectRef] = []
                 for r in pending:
                     if (
@@ -330,6 +348,561 @@ class _DirectActorChannel:
         with self._cv:
             self._dead = True
             self._cv.notify_all()
+
+
+class _TaskLeaseChannel:
+    """One cached worker lease + its direct submission pipe (task
+    leases). The head granted this owner a pinned worker for one task
+    shape; same-shape tasks stream here in ``LeaseTaskBatch`` windows
+    with no head hop — the reference raylet's worker lease
+    (local_lease_manager.h), held long enough to amortize placement
+    across a whole stream. Execution is strictly sequential worker-side
+    (the lease holds ONE task's resources); ``max_inflight`` is pipeline
+    depth. Results arrive on the runtime's direct-results callback
+    exactly like direct actor calls.
+
+    Liveness by construction: a head-of-line task that blocks (e.g. a
+    rendezvous peer waiting on its siblings) stops the flow of results;
+    after ``task_lease_stall_s`` the channel RECALLS the worker's queued
+    items and spills them — plus its local queue — back to head
+    scheduling, so followers run elsewhere instead of deadlocking behind
+    it. Any transport failure spills everything unresolved the same way
+    (chaos-safe: worker death, node death, breaker-open all land here)."""
+
+    MAX_BATCH = 128
+
+    def __init__(
+        self,
+        runtime: "RemoteRuntime",
+        manager: "_TaskLeaseManager",
+        shape_key: tuple,
+        grant: dict,
+    ):
+        self._rt = runtime
+        self._mgr = manager
+        self.shape_key = shape_key
+        self.lease_id = grant["lease_id"]
+        self.key = f"lease:{self.lease_id}"  # _direct_channels registry key
+        self.node_id = grant.get("node_id")
+        self.max_inflight = max(1, int(grant.get("max_inflight") or 32))
+        self.ttl = max(0.5, float(grant.get("ttl_s") or 5.0))
+        self.accel_env = grant.get("accel_env")
+        self._stall_s = manager.stall_s
+        self._worker = RpcClient(grant["worker_address"])
+        self._q: deque = deque()
+        self._inflight: Dict[str, dict] = {}  # ref hex -> item
+        self._cv = threading.Condition()
+        self.dead = False
+        self._stalled = False
+        now = time.monotonic()
+        self._last_activity = now
+        self._last_send = now
+        self._last_result = now
+        self._last_probe = now
+        self._last_renew = now
+        with runtime._lock:
+            runtime._direct_channels[self.key] = self
+        self._thread = threading.Thread(
+            target=self._loop, name=f"lease-chan-{self.lease_id[:6]}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # lock-free reads (GIL-atomic lens): the manager's pick runs per
+    # submission and must not serialize on the channel lock
+    def depth(self) -> int:
+        return len(self._q) + len(self._inflight)
+
+    def accepting(self) -> bool:
+        return not self.dead and not self._stalled
+
+    def submit(self, item: dict) -> None:
+        with self._cv:
+            if not self.dead:
+                self._q.append(item)
+                self._last_activity = time.monotonic()
+                self._cv.notify()
+                return
+        # spill OUTSIDE self._cv (lock order: runtime._direct_cv may be
+        # taken inside _lease_spill; _h_direct_results holds _direct_cv
+        # while calling on_result, which takes self._cv)
+        self._rt._lease_spill(item)
+
+    def on_result(self, ref_hex: str) -> None:
+        # called under the runtime's _direct_cv; self._cv nests inside it
+        # everywhere (never the reverse)
+        with self._cv:
+            self._inflight.pop(ref_hex, None)
+            now = time.monotonic()
+            self._last_result = now
+            self._last_activity = now
+            self._stalled = False  # results flow again
+            self._cv.notify()
+
+    def take_inflight(self, ref_hex: str) -> Optional[dict]:
+        """Pop one in-flight item (worker handed it back never-started);
+        the caller re-routes it. Frees the pipeline slot like a result."""
+        with self._cv:
+            item = self._inflight.pop(ref_hex, None)
+            if item is not None:
+                self._cv.notify()
+            return item
+
+    def cancel(self, ref_hex: str) -> bool:
+        """Best-effort cancel of a not-yet-running leased task: local
+        queue first, then a targeted recall of the worker's queue."""
+        with self._cv:
+            for it in self._q:
+                if it["ref"] == ref_hex:
+                    self._q.remove(it)
+                    return True
+            owed = ref_hex in self._inflight
+        if not owed or self.dead:
+            return False
+        try:
+            reply = self._worker.call(
+                "LeaseRecall",
+                {"lease_id": self.lease_id, "refs": [ref_hex]},
+                timeout=10.0,
+            )
+        except RpcError:
+            return False
+        if ref_hex in (reply.get("removed") or ()):
+            with self._cv:
+                self._inflight.pop(ref_hex, None)
+                self._cv.notify()
+            return True
+        return False
+
+    def kill_running(self, ref_hex: str) -> bool:
+        """Force-cancel the currently executing leased task by killing
+        its worker (head-path force semantics). The worker's death trips
+        the normal fail-over; the caller pre-seals the cancel so the
+        death spill skips this ref."""
+        if self.dead or ref_hex not in self._inflight:
+            return False
+        try:
+            reply = self._worker.call(
+                "LeaseKillRunning",
+                {"lease_id": self.lease_id, "ref": ref_hex},
+                timeout=10.0,
+            )
+        except RpcError:
+            return False
+        return bool(reply.get("ok"))
+
+    def _loop(self) -> None:
+        rt = self._rt
+        while True:
+            action = None
+            batch: List[dict] = []
+            with self._cv:
+                while action is None:
+                    if self.dead:
+                        return
+                    now = time.monotonic()
+                    window = self.max_inflight - len(self._inflight)
+                    if self._q and window > 0 and not self._stalled:
+                        action = "send"
+                        break
+                    if not self._q and not self._inflight:
+                        if now - self._last_activity > self.ttl:
+                            self.dead = True
+                            action = "retire"
+                            break
+                    elif self._inflight:
+                        quiet = now - max(self._last_result, self._last_send)
+                        # stall budget scales with the outstanding window
+                        # (~stall_s of sequential execution per owed
+                        # task, capped): a deep pipeline draining slowly
+                        # on a loaded host is NOT a wedge — a flat
+                        # threshold spilled flowing work in cascades —
+                        # while a blocked head-of-line with a few
+                        # followers (rendezvous peers) still recalls in
+                        # a few seconds
+                        budget = min(
+                            self._stall_s * max(1, len(self._inflight)),
+                            10.0 * self._stall_s,
+                        )
+                        if quiet > budget and (
+                            len(self._inflight) > 1 or self._q
+                        ):
+                            action = "recall"
+                            break
+                        if (
+                            quiet > 5.0
+                            and now - self._last_probe > 5.0
+                        ):
+                            action = "probe"
+                            break
+                    if self._renew_due(now):
+                        action = "renew"
+                        break
+                    self._cv.wait(timeout=0.25)
+                if action == "send":
+                    n = min(
+                        self.MAX_BATCH,
+                        self.max_inflight - len(self._inflight),
+                    )
+                    while self._q and len(batch) < n:
+                        it = self._q.popleft()
+                        self._inflight[it["ref"]] = it
+                        batch.append(it)
+                    self._last_send = time.monotonic()
+            try:
+                if action == "retire":
+                    self._teardown(spill=False)
+                    return
+                if action == "send":
+                    req = {
+                        "lease_id": self.lease_id,
+                        "client_addr": rt._callback_address(),
+                        "items": [
+                            {
+                                k: v
+                                for k, v in it.items()
+                                if not k.startswith("_")
+                            }
+                            for it in batch
+                        ],
+                    }
+                    if self.accel_env:
+                        req["accel_env"] = self.accel_env
+                    accepts = self._worker.call(
+                        "LeaseTaskBatch", req, timeout=60.0
+                    )
+                    rejected = []
+                    released = False
+                    with self._cv:
+                        for it, status in zip(batch, accepts):
+                            if status != "accepted":
+                                self._inflight.pop(it["ref"], None)
+                                rejected.append(it)
+                                released = released or status == "released"
+                    for it in rejected:
+                        rt._lease_spill(it)
+                    if released:
+                        # "released" is lease-level, not per-item: the
+                        # worker-side lease is gone for good — a channel
+                        # left alive would absorb every future same-shape
+                        # task into a worker-RPC-then-spill loop
+                        self._drain_then_fail()
+                        return
+                elif action == "recall":
+                    # head-of-line wedged: pull queued work back and let
+                    # the head place it on other workers; the running
+                    # task keeps its slot until it completes
+                    reply = self._worker.call(
+                        "LeaseRecall", {"lease_id": self.lease_id},
+                        timeout=10.0,
+                    )
+                    recalled: List[dict] = []
+                    with self._cv:
+                        for ref in reply.get("removed") or ():
+                            it = self._inflight.pop(ref, None)
+                            if it is not None:
+                                recalled.append(it)
+                        recalled.extend(self._q)
+                        self._q.clear()
+                        self._stalled = True  # until a result arrives
+                    for it in recalled:
+                        rt._lease_spill(it)
+                elif action == "probe":
+                    # small retry budget: a loaded-but-alive worker must
+                    # not fail the whole lease over one slow ping (a
+                    # spurious fail_over ERRORS max_retries=0 tasks)
+                    self._worker.call("Ping", timeout=5.0, retries=2)
+                    self._last_probe = time.monotonic()
+                if action in ("send", "recall", "renew"):
+                    self._maybe_renew()
+            except RpcError:
+                if batch:
+                    # the batch whose SEND failed was (almost certainly)
+                    # never delivered: respill it as never-started —
+                    # at-least-once for mid-flight batches, the
+                    # _DirectActorChannel convention. Only items a
+                    # PREVIOUS batch delivered can be mid-execution;
+                    # _fail_over labels those may-have-run.
+                    with self._cv:
+                        for it in batch:
+                            self._inflight.pop(it["ref"], None)
+                    for it in batch:
+                        rt._lease_spill(it)
+                self._fail_over()
+                return
+
+    def _renew_due(self, now: float) -> bool:
+        return (
+            bool(self._q or self._inflight)
+            and now - self._last_renew >= self.ttl / 2.0
+        )
+
+    def _maybe_renew(self) -> None:
+        now = time.monotonic()
+        if now - self._last_renew >= self.ttl / 2.0:
+            self._last_renew = now
+            self._rt._sender.enqueue(
+                "lease_renew",
+                {
+                    "lease_ids": [self.lease_id],
+                    "client_id": self._rt.client_id,
+                },
+            )
+
+    def on_killed(self) -> None:
+        """We deliberately killed the leased worker (force-cancel of its
+        running task). The FIFO is sequential, so nothing else was
+        executing: every other unresolved item is never-started by
+        construction and respills; the pre-sealed victim is skipped by
+        the spill idempotence guard."""
+        with self._cv:
+            if self.dead:
+                return
+            self.dead = True
+            items = list(self._inflight.values())
+            self._inflight.clear()
+            queued = list(self._q)
+            self._q.clear()
+        seen = set()
+        for it in items + queued:
+            if it["ref"] not in seen:
+                seen.add(it["ref"])
+                self._rt._lease_spill(it)
+        self._teardown(spill=False)
+
+    def _drain_then_fail(self) -> None:
+        """The lease was released under us but the WORKER is alive: it
+        is pushing 'spill' results for the items it never started and
+        the running item's real result. Those pushes — not our local
+        guess — decide never-started vs may-have-run, so wait for the
+        in-flight set to drain before failing over whatever never
+        arrived (a lost push, rare). Racing _fail_over immediately used
+        to mislabel ~a whole window of never-started max_retries=0
+        tasks as may-have-run and permanently fail them."""
+        with self._cv:
+            self.dead = True
+            deadline = time.monotonic() + 5.0
+            while self._inflight and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.25)
+        self._fail_over()
+
+    def _fail_over(self) -> None:
+        """Worker unreachable: everything unresolved re-routes through
+        head scheduling, and the lease is returned so a still-alive
+        worker behind a transient partition is unpinned."""
+        with self._cv:
+            self.dead = True
+            items = list(self._inflight.values())
+            self._inflight.clear()
+            queued = list(self._q)
+            self._q.clear()
+        seen = set()
+        for it in items:
+            if it["ref"] not in seen:
+                seen.add(it["ref"])
+                # in-flight at failure: the worker MAY have started it
+                self._rt._lease_spill(it, may_have_run=True)
+        for it in queued:
+            if it["ref"] not in seen:
+                seen.add(it["ref"])
+                self._rt._lease_spill(it)
+        self._teardown(spill=False)
+
+    def _teardown(self, spill: bool) -> None:
+        if spill:
+            self._fail_over()
+            return
+        self._mgr._drop_channel(self.shape_key, self)
+        self._rt._drop_direct_channel(self.key, self)
+        try:
+            self._rt._sender.enqueue(
+                "lease_return",
+                {"lease_id": self.lease_id, "node_id": self.node_id},
+            )
+        except Exception:  # noqa: BLE001 - sender already stopped
+            pass
+        try:
+            self._worker.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def stop(self) -> None:
+        """Shutdown path: spill nothing (the runtime is going away), but
+        hand the lease back so the worker returns to its pool."""
+        with self._cv:
+            if self.dead:
+                return
+            self.dead = True
+            self._cv.notify_all()
+        self._teardown(spill=False)
+
+
+class _TaskLeaseManager:
+    """Owner-side lease cache keyed by task shape (fn hash x resource
+    demand x runtime-env signature). A shape turns hot on its second
+    submission (one-off tasks never pin workers); the cache then grows
+    one lease at a time — up to ``task_lease_max_per_shape`` — while its
+    queues run deeper than one pipeline window. Tasks that find no
+    accepting lease take the per-task head path (a miss, never a
+    stall)."""
+
+    WARMUP = 2  # misses before the first grant request for a shape
+
+    def __init__(self, runtime: "RemoteRuntime"):
+        from ray_tpu.config import cfg
+
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._shapes: Dict[tuple, dict] = {}
+        self._stopped = False
+        self.max_inflight = max(1, int(cfg.task_lease_max_inflight))
+        self.max_per_shape = max(1, int(cfg.task_lease_max_per_shape))
+        self.stall_s = float(cfg.task_lease_stall_s)
+        # local queueing beyond this overflows to the head path instead —
+        # a memory/latency bound, not a throughput lever: queued items
+        # cost one dict entry each, lease loss spills them, and the stall
+        # recall pulls them off a wedged worker, so the bound can sit
+        # well above the pipeline window (a submit burst should ride the
+        # leases it warmed, not fall off them)
+        self.queue_cap = 16 * self.max_inflight
+
+    def submit(self, item: dict, shape_key: tuple) -> bool:
+        """True = streamed to a cached lease (caller is done); False =
+        no usable lease (caller takes the head path)."""
+        rt = self._rt
+        with self._lock:
+            if self._stopped:
+                return False
+            ent = self._shapes.get(shape_key)
+            if ent is None:
+                if len(self._shapes) > 512:
+                    # cold-shape pruning: drivers minting closures in a
+                    # loop get a fresh fn_id (and shape entry) each time —
+                    # entries with no lease and no grant in flight are
+                    # just counters and can go
+                    now = time.monotonic()
+                    for k in list(self._shapes):
+                        e = self._shapes[k]
+                        if (
+                            not e["channels"]
+                            and not e["granting"]
+                            and now > e["cooldown_until"]
+                        ):
+                            del self._shapes[k]
+                ent = self._shapes[shape_key] = {
+                    "channels": [],
+                    "granting": 0,
+                    "cooldown_until": 0.0,
+                    "misses": 0,
+                    "resources": dict(item["_resources"]),
+                    "fn_id": item["fn_id"],
+                }
+            chans = [c for c in ent["channels"] if not c.dead]
+            if len(chans) != len(ent["channels"]):
+                ent["channels"] = chans
+            chan = None
+            for c in chans:
+                if c.accepting() and c.depth() < self.queue_cap:
+                    if chan is None or c.depth() < chan.depth():
+                        chan = c
+            if chan is None:
+                ent["misses"] += 1
+                if ent["misses"] >= self.WARMUP or chans:
+                    self._maybe_grant_locked(ent, shape_key)
+            elif (
+                chan.depth() >= self.max_inflight
+                and len(chans) + ent["granting"] < self.max_per_shape
+            ):
+                # one full pipeline window queued: grow while we stream
+                self._maybe_grant_locked(ent, shape_key)
+        if chan is None:
+            rt.metrics["lease_cache_misses"] += 1
+            LEASE_CACHE_MISSES.inc()
+            return False
+        rt.metrics["lease_cache_hits"] += 1
+        LEASE_CACHE_HITS.inc()
+        # pin args + register the pending ref BEFORE the channel sees the
+        # item (same contract as direct actor calls: the result handler
+        # releases these)
+        from ray_tpu.core.refcount import TRACKER
+
+        ids = item["arg_ids"]
+        with rt._direct_cv:
+            for h in ids:
+                TRACKER.incref(h)
+            rt._direct_pending[item["ref"]] = chan.key
+            if ids:
+                rt._direct_arg_pins[item["ref"]] = ids
+        chan.submit(item)
+        return True
+
+    def _maybe_grant_locked(self, ent: dict, shape_key: tuple) -> None:
+        """Caller holds self._lock."""
+        if self._stopped:
+            return
+        if len(ent["channels"]) + ent["granting"] >= self.max_per_shape:
+            return
+        if time.monotonic() < ent["cooldown_until"]:
+            return
+        ent["granting"] += 1
+        threading.Thread(
+            target=self._grant,
+            args=(shape_key, dict(ent["resources"]), ent["fn_id"]),
+            name="lease-grant",
+            daemon=True,
+        ).start()
+
+    def _grant(self, shape_key: tuple, resources: dict, fn_id: str) -> None:
+        reply = None
+        try:
+            reply = self._rt.head.call(
+                "GrantTaskLease",
+                {
+                    "resources": resources,
+                    "fn_id": fn_id,
+                    "client_id": self._rt.client_id,
+                    "timeout": 10.0,
+                },
+                timeout=40.0,
+            )
+        except Exception:  # noqa: BLE001 - head unreachable: cooldown
+            pass
+        dangling = None  # granted after the runtime stopped: hand it back
+        with self._lock:
+            ent = self._shapes.get(shape_key)
+            if ent is not None:
+                ent["granting"] -= 1
+            if self._stopped and reply and reply.get("granted"):
+                dangling = reply
+                reply = None
+            if ent is None:
+                pass
+            elif reply and reply.get("granted"):
+                chan = _TaskLeaseChannel(self._rt, self, shape_key, reply)
+                ent["channels"].append(chan)
+            else:
+                ent["cooldown_until"] = time.monotonic() + 2.0
+        if dangling is not None:
+            try:
+                self._rt._sender.enqueue(
+                    "lease_return",
+                    {
+                        "lease_id": dangling["lease_id"],
+                        "node_id": dangling.get("node_id"),
+                    },
+                )
+            except Exception:  # noqa: BLE001 - sender stopped too
+                pass
+
+    def _drop_channel(self, shape_key: tuple, chan) -> None:
+        with self._lock:
+            ent = self._shapes.get(shape_key)
+            if ent is not None and chan in ent["channels"]:
+                ent["channels"].remove(chan)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
 
 
 class RemoteActorHandle:
@@ -572,6 +1145,28 @@ class RemoteRuntime:
         # env before connect() to change them for a runtime.
         self._trace_autostart = cfg.trace_tasks
         self._direct_wait_fallback_s = cfg.direct_wait_fallback_s
+        # lease-cached direct task dispatch (RAY_TPU_TASK_LEASES=0 kills
+        # it: every task rides the per-task head path). Leased-task
+        # results arrive on the same push channel as direct actor calls,
+        # so the result-cache paths check the union flag.
+        self._lease_enabled = cfg.task_leases
+        self._push_enabled = self._direct_enabled or self._lease_enabled
+        self._lease_mgr = (
+            _TaskLeaseManager(self) if self._lease_enabled else None
+        )
+        # shape-key env signature for the runtime-level env, computed
+        # once (per-task envs are rare; the runtime env applies to every
+        # submission and must not be re-serialized per task)
+        import json as _json
+
+        self._base_env_sig = (
+            _json.dumps(self.runtime_env, sort_keys=True, default=str)
+            if self.runtime_env
+            else None
+        )
+        self.metrics.update(
+            lease_cache_hits=0, lease_cache_misses=0, lease_spillbacks=0
+        )
         # one cloudpickle of each task function per function OBJECT (weak:
         # dead lambdas drop their blobs); see _serialize_fn
         import weakref
@@ -691,6 +1286,44 @@ class RemoteRuntime:
         trace = spec.trace or tracing.child_context(
             spec.task_id, self._trace_autostart
         )
+        merged_env = (
+            {**(self.runtime_env or {}), **spec.runtime_env}
+            if spec.runtime_env
+            else self.runtime_env
+        )
+        if self._lease_mgr is not None and self._leasable(spec, merged_env):
+            item = {
+                "task_id": spec.task_id,
+                "ref": spec.returns[0].hex,
+                "payload": payload,
+                "arg_ids": sorted(arg_ids),
+                "name": spec.name,
+                "client_id": self.client_id,
+                "trace": trace,
+                "fn_blob": fn_blob,
+                "fn_id": fn_id,
+                "fn_cache": fn_cacheable,
+                "runtime_env": merged_env,
+                # client-local fields (stripped from the wire): enough to
+                # rebuild a head-path LeaseRequest on spillback
+                "_resources": dict(spec.resources),
+                "_max_retries": spec.max_retries,
+            }
+            if spec.runtime_env:
+                import json
+
+                env_sig = json.dumps(
+                    merged_env, sort_keys=True, default=str
+                )
+            else:
+                env_sig = self._base_env_sig
+            shape_key = (
+                fn_id,
+                tuple(sorted(spec.resources.items())),
+                env_sig,
+            )
+            if self._lease_mgr.submit(item, shape_key):
+                return spec.returns
         lease = LeaseRequest(
             task_id=spec.task_id,
             name=spec.name,
@@ -701,11 +1334,7 @@ class RemoteRuntime:
             max_retries=spec.max_retries,
             retry_exceptions=spec.retry_exceptions,
             strategy=spec.strategy,
-            runtime_env=(
-                {**(self.runtime_env or {}), **spec.runtime_env}
-                if spec.runtime_env
-                else self.runtime_env
-            ),
+            runtime_env=merged_env,
             arg_ids=sorted(arg_ids),
             deps=deps,
             client_id=self.client_id,
@@ -718,6 +1347,30 @@ class RemoteRuntime:
         self._sender.enqueue("lease", lease)
         self._flusher.note_registered(lease.return_ids)
         return spec.returns
+
+    @staticmethod
+    def _leasable(spec: TaskSpec, merged_env: Optional[dict]) -> bool:
+        """A task qualifies for lease-cached direct dispatch when nothing
+        about it needs head-side routing or bookkeeping: no placement
+        constraint, no top-level ObjectRef args (dependency-aware
+        dispatch is the agent's job), a single return, no streaming, no
+        exception-retry budget (worker/node-death retries are covered by
+        spillback), and no pip/uv/conda env (those need dedicated
+        interpreter workers)."""
+        if spec.strategy is not None or getattr(spec, "streaming", False):
+            return False
+        if len(spec.returns) != 1 or spec.retry_exceptions:
+            return False
+        if any(isinstance(a, ObjectRef) for a in spec.args) or any(
+            isinstance(v, ObjectRef) for v in spec.kwargs.values()
+        ):
+            return False
+        if merged_env:
+            from .pip_env import has_env
+
+            if has_env(merged_env):
+                return False
+        return True
 
     def stream_next(
         self, task_id: str, index: int, timeout: Optional[float]
@@ -954,9 +1607,16 @@ class RemoteRuntime:
         unpin: List[str] = []
         uploads: List[tuple] = []  # evicted owner-held objects → head
         register: List[str] = []  # head-sealed results: holder is on books
+        spills: List[str] = []  # leased tasks handed back never-started
         with self._direct_cv:
             for r in results:
                 h = r["ref"]
+                if r.get("status") == "spill":
+                    # lease released under a queued task: the worker
+                    # never started it — re-route through the head
+                    # (outside this lock; the channel still holds it)
+                    spills.append(h)
+                    continue
                 if "deferred_seal" not in r:
                     # the worker sealed this one to the head (error, big
                     # value, ref-containing result, or deferred seals
@@ -1023,6 +1683,16 @@ class RemoteRuntime:
                         chan.on_result(h)
                 unpin.extend(self._direct_arg_pins.pop(h, ()))
             self._direct_cv.notify_all()
+        for h in spills:
+            key = self._direct_pending.get(h)
+            chan = (
+                self._direct_channels.get(key)
+                if isinstance(key, str) and key.startswith("lease:")
+                else None
+            )
+            item = chan.take_inflight(h) if chan is not None else None
+            if item is not None:
+                self._lease_spill(item)
         if register:
             self._flusher.note_registered_live(register)
         for ev, data, contained in uploads:
@@ -1123,6 +1793,69 @@ class RemoteRuntime:
         self._flusher.note_registered_live([item["ref"]])
         # the lease (queued before this release can flush) pins the args
         # head-side for the task's lifetime
+        for h in unpin:
+            TRACKER.decref(h)
+
+    def _lease_spill(self, item: dict, may_have_run: bool = False) -> None:
+        """Route a leased task back through per-task head scheduling
+        (lease loss, stall recall, worker rejection) — the direct-path
+        analog of ``_fallback_submit``. Idempotent per ref: a result that
+        raced in (or an earlier spill) already cleared the pending entry,
+        and re-submitting then would just re-execute for nothing.
+
+        ``may_have_run``: the item was in flight when its lease died, so
+        the worker may have (partially) executed it. A task with no
+        retry budget then FAILS instead of re-running — the head path's
+        worker-death semantics for max_retries=0 (at-most-once held)."""
+        from ray_tpu.core.refcount import TRACKER
+
+        with self._direct_cv:
+            if item["ref"] not in self._direct_pending:
+                return  # already resolved or already spilled
+            self._direct_pending.pop(item["ref"], None)
+            self._shared_pending.discard(item["ref"])
+            unpin = self._direct_arg_pins.pop(item["ref"], ())
+            fail = may_have_run and int(item.get("_max_retries", 0)) <= 0
+            if fail:
+                self._direct_results[item["ref"]] = (
+                    "err",
+                    pickle.dumps(
+                        RuntimeError(
+                            f"worker died running {item['name']} "
+                            "(max_retries=0: not re-executed)"
+                        )
+                    ),
+                )
+                self._direct_results_order.append(item["ref"])
+            self._direct_cv.notify_all()
+        if fail:
+            for h in unpin:
+                TRACKER.decref(h)
+            return
+        lease = LeaseRequest(
+            task_id=item["task_id"],
+            name=item["name"],
+            payload=item["payload"],
+            return_ids=[item["ref"]],
+            resources=dict(item["_resources"]),
+            kind="task",
+            max_retries=item["_max_retries"],
+            arg_ids=item["arg_ids"],
+            deps=[],
+            client_id=self.client_id,
+            trace=item.get("trace"),
+            fn_blob=item["fn_blob"],
+            fn_id=item["fn_id"],
+            fn_cache=item["fn_cache"],
+            runtime_env=item.get("runtime_env"),
+        )
+        self._sender.enqueue("lease", lease)
+        self.metrics["lease_spillbacks"] += 1
+        LEASE_SPILLBACKS.inc()
+        # the lease registers us as the return's holder head-side — the
+        # local release is owed from now on; the queued lease re-pins the
+        # args head-side before this unpin can flush
+        self._flusher.note_registered_live([item["ref"]])
         for h in unpin:
             TRACKER.decref(h)
 
@@ -1391,7 +2124,7 @@ class RemoteRuntime:
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
         h = ref.hex
-        if self._direct_enabled and (
+        if self._push_enabled and (
             h in self._direct_pending or h in self._direct_results
         ):
             entry = self._wait_direct(h, deadline)
@@ -1402,7 +2135,7 @@ class RemoteRuntime:
         while True:
             # a deferred (owner-held) result can land locally while we're
             # polling a head that will never hear of the object
-            if self._direct_enabled:
+            if self._push_enabled:
                 with self._direct_cv:
                     entry = self._direct_results.get(h)
                 if entry is not None:
@@ -1459,7 +2192,7 @@ class RemoteRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         results: Dict[str, tuple] = {}  # hex -> ("val", v) | ("err", exc)
         order = [r.hex for r in refs]
-        if self._direct_enabled:
+        if self._push_enabled:
             for h in dict.fromkeys(order):
                 if h in self._direct_pending or h in self._direct_results:
                     try:
@@ -1476,7 +2209,7 @@ class RemoteRuntime:
             unresolved = list(dict.fromkeys(h for h in order if h not in results))
             if not unresolved:
                 break
-            if self._direct_enabled:
+            if self._push_enabled:
                 # late-arriving owner-held results resolve locally; the
                 # head may never hear of those objects
                 for h in unresolved:
@@ -1558,8 +2291,47 @@ class RemoteRuntime:
         return out
 
     def cancel_object(self, ref: ObjectRef, force: bool = False) -> bool:
+        h = ref.hex
+        if self._lease_mgr is not None:
+            key = self._direct_pending.get(h)
+            chan = (
+                self._direct_channels.get(key)
+                if isinstance(key, str) and key.startswith("lease:")
+                else None
+            )
+            cancelled = chan is not None and chan.cancel(h)
+            killed = False
+            if not cancelled and chan is not None and force:
+                # running on the leased worker: force semantics = kill
+                # the worker (the head's force path for its own tasks);
+                # pre-sealing below makes the death spill skip this ref
+                cancelled = killed = chan.kill_running(h)
+            if cancelled:
+                # sealed locally: the head never knew this task existed
+                from ray_tpu.core.refcount import TRACKER
+
+                unpin = ()
+                with self._direct_cv:
+                    if h in self._direct_pending:
+                        self._direct_pending.pop(h, None)
+                        self._shared_pending.discard(h)
+                        unpin = self._direct_arg_pins.pop(h, ())
+                        self._direct_results[h] = (
+                            "err",
+                            pickle.dumps(RuntimeError("task cancelled")),
+                        )
+                        self._direct_results_order.append(h)
+                        self._direct_cv.notify_all()
+                for p in unpin:
+                    TRACKER.decref(p)
+                if killed:
+                    # retire the channel NOW (its worker is dying): the
+                    # other unresolved items respill as never-started
+                    # instead of racing the death into may-have-run
+                    chan.on_killed()
+                return True
         reply = self.head.call(
-            "CancelLease", {"object_id": ref.hex, "force": force}
+            "CancelLease", {"object_id": h, "force": force}
         )
         return bool(reply.get("cancelled"))
 
@@ -1660,8 +2432,10 @@ class RemoteRuntime:
     def shutdown(self) -> None:
         from ray_tpu.core import refcount
 
+        if self._lease_mgr is not None:
+            self._lease_mgr.stop()  # no new grants/channels from here on
         for chan in list(self._direct_channels.values()):
-            chan.stop()
+            chan.stop()  # lease channels also enqueue their lease_return
         self._direct_channels.clear()
         if self._callback_server is not None:
             self._callback_server.stop()

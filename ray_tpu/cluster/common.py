@@ -56,9 +56,14 @@ class NodeInfo:
     hosted_actors: List[dict] = field(default_factory=list)
     # (object_id, size) inventory of this node's store — a restarted head
     # re-seeds its object directory from these, so refs minted before the
-    # restart keep resolving (the directory died with the old head; the
+    # restart resolve (the directory died with the old head; the
     # bytes didn't)
     stored_objects: List[Tuple[str, int]] = field(default_factory=list)
+    # task-lease ids whose worker this agent still has pinned — a restarted
+    # head reconciles these against its (possibly unpersisted) lease table
+    # and releases any it no longer tracks, so leased workers never stay
+    # pinned to a lease the control plane forgot
+    held_task_leases: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -71,7 +76,9 @@ class LeaseRequest:
     payload: bytes  # cloudpickled (func, args, kwargs); (args, kwargs) when fn_blob set
     return_ids: List[str]
     resources: Dict[str, float]
-    kind: str = "task"  # task | actor_creation | actor_method
+    # worker_lease: not a task — a request to pin one worker + this
+    # resource shape for an owner's direct task dispatch (task leases)
+    kind: str = "task"  # task | actor_creation | actor_method | worker_lease
     actor_id: Optional[str] = None
     max_retries: int = 3
     retry_exceptions: bool = False

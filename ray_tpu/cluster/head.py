@@ -66,6 +66,7 @@ MAX_BATCH = cfg.sched_max_batch
 
 
 from ray_tpu.util.metrics import Counter as _MetricCounter
+from ray_tpu.util.metrics import Histogram as _MetricHistogram
 
 # best-effort callbacks the head dropped (chaos runs watch this: a swallowed
 # recovery error is invisible in logs at default level but not in metrics)
@@ -73,6 +74,30 @@ HEAD_DROPPED_CALLBACKS = _MetricCounter(
     "head_dropped_callbacks",
     "Best-effort head-side callbacks that raised and were swallowed.",
     label_names=("callable",),
+)
+
+# scheduler-loop round latency (until now only sched_rounds counted; a
+# slow round — XLA bring-up, deep batch — was invisible)
+SCHED_ROUND_MS = _MetricHistogram(
+    "sched_round_ms",
+    "Head scheduler loop round latency in ms (rounds with work only).",
+    boundaries=(0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 5000),
+)
+
+# task-lease lifecycle (lease-cached direct dispatch: the head grants
+# worker leases to owners; tasks stream caller->worker off the head path)
+TASK_LEASE_GRANTED = _MetricCounter(
+    "task_lease_granted_total",
+    "Worker leases granted to task owners for direct dispatch.",
+)
+TASK_LEASE_RETURNED = _MetricCounter(
+    "task_lease_returned_total",
+    "Worker leases returned by their owners (queue drain / idle TTL).",
+)
+TASK_LEASE_REVOKED = _MetricCounter(
+    "task_lease_revoked_total",
+    "Worker leases revoked by the head (worker/node death, TTL expiry, "
+    "owner disconnect).",
 )
 
 
@@ -193,6 +218,14 @@ class HeadServer:
         # of parking forever on a stream that will never reappear
         self._stream_tombstones: set = set()
         self._stream_tombstone_order: deque = deque()
+        # task-lease table (lease-cached direct dispatch): lease_id ->
+        # {state: granting|active, resources, client_id, fn_id, node_id,
+        #  worker_address, worker_id, accel_env, expires_at, abandoned}.
+        # Active entries persist in the snapshot/WAL so TTL expiry and
+        # revoke-on-death survive a head restart (owners keep streaming
+        # to their leased workers regardless — the head is off that path).
+        self._task_leases: Dict[str, dict] = {}
+        self._grant_gate = threading.BoundedSemaphore(8)
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
         self._named_actors: Dict[str, str] = {}
@@ -235,6 +268,9 @@ class HeadServer:
             "leases_spilled_back": 0,
             "sched_rounds": 0,
             "nodes_dead": 0,
+            "task_leases_granted": 0,
+            "task_leases_returned": 0,
+            "task_leases_revoked": 0,
         }
 
         self._dispatch_pool = ThreadPoolExecutor(
@@ -256,6 +292,7 @@ class HeadServer:
             "StreamAbandon": self._h_stream_abandon,
             "FreeObjects": self._h_free_objects,
             "RefUpdate": lambda r: self._h_ref_update(r, src="direct"),
+            "GrantTaskLease": self._h_grant_task_lease,
             "CreateActor": self._h_create_actor,
             "GetActor": self._h_get_actor,
             "WaitActor": self._h_wait_actor,
@@ -350,6 +387,13 @@ class HeadServer:
                     for lid, spec in self._leases.items()
                     if spec.kind == "task" and spec.return_ids
                 },
+                # active task leases: TTL expiry / revoke-on-death keep
+                # working across a restart (owners stream direct anyway)
+                "task_leases": [
+                    self._lease_snapshot_row(e)
+                    for e in self._task_leases.values()
+                    if e["state"] == "active"
+                ],
             } | streams_part
 
     def _snapshot_streams(self) -> dict:
@@ -453,6 +497,10 @@ class HeadServer:
                 entry.inline = blob
                 entry.size = len(blob)
             entry.event.set()
+        now_m = time.monotonic()
+        ttl = cfg.task_lease_ttl_s
+        for row in snap.get("task_leases", []):
+            self._restore_task_lease(row, now_m, ttl)
         for actor_id, fields in snap.get("actors", {}).items():
             info = ActorInfo(**fields)
             # hosting agents re-register and re-attach; until then, unknown
@@ -490,6 +538,12 @@ class HeadServer:
                         and self._named_actors.get(info.name) == rec[1]
                     ):
                         del self._named_actors[info.name]
+            elif kind == "task_lease":
+                self._restore_task_lease(
+                    rec[1], time.monotonic(), cfg.task_lease_ttl_s
+                )
+            elif kind == "task_lease_gone":
+                self._task_leases.pop(rec[1], None)
         logger.info(
             "recovered head state: %d kv keys, %d actors, %d jobs, "
             "%d WAL records",
@@ -508,6 +562,17 @@ class HeadServer:
                 name="head-actor-recover",
                 daemon=True,
             ).start()
+
+    def _restore_task_lease(self, row: dict, now_m: float, ttl: float) -> None:
+        """Rebuild one persisted lease row (expiry rebased onto this
+        process's monotonic clock; at least one TTL of grace so live
+        owners get a renewal in before the sweep runs)."""
+        e = dict(row)
+        remaining = float(e.pop("ttl_remaining_s", 0.0))
+        e["state"] = "active"
+        e["abandoned"] = False
+        e["expires_at"] = now_m + max(remaining, ttl)
+        self._task_leases[e["lease_id"]] = e
 
     def _recover_orphan_actors(self, grace_s: float = 10.0) -> None:
         time.sleep(grace_s)
@@ -664,6 +729,24 @@ class HeadServer:
                     for oid, size in info.stored_objects
                 ]
             )
+        # task-lease reconciliation: leases the agent still holds that
+        # this head no longer tracks (unpersisted restart, WAL window)
+        # are released so their workers don't stay pinned forever
+        for lid in getattr(info, "held_task_leases", ()) or ():
+            with self._lock:
+                known = lid in self._task_leases
+                if known:
+                    # re-learn the hosting node (snapshot rows survive,
+                    # but a row restored before agents re-registered may
+                    # predate a node-id change)
+                    self._task_leases[lid]["node_id"] = info.node_id
+            if not known:
+                logger.info(
+                    "agent %s holds unknown task lease %s; releasing",
+                    info.node_id,
+                    lid[:8],
+                )
+                self._agent_return_lease(info.node_id, lid)
         logger.info("node %s registered at %s", info.node_id, info.address)
         return {"node_id": info.node_id, "head_address": self.address}
 
@@ -753,6 +836,7 @@ class HeadServer:
                 )
                 self._on_node_death(nid)
             self._gc_idle_streams()
+            self._expire_task_leases()
 
     def _on_node_death(self, node_id: str) -> None:
         with self._cond:
@@ -777,6 +861,18 @@ class HeadServer:
             dead_actors = [
                 a for a in self._actors.values() if a.node_id == node_id
             ]
+            # task leases on the dead node: revoke (the owners' channels
+            # discover via RPC failure and spill their queues to the
+            # per-task head path — chaos-safe by construction)
+            dead_leases = [
+                lid
+                for lid, e in self._task_leases.items()
+                if e.get("node_id") == node_id
+            ]
+            for lid in dead_leases:
+                self._drop_task_lease_locked(lid)
+                self.metrics["task_leases_revoked"] += 1
+                TASK_LEASE_REVOKED.inc()
             self._cond.notify_all()
         # in-flight leases on the dead node: retry or fail
         requeued = set()
@@ -792,6 +888,20 @@ class HeadServer:
             self._restart_or_kill_actor(info, f"node {node_id} died")
 
     def _retry_or_fail(self, spec: LeaseRequest, reason: str) -> None:
+        if spec.kind == "worker_lease":
+            # a grant lost in flight (agent unreachable / node died):
+            # drop the table row — the waiting owner's long-poll returns
+            # "grant failed" and it stays on the per-task head path
+            with self._cond:
+                e = self._task_leases.get(spec.task_id)
+                was_active = e is not None and e["state"] == "active"
+                self._drop_task_lease_locked(spec.task_id)
+                if was_active:
+                    self.metrics["task_leases_revoked"] += 1
+                    TASK_LEASE_REVOKED.inc()
+                self._cond.notify_all()
+            self._wal_flush()
+            return
         if spec.kind == "actor_creation":
             # a creation lease lost to node death / unreachable agent is a
             # SCHEDULING failure, not an actor failure: reschedule without
@@ -1072,6 +1182,8 @@ class HeadServer:
                     spec.return_ids,
                     RuntimeError(fail.get("reason", "worker failure")),
                 )
+        if req.get("task_leases"):
+            self._apply_task_lease_reports(req["task_leases"])
         for actor_ready in req.get("actors_alive", []):
             self._mark_actor_alive(**actor_ready)
         for actor_dead in req.get("actors_dead", []):
@@ -1654,6 +1766,236 @@ class HeadServer:
                 _best_effort(self._h_create_actor, payload)
             elif kind == "kill_actor":
                 _best_effort(self._h_kill_actor, payload)
+            elif kind == "lease_renew":
+                _best_effort(self._h_lease_renew, payload)
+            elif kind == "lease_return":
+                _best_effort(self._h_lease_return, payload)
+
+    # ------------------------------------------------------------------
+    # task leases (lease-cached direct dispatch): the head schedules
+    # LEASE GRANTS through the same batched kernel that places tasks —
+    # a worker_lease spec rides the pending queue, the kernel picks its
+    # node, the agent allocates the shape + pins a worker, and the
+    # activation report closes the loop back to the waiting owner. From
+    # then on the owner streams same-shape tasks straight to the leased
+    # worker; the head only sees renewals, the eventual return, and the
+    # batched seal reports that keep its object directory authoritative.
+    # ------------------------------------------------------------------
+    def _h_grant_task_lease(self, req: dict) -> dict:
+        """Owner requests a cacheable worker lease for a task shape.
+        Long-polls until the grant activates (or the window closes — the
+        owner keeps using the per-task head path and may retry)."""
+        if not cfg.task_leases:
+            return {"granted": False, "reason": "task leases disabled"}
+        # bound concurrent grant long-polls: each occupies an RPC server
+        # thread for up to its window, and a burst of cold shapes against
+        # a full cluster must not starve ReportSeals/ClientBatch/renewal
+        # traffic out of the pool — rejected grants fail fast and the
+        # owner's cooldown retries later
+        if not self._grant_gate.acquire(blocking=False):
+            return {"granted": False, "reason": "grant queue full"}
+        try:
+            return self._grant_task_lease_inner(req)
+        finally:
+            self._grant_gate.release()
+
+    def _grant_task_lease_inner(self, req: dict) -> dict:
+        resources = dict(req.get("resources") or {})
+        lease_id = new_id()
+        ttl = cfg.task_lease_ttl_s
+        spec = LeaseRequest(
+            task_id=lease_id,
+            name=f"worker_lease:{(req.get('fn_id') or '')[:8]}",
+            payload=b"",
+            return_ids=[],
+            resources=resources,
+            kind="worker_lease",
+            max_retries=0,
+            client_id=req.get("client_id", ""),
+        )
+        with self._cond:
+            self._task_leases[lease_id] = {
+                "lease_id": lease_id,
+                "state": "granting",
+                "resources": resources,
+                "client_id": spec.client_id,
+                "fn_id": req.get("fn_id", ""),
+                "node_id": None,
+                "worker_address": None,
+                "worker_id": None,
+                "accel_env": None,
+                "expires_at": time.monotonic() + max(3.0 * ttl, 15.0),
+                "abandoned": False,
+            }
+            self._leases[lease_id] = spec
+            self._pending.append(spec)
+            self._cond.notify_all()
+        deadline = time.monotonic() + min(
+            float(req.get("timeout") or 10.0), 30.0
+        )
+        with self._cond:
+            while True:
+                e = self._task_leases.get(lease_id)
+                if e is None:
+                    return {
+                        "granted": False,
+                        "reason": "grant failed (no worker available)",
+                    }
+                if e["state"] == "active":
+                    return {
+                        "granted": True,
+                        "lease_id": lease_id,
+                        "node_id": e["node_id"],
+                        "worker_address": e["worker_address"],
+                        "accel_env": e["accel_env"],
+                        "max_inflight": int(cfg.task_lease_max_inflight),
+                        "ttl_s": float(ttl),
+                    }
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # still queued/in flight: mark abandoned — the
+                    # dispatch-time filter drops it if unplaced, and a
+                    # late activation is released straight back
+                    e["abandoned"] = True
+                    self._cancelled_leases.add(lease_id)
+                    return {
+                        "granted": False,
+                        "reason": "grant timed out (no capacity)",
+                    }
+                self._cond.wait(remaining)
+
+    def _apply_task_lease_reports(self, reports: List[dict]) -> None:
+        """Agent-side grant activations and losses (ReportSeals
+        ``task_leases`` entries)."""
+        for tl in reports:
+            lease_id = tl["lease_id"]
+            release_node = None
+            with self._cond:
+                e = self._task_leases.get(lease_id)
+                if not tl.get("ok"):
+                    # grant failed agent-side, or the leased worker died
+                    if e is not None:
+                        was_active = e["state"] == "active"
+                        self._drop_task_lease_locked(lease_id)
+                        if was_active or tl.get("lost"):
+                            self.metrics["task_leases_revoked"] += 1
+                            TASK_LEASE_REVOKED.inc()
+                    self._cond.notify_all()
+                elif e is None or e.get("abandoned"):
+                    # nobody is waiting for this grant anymore (owner
+                    # timed out / head restarted): release it right back
+                    self._drop_task_lease_locked(lease_id)
+                    release_node = tl.get("node_id")
+                else:
+                    e.update(
+                        state="active",
+                        node_id=tl.get("node_id"),
+                        worker_address=tl.get("worker_address"),
+                        worker_id=tl.get("worker_id"),
+                        accel_env=tl.get("accel_env"),
+                        expires_at=time.monotonic()
+                        + max(3.0 * cfg.task_lease_ttl_s, 15.0),
+                        abandoned=False,
+                    )
+                    self.metrics["task_leases_granted"] += 1
+                    TASK_LEASE_GRANTED.inc()
+                    self._wal(("task_lease", self._lease_snapshot_row(e)))
+                    self._cond.notify_all()
+            self._wal_flush()
+            if release_node is not None:
+                self._agent_return_lease(release_node, lease_id)
+
+    @staticmethod
+    def _lease_snapshot_row(e: dict) -> dict:
+        """Durable slice of a lease row (monotonic expiry rebased on
+        load)."""
+        row = {
+            k: e[k]
+            for k in (
+                "lease_id",
+                "resources",
+                "client_id",
+                "fn_id",
+                "node_id",
+                "worker_address",
+                "worker_id",
+                "accel_env",
+            )
+        }
+        row["ttl_remaining_s"] = max(
+            0.0, e["expires_at"] - time.monotonic()
+        )
+        return row
+
+    def _drop_task_lease_locked(self, lease_id: str) -> Optional[dict]:
+        """Forget a lease everywhere. Caller holds self._lock."""
+        e = self._task_leases.pop(lease_id, None)
+        self._in_flight.pop(lease_id, None)
+        self._leases.pop(lease_id, None)
+        if e is not None:
+            self._wal(("task_lease_gone", lease_id))
+        return e
+
+    def _agent_return_lease(self, node_id: str, lease_id: str) -> None:
+        client = self._clients.get(node_id)
+        if client is not None:
+            self._dispatch_pool.submit(
+                _best_effort,
+                client.call,
+                "ReturnWorkerLease",
+                {"lease_id": lease_id},
+            )
+
+    def _h_lease_renew(self, req: dict) -> None:
+        """Owner heartbeat while its queue is non-empty (ClientBatch
+        ``lease_renew``): pushes the expiry out so the dead-owner sweep
+        never revokes a flowing lease."""
+        horizon = time.monotonic() + max(3.0 * cfg.task_lease_ttl_s, 15.0)
+        with self._lock:
+            for lid in req.get("lease_ids", ()):
+                e = self._task_leases.get(lid)
+                if e is not None:
+                    e["expires_at"] = horizon
+
+    def _h_lease_return(self, req: dict) -> None:
+        """Owner returned a lease (queue drain / idle TTL / shutdown)."""
+        lease_id = req["lease_id"]
+        with self._cond:
+            e = self._drop_task_lease_locked(lease_id)
+            if e is not None:
+                self.metrics["task_leases_returned"] += 1
+                TASK_LEASE_RETURNED.inc()
+            self._cond.notify_all()
+        self._wal_flush()
+        node_id = (e or {}).get("node_id") or req.get("node_id")
+        if node_id:
+            # forward even when the table missed it (unpersisted head
+            # restart): the agent-side release is what unpins the worker
+            self._agent_return_lease(node_id, lease_id)
+
+    def _expire_task_leases(self) -> None:
+        """Dead-owner safety net: revoke leases not renewed within
+        3x TTL (floored at 15s — renewals ride the pipelined ClientBatch
+        and may lag under load; revoking a healthy flowing lease costs a
+        spill storm). A live owner renews while busy, returns on idle."""
+        now = time.monotonic()
+        with self._lock:
+            victims = [
+                (lid, e.get("node_id"))
+                for lid, e in self._task_leases.items()
+                if now > e["expires_at"]
+            ]
+        for lid, node_id in victims:
+            logger.info("task lease %s expired; revoking", lid[:8])
+            with self._cond:
+                if self._drop_task_lease_locked(lid) is None:
+                    continue
+                self.metrics["task_leases_revoked"] += 1
+                TASK_LEASE_REVOKED.inc()
+                self._cond.notify_all()
+            self._wal_flush()
+            if node_id:
+                self._agent_return_lease(node_id, lid)
 
     @property
     def device_state(self):
@@ -1688,6 +2030,7 @@ class HeadServer:
                 # gone — the autoscaler must still see it (the first round
                 # can stall for seconds in XLA backend bring-up)
                 self._scheduling_batch = batch
+            t_round = time.perf_counter()
             try:
                 self._try_schedule_pgs()
                 if batch:
@@ -1697,6 +2040,10 @@ class HeadServer:
                 with self._cond:
                     self._pending.extend(batch)
             finally:
+                if batch:
+                    SCHED_ROUND_MS.observe(
+                        (time.perf_counter() - t_round) * 1e3
+                    )
                 self._scheduling_batch = []
             time.sleep(SCHED_TICK_S)
 
@@ -1751,6 +2098,16 @@ class HeadServer:
             _, a0, al0 = self.view.active_arrays()
             avail = a0.copy()
             alive = al0.copy()
+        # grants in flight (worker leases being placed) consume capacity
+        # the availability arrays won't show until the agent's next
+        # report: count their demand against the slot estimate
+        reserved = [
+            self._spec_req(
+                self._leases.get(lid)
+            ).dense(avail.shape[1])
+            for lid, e in self._task_leases.items()
+            if e["state"] == "granting" and self._leases.get(lid) is not None
+        ]
         take, keep = select_unparkable(
             parked,
             avail,
@@ -1760,6 +2117,7 @@ class HeadServer:
             ),
             resources_of=lambda s: s.resources,
             request_of=self._spec_req,
+            reserved=reserved or None,
         )
         self._pending.extend(take)
         self._infeasible = keep
@@ -2494,6 +2852,22 @@ class HeadServer:
                 and info.lifetime != "detached"
                 and info.state != "DEAD"
             ]
+            dead_leases = [
+                (lid, e.get("node_id"))
+                for lid, e in self._task_leases.items()
+                if e.get("client_id") == cid
+            ]
+        # the disconnecting owner's cached worker leases go back to their
+        # pools (a crashed driver skips this; the TTL sweep reclaims them)
+        for lid, node_id in dead_leases:
+            with self._cond:
+                if self._drop_task_lease_locked(lid) is not None:
+                    self.metrics["task_leases_returned"] += 1
+                    TASK_LEASE_RETURNED.inc()
+                self._cond.notify_all()
+            self._wal_flush()
+            if node_id:
+                self._agent_return_lease(node_id, lid)
         # reap OFF the handler thread: agent kill RPCs can block up to
         # their timeout per victim, while the disconnecting client only
         # waits ~5s for this reply
@@ -2774,6 +3148,31 @@ class HeadServer:
                     "pending": len(self._pending),
                     "infeasible": len(self._infeasible),
                     "in_flight": len(self._in_flight),
+                }
+            if kind == "dispatch":
+                # the task-lease dispatch plane (lease-cached direct
+                # dispatch): active leases + per-owner counts + lifecycle
+                per_owner: Dict[str, int] = {}
+                for e in self._task_leases.values():
+                    per_owner[e["client_id"]] = (
+                        per_owner.get(e["client_id"], 0) + 1
+                    )
+                return {
+                    "task_leases": [
+                        {
+                            "lease_id": e["lease_id"],
+                            "state": e["state"],
+                            "client_id": e["client_id"],
+                            "node_id": e["node_id"],
+                            "fn_id": e["fn_id"],
+                            "resources": dict(e["resources"]),
+                        }
+                        for e in self._task_leases.values()
+                    ],
+                    "per_owner": per_owner,
+                    "granted": self.metrics["task_leases_granted"],
+                    "returned": self.metrics["task_leases_returned"],
+                    "revoked": self.metrics["task_leases_revoked"],
                 }
             return {
                 "metrics": dict(self.metrics),

@@ -43,6 +43,15 @@ class RpcDeadlineError(RpcError):
     """The caller's overall deadline was exhausted across retries."""
 
 
+class RpcUnknownMethodError(RpcError):
+    """The peer has no handler registered for the requested method —
+    dispatch-table drift (a caller invoking a kind the receiving side
+    never registered), not a transport failure. Raised to the caller
+    immediately, WITHOUT consuming the retry budget: gRPC's raw
+    UNIMPLEMENTED used to read as a dead peer and burn every retry on a
+    method that can never exist."""
+
+
 class _Blackholed(Exception):
     """Injected partition: the peer is unreachable from this process.
     Handled exactly like a transport failure (retries, breaker)."""
@@ -413,7 +422,24 @@ class _GenericHandler(grpc.GenericRpcHandler):
         name = handler_call_details.method.rsplit("/", 1)[-1]
         fn = self._handlers.get(name)
         if fn is None:
-            return None
+            # unknown method: reply with a typed handler-level error so the
+            # caller fails fast with the method name instead of retrying a
+            # raw UNIMPLEMENTED as if the peer were down
+            def unknown(request_bytes, context, _name=name):
+                return cloudpickle.dumps(
+                    (
+                        False,
+                        RpcUnknownMethodError(
+                            f"no handler registered for rpc method {_name!r}"
+                        ),
+                    )
+                )
+
+            return grpc.unary_unary_rpc_method_handler(
+                unknown,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
 
         def unary(request_bytes, context):
             t0 = time.perf_counter()

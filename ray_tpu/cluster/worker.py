@@ -188,6 +188,20 @@ class Worker:
             name="direct-seal-send",
             daemon=True,
         ).start()
+        # leased-task execution (task leases: owner streams same-shape
+        # tasks straight to this pinned worker): per-lease FIFO queue +
+        # executor thread — ONE task runs at a time against the lease's
+        # single resource allocation (multiplexing is pipelining depth,
+        # not parallelism); results/seals ride the direct-call machinery
+        self._lease_q: Dict[str, deque] = {}  # lease_id -> queued items
+        self._lease_state: Dict[str, dict] = {}  # lease_id -> {released,undo}
+        # released-lease tombstones: a stale owner batch arriving after
+        # the FIFO drained must see "released" (and spill to the head),
+        # never resurrect the lease on a worker already back in the pool
+        self._lease_tombstones: set = set()
+        self._lease_tombstone_order: deque = deque()
+        self._lease_running: Dict[str, str] = {}  # lease_id -> ref executing
+        self._lease_cv = threading.Condition()
         self._server = RpcServer(
             {
                 "PushTask": self._h_push_task,
@@ -197,6 +211,10 @@ class Worker:
                 "DagInstall": self._h_dag_install,
                 "DagTeardown": self._h_dag_teardown,
                 "DirectPushBatch": self._h_direct_push_batch,
+                "LeaseTaskBatch": self._h_lease_task_batch,
+                "LeaseRecall": self._h_lease_recall,
+                "LeaseRelease": self._h_lease_release,
+                "LeaseKillRunning": self._h_lease_kill_running,
                 "Ping": lambda r: "pong",
             },
             port=0,
@@ -1281,6 +1299,198 @@ class Worker:
                         len(seals),
                     )
                     time.sleep(0.5)
+
+    # ------------------------------------------------------------------
+    # leased-task execution (task leases; reference: the raylet's worker
+    # lease — one worker pinned to a submitter, tasks streamed to it with
+    # no per-task scheduler hop, local_lease_manager.h). Tasks execute
+    # STRICTLY one at a time per lease (the lease holds exactly one
+    # task's resource allocation); queued items are recallable so the
+    # owner can spill them back to head scheduling when the head of the
+    # line blocks (rendezvous peers) or on explicit cancel. Results and
+    # seals ride the direct-call result/seal machinery, so the head's
+    # object directory stays authoritative exactly as for direct actor
+    # calls (owner-held deferred seals included).
+    # ------------------------------------------------------------------
+
+    def _h_lease_task_batch(self, req: dict) -> List[str]:
+        """Accept a window of leased tasks onto the lease's FIFO. The
+        reply returns as soon as everything is queued; results push back
+        to the caller's callback server. "released" tells a stale caller
+        its lease is gone (it re-routes through the head)."""
+        lease_id = req["lease_id"]
+        client_addr = req["client_addr"]
+        accel_env = req.get("accel_env")
+        with self._lease_cv:
+            if lease_id in self._lease_tombstones:
+                return ["released"] * len(req["items"])
+            st = self._lease_state.get(lease_id)
+            if st is None:
+                st = self._lease_state[lease_id] = {
+                    "released": False,
+                    "undo": None,
+                }
+                if accel_env:
+                    # the lease owns this worker until released: its chip
+                    # assignment applies for the lease lifetime (the
+                    # actor-creation persistence semantics, scoped to the
+                    # lease instead of the process)
+                    prev = {k: os.environ.get(k) for k in accel_env}
+                    os.environ.update(accel_env)
+
+                    def undo(prev=prev) -> None:
+                        for k, old in prev.items():
+                            if old is None:
+                                os.environ.pop(k, None)
+                            else:
+                                os.environ[k] = old
+
+                    st["undo"] = undo
+                self._lease_q[lease_id] = deque()
+                threading.Thread(
+                    target=self._lease_fifo_loop,
+                    args=(lease_id,),
+                    # "direct-" prefix: framework thread, scrub-allowed
+                    name=f"direct-lease-{lease_id[:6]}",
+                    daemon=True,
+                ).start()
+            elif st["released"]:
+                return ["released"] * len(req["items"])
+            q = self._lease_q[lease_id]
+            for item in req["items"]:
+                item["client_addr"] = client_addr
+                q.append(item)
+            self._lease_cv.notify_all()
+        return ["accepted"] * len(req["items"])
+
+    def _h_lease_recall(self, req: dict) -> dict:
+        """Hand queued (not-yet-running) items back to the caller: with
+        ``refs`` a targeted cancel, without it a stall spill — the owner
+        re-routes the removed tasks through head scheduling. The running
+        head-of-line task is never touched (non-force semantics)."""
+        lease_id = req["lease_id"]
+        only = req.get("refs")
+        removed: List[str] = []
+        with self._lease_cv:
+            q = self._lease_q.get(lease_id)
+            if q:
+                keep: deque = deque()
+                for item in q:
+                    if only is None or item["ref"] in only:
+                        removed.append(item["ref"])
+                    else:
+                        keep.append(item)
+                self._lease_q[lease_id] = keep
+                self._lease_cv.notify_all()
+        return {"removed": removed}
+
+    def _h_lease_release(self, req: dict) -> dict:
+        """The agent reclaimed this lease's worker. Queued (not-yet-
+        started) items are handed BACK to their owner as ``spill``
+        results — it re-routes them through head scheduling — so the
+        pooled worker only overlaps its next task with at most the one
+        leased task already running; the FIFO thread exits (and undoes
+        the lease env) once that finishes. A tombstone keeps stale
+        owner batches from resurrecting the lease."""
+        lease_id = req["lease_id"]
+        drained: List[dict] = []
+        with self._lease_cv:
+            self._lease_tombstones.add(lease_id)
+            self._lease_tombstone_order.append(lease_id)
+            while len(self._lease_tombstone_order) > 1024:
+                self._lease_tombstones.discard(
+                    self._lease_tombstone_order.popleft()
+                )
+            st = self._lease_state.get(lease_id)
+            if st is not None:
+                st["released"] = True
+                q = self._lease_q.get(lease_id)
+                if q:
+                    drained.extend(q)
+                    q.clear()
+                self._lease_cv.notify_all()
+        for item in drained:
+            self._direct_emit(
+                item["client_addr"],
+                {"ref": item["ref"], "status": "spill"},
+                None,
+            )
+        return {"ok": True}
+
+    def _lease_fifo_loop(self, lease_id: str) -> None:
+        while True:
+            item = None
+            undo = None
+            with self._lease_cv:
+                while True:
+                    st = self._lease_state.get(lease_id)
+                    if st is None:
+                        return
+                    q = self._lease_q.get(lease_id)
+                    if q:
+                        item = q.popleft()
+                        break
+                    if st["released"]:
+                        undo = st.get("undo")
+                        self._lease_q.pop(lease_id, None)
+                        self._lease_state.pop(lease_id, None)
+                        break
+                    self._lease_cv.wait(timeout=1.0)
+            if item is None:
+                if undo is not None:
+                    undo()
+                return
+            self._lease_running[lease_id] = item["ref"]
+            try:
+                self._run_lease_item(item)
+            finally:
+                self._lease_running.pop(lease_id, None)
+
+    def _h_lease_kill_running(self, req: dict) -> dict:
+        """Force-cancel of the CURRENTLY EXECUTING leased task: the only
+        preemption a thread-based executor has is killing the process —
+        exactly what the head's force path does to a worker running a
+        head-scheduled task. The agent's death path respawns the worker
+        and reports the lease lost; the caller pre-seals the cancel."""
+        if self._lease_running.get(req["lease_id"]) != req["ref"]:
+            return {"ok": False}  # finished (or never started) meanwhile
+        import threading as _threading
+
+        _threading.Timer(0.1, lambda: os._exit(1)).start()
+        return {"ok": True}
+
+    def _run_lease_item(self, item: dict) -> None:
+        """Execute one leased task and emit its result through the
+        direct-call result path (seal bookkeeping identical to direct
+        actor calls: inline values owner-held under deferred seals, big
+        values sealed to the node store, errors sealed with owner)."""
+        self._set_context(item)
+        runtime_env = item.get("runtime_env")
+        if runtime_env:
+            self._env_enter(runtime_env)
+        out = None
+        failed: Optional[BaseException] = None
+        try:
+            fn = self._fn_from_blob(
+                item.get("fn_id", ""), item["fn_blob"], item.get("fn_cache")
+            )
+            args, kwargs = wire.loads(item["payload"])
+            args, kwargs = self._resolve(args, kwargs)
+            out = fn(*args, **kwargs)
+        except BaseException as exc:  # noqa: BLE001 - errors are values
+            failed = exc
+        finally:
+            if runtime_env:
+                self._env_exit()
+            self._clear_context()
+        try:
+            if failed is not None:
+                result, seal = self._build_direct_error(item, failed)
+            else:
+                result, seal = self._build_direct_result(item, out)
+        except BaseException as exc:  # noqa: BLE001 - sealing can fail too
+            result, seal = self._build_direct_error(item, exc)
+        self._direct_emit(item["client_addr"], result, seal)
 
     # ------------------------------------------------------------------
     # compiled-DAG programs (reference: compiled_dag_node.py actor-side

@@ -372,8 +372,12 @@ define(
 )
 define(
     "direct_results_cap",
-    4096,
-    "Driver-side FIFO bound on cached direct-call results.",
+    16384,
+    "Driver-side FIFO bound on cached direct-call / leased-task "
+    "results. Evicting an owner-held (deferred-seal) entry whose ref is "
+    "still live costs a PutObject upload to the head, so the cap should "
+    "sit above a driver's typical in-flight ref count — a 10k-task "
+    "submit-then-get wave over a 4096 cap paid ~6k serial uploads.",
 )
 define("direct_trace", False, "Stamp direct-call results with timing marks.")
 define(
@@ -386,6 +390,49 @@ define(
     "submission or evicted from the local cache. Cuts the per-call "
     "worker->agent->head seal chain off the hot path; a failed result "
     "push falls back to worker-side sealing.",
+)
+
+# ---------------------------------------------------------------------------
+# task leases (owner-cached direct task dispatch)
+# ---------------------------------------------------------------------------
+define(
+    "task_leases",
+    True,
+    "Lease-cached direct task dispatch: the head grants owners cacheable "
+    "worker leases per task shape (fn hash x resources), and same-shape "
+    "tasks stream caller->worker with no head hop (the reference's "
+    "local_lease_manager worker leases). Off: every task rides the "
+    "per-task head-scheduled path.",
+)
+define(
+    "task_lease_ttl_s",
+    5.0,
+    "Idle TTL of a cached worker lease: the owner returns a lease this "
+    "long after its queue drained; the head's expiry sweep revokes "
+    "leases not renewed within 3x this (dead-owner safety net).",
+)
+define(
+    "task_lease_max_inflight",
+    64,
+    "Tasks in flight (sent, result pending) per cached worker lease. "
+    "This is PIPELINE depth, not parallelism — the leased worker "
+    "executes one task at a time against the lease's single resource "
+    "allocation; parallelism comes from holding more leases.",
+)
+define(
+    "task_lease_max_per_shape",
+    8,
+    "Max concurrent worker leases one owner holds per task shape; the "
+    "cache grows toward this while its queues stay deep.",
+)
+define(
+    "task_lease_stall_s",
+    1.0,
+    "A lease with results owed but none arriving for this long recalls "
+    "its queued (not-yet-running) tasks from the worker and spills them "
+    "back to head scheduling — a head-of-line task blocked on other "
+    "tasks' results (rendezvous peers) delays followers by ~this "
+    "instead of deadlocking the lease.",
 )
 
 # ---------------------------------------------------------------------------

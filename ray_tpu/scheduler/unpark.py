@@ -30,13 +30,18 @@ def select_unparkable(
     resources_of: Callable[[Any], dict],
     request_of: Callable[[Any], Any],
     slack: int = UNPARK_SLACK,
+    reserved: Any = None,
 ) -> Tuple[List[Any], List[Any]]:
     """(take, keep): specs to re-queue now vs. keep parked.
 
     ``is_constrained``: shape-capacity math doesn't apply (affinity /
     PG / target-node routed) — those unpark ``slack`` at a time.
     ``request_of`` returns a ResourceRequest (``demands`` keyed by dense
-    column, ``dense(width)``)."""
+    column, ``dense(width)``). ``reserved``: dense demand rows already
+    granted but not yet reflected in ``avail`` (e.g. worker leases being
+    placed — the agent's ledger deduction reaches the view only with its
+    next report); each reserved row that overlaps a shape's demand
+    columns is assumed to consume one of that shape's slots."""
     if len(parked) <= slack:
         return list(parked), []
     r = avail.shape[1] if avail.ndim == 2 else 0
@@ -74,7 +79,17 @@ def select_unparkable(
                         avail[:, cols] / d[cols][None, :]
                     ).min(axis=1)
                     slots = np.where(alive, np.maximum(slots, 0.0), 0.0)
-                    cap = int(slots.sum()) + slack
+                    cap = int(slots.sum())
+                    if reserved is not None:
+                        # outstanding grants eat into the estimate before
+                        # the view hears about them
+                        overlap = sum(
+                            1
+                            for row in reserved
+                            if row.shape[0] >= r and (row[:r][cols] > 0).any()
+                        )
+                        cap = max(0, cap - overlap)
+                    cap += slack
         n = min(len(q), cap)
         take.extend(q[:n])
         keep.extend(q[n:])
