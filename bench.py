@@ -1134,15 +1134,20 @@ def chaos_bench(num_faults: int = 20, seed: int = None) -> dict:
         result = orch.run()
         lat = result.recovery_percentiles()
         breaker_opens = sum(b.open_count for b in _BREAKERS.values())
-        return {
+        out = {
             "chaos_seed": seed,
             "chaos_ok": result.ok,
             "chaos_faults_injected": len(result.faults),
             "chaos_fault_counts": result.summary()["fault_counts"],
             "chaos_objects_acked": result.objects_acked,
             "chaos_objects_reconstructed": result.objects_reconstructed,
-            "chaos_recovery_p50_s": round(lat["p50"], 3),
-            "chaos_recovery_p95_s": round(lat["p95"], 3),
+            "chaos_owners_killed": result.owners_killed,
+            "recovery_p50_s": round(lat["p50"], 3),
+            "recovery_p95_s": round(lat["p95"], 3),
+            # deleted-with-outstanding-pins arena entries still alive once
+            # the soak settled: any nonzero value is a reader-pin leak
+            # (zombie-pin reclamation regression)
+            "arena_zombies_after_soak": result.arena_zombies_after,
             "chaos_breaker_opens": breaker_opens,
             "chaos_wall_s": round(time.perf_counter() - t0, 1),
             **(
@@ -1151,6 +1156,20 @@ def chaos_bench(num_faults: int = 20, seed: int = None) -> dict:
                 else {}
             ),
         }
+        # env-tunable recovery regression gate, mirroring the throughput
+        # floors: CI sets RAY_TPU_BENCH_RECOVERY_P95_S to fail the run
+        # loudly when p95 fault-recovery latency regresses above it (or
+        # the soak leaks arena zombies)
+        p95_budget = float(
+            os.environ.get("RAY_TPU_BENCH_RECOVERY_P95_S", "0") or 0.0
+        )
+        if p95_budget > 0:
+            out["recovery_p95_budget_s"] = p95_budget
+            out["recovery_p95_ok"] = bool(
+                lat["p95"] <= p95_budget
+                and result.arena_zombies_after == 0
+            )
+        return out
     finally:
         set_runtime(None)
         try:
@@ -1246,11 +1265,12 @@ def main():
         out.get("actors_floor_ok") is False
         or out.get("data_floor_ok") is False
         or out.get("tasks_floor_ok") is False
+        or out.get("recovery_p95_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
-        # RAY_TPU_BENCH_TASKS_FLOOR_PER_S): the JSON above still
-        # published; exit nonzero so CI notices
+        # RAY_TPU_BENCH_TASKS_FLOOR_PER_S / RAY_TPU_BENCH_RECOVERY_P95_S):
+        # the JSON above still published; exit nonzero so CI notices
         import sys
 
         sys.exit(1)

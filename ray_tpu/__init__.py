@@ -12,6 +12,7 @@ from ray_tpu.core.api import (  # noqa: F401
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,
+    OwnerDiedError,
     TaskError,
     actor_exited,
     available_resources,

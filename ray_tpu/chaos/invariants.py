@@ -151,6 +151,83 @@ class InvariantChecker:
         self.workload.failed_pending.clear()
         return failures
 
+    def wait_owner_reaped(self, cid: str, timeout: float) -> List[str]:
+        """After an owner SIGKILL: within the budget the head must hold
+        ZERO live non-detached actors, ZERO task-lease rows, and no
+        session for that client — the full fate-sharing reap."""
+        head = self.cluster.head
+        deadline = time.monotonic() + timeout
+        actors: List[str] = []
+        leases: List[str] = []
+        session = True
+        while time.monotonic() < deadline:
+            with head._lock:
+                actors = [
+                    a.actor_id
+                    for a in head._actors.values()
+                    if a.owner_client == cid
+                    and a.lifetime != "detached"
+                    and a.state != "DEAD"
+                ]
+                leases = [
+                    lid
+                    for lid, e in head._task_leases.items()
+                    if e.get("client_id") == cid
+                ]
+                session = cid in head._owner_sessions
+            if not actors and not leases and not session:
+                return []
+            time.sleep(0.2)
+        out = []
+        if actors:
+            out.append(
+                f"owner {cid[:8]} leaked {len(actors)} live actors "
+                f"after death"
+            )
+        if leases:
+            out.append(
+                f"owner {cid[:8]} leaked {len(leases)} worker leases "
+                f"after death"
+            )
+        if session:
+            out.append(f"owner {cid[:8]} session never declared dead")
+        return out
+
+    def arena_zombies(self) -> int:
+        """Sum of deleted-with-outstanding-pins entries across every live
+        node's arena (agent DebugState ``object_plane.arena_zombies``)."""
+        from ray_tpu.cluster.rpc import RpcClient
+
+        total = 0
+        head = self.cluster.head
+        with head._lock:
+            nodes = [
+                (nid, n.address) for nid, n in head.nodes.items() if n.alive
+            ]
+        for nid, addr in nodes:
+            client = RpcClient(addr)
+            try:
+                state = client.call("DebugState", timeout=10.0)
+                total += int(
+                    (state.get("object_plane") or {}).get("arena_zombies", 0)
+                )
+            except Exception:  # noqa: BLE001 - node mid-death
+                pass
+            finally:
+                client.close()
+        return total
+
+    def wait_arena_zombies_zero(self, timeout: float = 15.0) -> int:
+        """Poll until the cluster-wide zombie count reaches zero (frees
+        may still be in flight right after the last fault); returns the
+        final count (0 = invariant holds)."""
+        deadline = time.monotonic() + timeout
+        count = self.arena_zombies()
+        while count > 0 and time.monotonic() < deadline:
+            time.sleep(0.5)
+            count = self.arena_zombies()
+        return count
+
     def check_durable_state(self, pre: Snapshot) -> List[str]:
         head = self.cluster.head
         failures: List[str] = []

@@ -20,14 +20,29 @@ injection. Fault kinds:
                        drop its directory entries; lineage must rebuild
                        it.
 
+- ``owner_kill``      — SIGKILL a sacrificial OWNER process (a real
+                       driver in its own process, ``owner_proc.py``);
+                       the head must notice purely through missed owner
+                       heartbeats and reap its actors/leases/objects
+                       with nothing leaked.
+- ``zygote_kill``     — SIGKILL one node's fork-server (taking its
+                       forked workers with it); worker spawns must keep
+                       succeeding (zygote restart or cold spawn).
+
 Every fault records recovery latency = time from injection until all
 invariants are green again; the run result carries p50/p95 plus objects
-reconstructed, for the bench chaos tier.
+reconstructed and the post-soak arena zombie count, for the bench chaos
+tier.
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import random
+import subprocess
+import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -72,6 +87,10 @@ class ChaosRunResult:
     faults: List[FaultResult] = field(default_factory=list)
     objects_reconstructed: int = 0
     objects_acked: int = 0
+    # deleted-with-outstanding-pins arena entries still alive after the
+    # soak settled: any nonzero value is a reader-pin leak
+    arena_zombies_after: int = 0
+    owners_killed: int = 0
 
     @property
     def ok(self) -> bool:
@@ -97,6 +116,8 @@ class ChaosRunResult:
             "fault_counts": counts,
             "objects_acked": self.objects_acked,
             "objects_reconstructed": self.objects_reconstructed,
+            "arena_zombies_after": self.arena_zombies_after,
+            "owners_killed": self.owners_killed,
             "recovery_latency_s": self.recovery_percentiles(),
             "failures": [
                 {"fault": f.spec.index, "kind": f.spec.kind, "why": f.failures}
@@ -138,6 +159,56 @@ class ChaosOrchestrator:
         # derives from the plan seed too: full-run determinism modulo
         # scheduler placement
         self._rng = random.Random(plan.seed ^ 0x5EED)
+        # sacrificial owner process (owner_kill): pre-spawned so the kill
+        # never pays setup latency inside a fault's recovery window
+        self._owner_proc: Optional[subprocess.Popen] = None
+        self._owner_info_path: Optional[str] = None
+        self._killed_owner: Optional[dict] = None
+
+    # -- sacrificial owner ----------------------------------------------
+    def _spawn_owner_proc(self) -> None:
+        """Start (or replace) the sacrificial owner driver, async — the
+        info file appears once its actors are ALIVE."""
+        if self._owner_proc is not None:
+            # replacing after an owner_kill: reap the corpse and drop its
+            # info file, or a long soak leaks one of each per kill
+            self._stop_owner_proc()
+        fd, path = tempfile.mkstemp(prefix="ray_tpu_chaos_owner_")
+        os.close(fd)
+        os.unlink(path)  # owner_proc writes it atomically when ready
+        self._owner_info_path = path
+        self._owner_proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.chaos.owner_proc",
+                "--head",
+                self.cluster.address,
+                "--info-file",
+                path,
+                "--actors",
+                "1",
+            ]
+        )
+
+    def _owner_info(self) -> Optional[dict]:
+        if self._owner_info_path is None:
+            return None
+        try:
+            with open(self._owner_info_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _stop_owner_proc(self) -> None:
+        if self._owner_proc is not None and self._owner_proc.poll() is None:
+            self._owner_proc.kill()
+            self._owner_proc.wait(timeout=10)
+        if self._owner_info_path:
+            try:
+                os.unlink(self._owner_info_path)
+            except OSError:
+                pass
 
     # -- node selection -------------------------------------------------
     def _live_nodes(self) -> List[str]:
@@ -205,11 +276,44 @@ class ChaosOrchestrator:
                 return f"skipped: {ref.hex[:8]} not droppable (inline?)"
             self._dropped_hex = ref.hex
             return f"dropped all copies of {ref.hex[:8]}"
+        if kind == "owner_kill":
+            proc = self._owner_proc
+            info = self._owner_info()
+            if proc is None or proc.poll() is not None or info is None:
+                return "skipped: sacrificial owner not ready yet"
+            proc.kill()  # SIGKILL: no DisconnectClient, no atexit
+            proc.wait(timeout=10)
+            self._killed_owner = info
+            return (
+                f"SIGKILLed owner {info['client_id'][:8]} "
+                f"(pid {info['pid']}, {len(info['actor_ids'])} actors)"
+            )
+        if kind == "zygote_kill":
+            nid = self._pick_node(spec)
+            if nid is None:
+                return "skipped: no live node"
+            addr = self.cluster.agent_address(nid)
+            if addr is None:
+                return "skipped: node has no address"
+            from ray_tpu.cluster.rpc import RpcClient, RpcError
+
+            client = RpcClient(addr)
+            try:
+                reply = client.call("ChaosKillZygote", timeout=10.0)
+            except RpcError:
+                return f"skipped: agent {nid} unreachable"
+            finally:
+                client.close()
+            if not reply.get("killed"):
+                return f"skipped: {reply.get('reason')}"
+            return f"killed zygote pid {reply['pid']} on {nid}"
         raise ValueError(f"unknown fault kind {kind!r}")
 
     # -- the run --------------------------------------------------------
     def run(self) -> ChaosRunResult:
         result = ChaosRunResult(seed=self.plan.seed)
+        if any(f.kind == "owner_kill" for f in self.plan.faults):
+            self._spawn_owner_proc()
         try:
             for spec in self.plan.faults:
                 self.workload.step(self.tasks_per_step)
@@ -217,6 +321,7 @@ class ChaosOrchestrator:
                 pre = self.checker.snapshot()
                 t0 = time.monotonic()
                 self._dropped_hex: Optional[str] = None
+                self._killed_owner = None
                 detail = self._inject(spec)
                 logger.info(
                     "chaos #%d %s: %s", spec.index, spec.kind, detail
@@ -232,6 +337,19 @@ class ChaosOrchestrator:
                     if miss:
                         check.ok = False
                         check.failures.append(miss)
+                if self._killed_owner is not None:
+                    # nothing of the dead owner's may outlive the liveness
+                    # window: no ALIVE actors, no lease rows, no session
+                    result.owners_killed += 1
+                    owner_fail = self.checker.wait_owner_reaped(
+                        self._killed_owner["client_id"],
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if owner_fail:
+                        check.ok = False
+                        check.failures.extend(owner_fail)
+                    # pre-warm the next sacrificial owner off the clock
+                    self._spawn_owner_proc()
                 recovery = time.monotonic() - t0
                 CHAOS_RECOVERY.observe(recovery)
                 if not check.ok:
@@ -264,5 +382,12 @@ class ChaosOrchestrator:
                 )
         finally:
             self.cluster.heal_all()
+            self._stop_owner_proc()
         result.objects_acked = self.workload.objects_acked
+        # post-soak leak audit: every reader released (or died and had its
+        # pin log replayed) — deleted-with-pins entries must be zero. A
+        # short settle loop tolerates frees still in flight.
+        result.arena_zombies_after = self.checker.wait_arena_zombies_zero(
+            timeout=15.0
+        )
         return result

@@ -24,6 +24,8 @@ DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
     ("straggler", 3.0),
     ("object_drop", 3.0),
     ("kill_node", 2.0),
+    ("owner_kill", 1.5),
+    ("zygote_kill", 1.5),
     ("head_restart", 1.0),
 )
 

@@ -44,7 +44,13 @@ from .object_plane import (
     ChunkFetchError,
     fetch_chunked,
 )
-from .rpc import HANDLER_STATS, RpcClient, RpcError, RpcServer
+from .rpc import (
+    HANDLER_STATS,
+    RpcClient,
+    RpcError,
+    RpcServer,
+    RpcStaleEpochError,
+)
 from .zygote import ZygoteClient, fork_available
 
 
@@ -232,6 +238,10 @@ class NodeAgent:
         self.labels = dict(labels or {})
         self._lock = threading.RLock()
         self._shutdown = False
+        # set for real from the RegisterNode reply below; None (unstamped,
+        # always accepted) until then so reporter threads that start early
+        # never race the registration round-trip
+        self._head_epoch: Optional[int] = None
 
         # --- object store (plasma-in-raylet analog), wrapped with LRU
         # disk spill + restore so a full arena backpressures to disk
@@ -321,6 +331,7 @@ class NodeAgent:
             ),
             "Shutdown": self._h_shutdown,
             "DebugState": self._h_debug_state,
+            "ChaosKillZygote": self._h_chaos_kill_zygote,
             "Ping": lambda r: "pong",
         }
         self._server = RpcServer(handlers, host=host, port=0)
@@ -470,6 +481,11 @@ class NodeAgent:
             retry_interval=0.2,
         )
         assert reply["node_id"] == self.node_id
+        # cluster epoch adopted at registration: control RPCs to the head
+        # are stamped with it, so a rebuilt head fences this agent out the
+        # moment it restarts — until the agent re-registers (the resync
+        # protocol) and adopts the new epoch
+        self._head_epoch = reply.get("epoch")
         self._report_thread = threading.Thread(
             target=self._report_loop, name="agent-report", daemon=True
         )
@@ -756,6 +772,42 @@ class NodeAgent:
         except OSError:
             pass
         self._close_worker_client(handle)
+        # zombie-pin reclamation: replay the dead reader's view-pin log and
+        # release what its finalizers never could (SIGKILL). Waits briefly
+        # for the process to be truly gone first — replaying while a
+        # half-dead worker's finalizer races its own release could
+        # double-release a share (the log's R-before-release ordering
+        # protects every other interleaving).
+        pid = getattr(handle.proc, "pid", None)
+        if pid and self.store_path:
+            deadline = time.monotonic() + 1.0
+            while handle.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            if handle.proc.poll() is None:
+                # not confirmed dead (D-state under memory pressure):
+                # replaying now could double-release against the live
+                # process's own finalizer. Leak the pins instead — the
+                # arena restart sweep reclaims them.
+                logger.warning(
+                    "worker %s (pid %d) not reaped within 1s; skipping "
+                    "pin-log replay (pins reclaimed at arena restart)",
+                    handle.worker_id[:8],
+                    pid,
+                )
+                pid = None
+        if pid and self.store_path:
+            try:
+                released = self.store.release_dead_pins(pid)
+                if released:
+                    logger.info(
+                        "released %d arena view pins leaked by dead "
+                        "worker %s (pid %d)",
+                        released,
+                        handle.worker_id[:8],
+                        pid,
+                    )
+            except Exception:  # noqa: BLE001 - reclamation is best-effort
+                logger.debug("pin-log replay failed", exc_info=True)
         if lease_entry is not None:
             self._release(lease_entry["alloc"])
         report: Dict[str, Any] = {"node_id": self.node_id}
@@ -1825,6 +1877,7 @@ class NodeAgent:
             if self.store.contains(oid):
                 return self._local_reply(oid)
             return None  # leader failed; retry via the locate loop
+        gone_nodes: List[str] = []
         try:
             with self._pull_adm(purpose):
                 for nid, addr in locations:
@@ -1846,8 +1899,18 @@ class NodeAgent:
                                 else time.monotonic() + wait_s
                             ),
                         )
-                    except (RpcError, KeyError, TimeoutError, ChunkFetchError):
-                        # KeyError: peer dropped it; TimeoutError: its
+                    except KeyError:
+                        # DEFINITE miss: the peer answered and does not
+                        # hold the object (evicted, lost mid-spill, or a
+                        # stale directory row). Report it so the head
+                        # prunes the location — and reconstructs through
+                        # lineage if that was the last copy. Transient
+                        # failures below never trigger this: a timeout
+                        # must not cost a re-execution.
+                        gone_nodes.append(nid)
+                        continue
+                    except (RpcError, TimeoutError, ChunkFetchError):
+                        # RpcError: transport blip; TimeoutError: its
                         # push admission saturated; ChunkFetchError: a
                         # chunk died past its retry budget — try the next
                         # copy, then the locate loop
@@ -1875,6 +1938,15 @@ class NodeAgent:
             with self._lock:
                 self._pull_waiters.pop(oid, None)
             ev.set()
+            if gone_nodes:
+                self._report_to_head(
+                    {
+                        "node_id": self.node_id,
+                        "objects_missing": [
+                            {"object_id": oid, "node_ids": gone_nodes}
+                        ],
+                    }
+                )
 
     def _local_reply(self, oid: str) -> dict:
         """Workers read 'local' objects straight from the shm arena; a
@@ -1962,7 +2034,21 @@ class NodeAgent:
                     timeout=10.0,
                     retries=8,
                     retry_interval=0.25,
+                    epoch=self._head_epoch,
                 )
+            except RpcStaleEpochError:
+                if self._shutdown:
+                    return
+                # the head restarted under us: our stamp predates its
+                # rebuilt tables. Re-register (adopting the new epoch and
+                # re-advertising actors/inventory/leases), THEN redeliver
+                # — the report lands fenced-fresh or not at all.
+                logger.warning(
+                    "head epoch advanced; re-registering before redelivery"
+                )
+                self._re_register()
+                with self._report_cv:
+                    self._report_queue.insert(0, report)
             except RpcError:
                 if self._shutdown:
                     return
@@ -1973,6 +2059,18 @@ class NodeAgent:
                 with self._report_cv:
                     self._report_queue.insert(0, report)
                 time.sleep(0.5)
+
+    def _re_register(self) -> None:
+        """Resync with a restarted head: RegisterNode is fence-exempt by
+        design, re-attaches this node's actors/store inventory/held
+        leases, and its reply carries the NEW cluster epoch."""
+        try:
+            reply = self.head.call(
+                "RegisterNode", self._node_info(), timeout=10.0
+            )
+            self._head_epoch = reply.get("epoch")
+        except RpcError:
+            pass  # next report tick (or its stale rejection) retries
 
     # a spawned worker gets this long to come up and register before its
     # reservation is reclaimed and the process killed (cold spawns pay a
@@ -2025,13 +2123,20 @@ class NodeAgent:
                         version=version,
                     ),
                     timeout=5.0,
+                    epoch=self._head_epoch,
                 )
                 last_head_contact = time.monotonic()
                 if not reply.get("alive", True):
                     # a transient heartbeat gap (or a head restart) got us
                     # declared dead/unknown — rejoin with our live actors.
                     logger.warning("head declared us dead; re-registering")
-                    self.head.call("RegisterNode", self._node_info(), timeout=5.0)
+                    self._re_register()
+            except RpcStaleEpochError:
+                # fenced out by a rebuilt head: re-registration IS the
+                # resync protocol (and refreshes the epoch stamp)
+                last_head_contact = time.monotonic()  # the head is alive
+                logger.warning("stale cluster epoch; re-registering")
+                self._re_register()
             except RpcError:
                 if (
                     time.monotonic() - last_head_contact
@@ -2438,7 +2543,27 @@ class NodeAgent:
             "transfer_chunk_ms": TRANSFER_CHUNK_MS.summary(),
             "shm_evictions": int(SHM_EVICTIONS.value()),
             "spilled_objects": st.get("spilled_objects", 0),
+            # deleted-with-outstanding-pins entries still holding arena
+            # space; nonzero after every reader released (or died and had
+            # its pin log replayed) is a leak — the chaos soak asserts 0
+            "arena_zombies": self.store.zombie_count(),
         }
+
+    def _h_chaos_kill_zygote(self, req=None) -> dict:
+        """Chaos fault: SIGKILL this node's fork-server. The next fork
+        attempt marks the client broken and `_zygote_for_fork` restarts
+        it (bounded); past the restart budget the agent cold-spawns
+        forever — either way worker spawns keep succeeding, which is the
+        invariant the chaos soak asserts."""
+        z = self._zygote
+        if z is None:
+            return {"killed": False, "reason": "no zygote (cold-spawn mode)"}
+        try:
+            pid = z.proc.pid
+            z.proc.kill()
+        except OSError as exc:
+            return {"killed": False, "reason": repr(exc)}
+        return {"killed": True, "pid": pid}
 
     def _h_shutdown(self, req=None) -> None:
         threading.Thread(target=self.shutdown, daemon=True).start()

@@ -32,7 +32,13 @@ from ray_tpu.core.runtime import TaskSpec
 
 from . import serialization as wire
 from .common import INLINE_OBJECT_MAX, LeaseRequest, new_id
-from .rpc import RpcClient, RpcDeadlineError, RpcError, RpcServer
+from .rpc import (
+    RpcClient,
+    RpcDeadlineError,
+    RpcError,
+    RpcServer,
+    RpcStaleEpochError,
+)
 
 from ray_tpu.util.metrics import Counter as _Counter
 
@@ -864,7 +870,15 @@ class _TaskLeaseManager:
                     "timeout": 10.0,
                 },
                 timeout=40.0,
+                epoch=self._rt._cluster_epoch,
             )
+        except RpcStaleEpochError:
+            # fenced by a rebuilt head: resync (fresh hello) and let the
+            # cooldown retry the grant with the new epoch
+            try:
+                self._rt._hello()
+            except Exception:  # noqa: BLE001
+                pass
         except Exception:  # noqa: BLE001 - head unreachable: cooldown
             pass
         dangling = None  # granted after the runtime stopped: hand it back
@@ -972,8 +986,13 @@ class _PipelinedSender:
 
     MAX_BATCH = 512
 
-    def __init__(self, client: RpcClient):
+    def __init__(self, client: RpcClient, epoch_fn=None, on_stale=None):
         self._client = client
+        # epoch-fenced control plane: epoch_fn supplies the stamp for
+        # every ClientBatch; on_stale runs the owner-side resync (a fresh
+        # ClientHello) when a rebuilt head rejects our stamp
+        self._epoch_fn = epoch_fn
+        self._on_stale = on_stale
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._enqueued = 0
@@ -1031,6 +1050,18 @@ class _PipelinedSender:
             self._enqueued += len(payloads)
             self._cv.notify_all()
 
+    def try_enqueue_once(self, kind: str, payload: Any, prev_ticket: int) -> int:
+        """Queue one item unless the previous such item is still
+        undelivered (heartbeats must not pile up behind a head outage).
+        Returns the new ticket, or ``prev_ticket`` when skipped."""
+        with self._cv:
+            if self._stop or prev_ticket > self._acked:
+                return prev_ticket
+            self._q.append((kind, payload))
+            self._enqueued += 1
+            self._cv.notify_all()
+            return self._enqueued
+
     def _loop(self) -> None:
         import logging
 
@@ -1062,8 +1093,32 @@ class _PipelinedSender:
                         timeout=60.0,
                         retries=8,
                         retry_interval=0.25,
+                        epoch=(
+                            self._epoch_fn() if self._epoch_fn else None
+                        ),
                     )
                     delivered = True
+                except RpcStaleEpochError:
+                    # the head restarted under us: run the owner resync
+                    # (fresh ClientHello adopts the new epoch and
+                    # re-registers the session), then redeliver this same
+                    # batch — order preserved, nothing dropped
+                    import sys
+
+                    if sys.is_finalizing():
+                        return
+                    log.warning(
+                        "head epoch advanced; re-helloing before re-send"
+                    )
+                    if self._on_stale is not None:
+                        try:
+                            self._on_stale()
+                        except Exception:  # noqa: BLE001 - retried below
+                            pass
+                    with self._cv:
+                        if self._stop:
+                            return
+                        self._cv.wait(timeout=0.2)
                 except (RpcError, RuntimeError):
                     # a dropped lease would strand its caller's get()
                     # forever and a dropped release leaks the object —
@@ -1165,8 +1220,22 @@ class RemoteRuntime:
             else None
         )
         self.metrics.update(
-            lease_cache_hits=0, lease_cache_misses=0, lease_spillbacks=0
+            lease_cache_hits=0,
+            lease_cache_misses=0,
+            lease_spillbacks=0,
+            lineage_resubmits=0,
         )
+        # owner-side lineage (ownership-model reconstruction): leased
+        # direct-dispatch tasks never register a spec with the head, so
+        # the owner retains each task's submit item keyed by return ref
+        # and resubmits through head scheduling when the head seals the
+        # object lost-without-lineage. Byte-bounded LRU — an evicted
+        # object's loss is permanent.
+        from collections import OrderedDict as _OrderedDict
+
+        self._lineage_lock = threading.Lock()
+        self._lineage: "_OrderedDict[str, dict]" = _OrderedDict()
+        self._lineage_bytes = 0
         # one cloudpickle of each task function per function OBJECT (weak:
         # dead lambdas drop their blobs); see _serialize_fn
         import weakref
@@ -1193,25 +1262,116 @@ class RemoteRuntime:
         self._shared_pending: set = set()
         self._direct_cv = threading.Condition()
         self._callback_server: Optional[RpcServer] = None
+        # --- owner session + epoch-fenced control plane -----------------
+        # a DRIVER process (one installing its own flusher below) holds a
+        # session lease with the head: it heartbeats on the pipelined
+        # ClientBatch, and a crashed driver is reaped (actors killed,
+        # leases revoked, unproduced objects failed with OwnerDiedError).
+        # Worker-embedded runtimes reuse the worker's identity and fate-
+        # share through the agent's worker-death reports instead.
+        self._stop_event = threading.Event()
+        self._shutdown_done = False
+        self._beat_ticket = 0
+        self._cluster_epoch: Optional[int] = None
+        self._owner_ttl_s = float(cfg.owner_lease_ttl_s)
+        self._owner_session = bool(cfg.owner_liveness) and not isinstance(
+            refcount.current_consumer(), refcount.RefFlusher
+        )
+        self._hello()
         # dedicated channel for the pipeline: its traffic during a head
         # outage must not push the main channel into gRPC reconnect backoff
         self._pipe_chan = RpcClient(address)
-        self._sender = _PipelinedSender(self._pipe_chan)
+        self._sender = _PipelinedSender(
+            self._pipe_chan,
+            epoch_fn=lambda: self._cluster_epoch,
+            on_stale=self._hello,
+        )
         incumbent = refcount.current_consumer()
         if isinstance(incumbent, refcount.RefFlusher):
             self._flusher = incumbent
             self._owns_flusher = False
         else:
+            # _ref_wait_timeout bounds the synchronous ack wait on ref
+            # updates: None (wait out the head) in steady state; shutdown
+            # sets it so the exit path can NEVER hang on a wedged
+            # pipeline (the item stays queued either way, and the head's
+            # disconnect reap drops our holder rows regardless)
+            self._ref_wait_timeout: Optional[float] = None
             self._flusher = refcount.RefFlusher(
                 lambda inc, dec: self._sender.enqueue(
                     "ref",
                     {"holder": self.client_id, "increfs": inc, "decrefs": dec},
                     wait=True,
+                    wait_timeout=self._ref_wait_timeout,
                 ),
                 holder=self.client_id,
             )
             refcount.install_consumer(self._flusher)
             self._owns_flusher = True
+        if self._owner_session:
+            threading.Thread(
+                target=self._owner_beat_loop, name="owner-beat", daemon=True
+            ).start()
+        # best-effort bounded shutdown at interpreter exit: a driver that
+        # never calls shutdown()/exits a with-block still sends its
+        # DisconnectClient instead of falling through to crash detection
+        import atexit
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _exit_hook(_ref=ref):
+            rt = _ref()
+            if rt is not None:
+                try:
+                    rt.shutdown()
+                except Exception:  # noqa: BLE001 - exit path
+                    pass
+
+        self._atexit_hook = _exit_hook
+        atexit.register(_exit_hook)
+
+    def __enter__(self) -> "RemoteRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def _hello(self) -> None:
+        """ClientHello handshake: adopt the cluster epoch this runtime
+        stamps its control stream with, and (driver processes) register
+        the owner session lease. Re-run whenever a rebuilt head rejects
+        our stamp as stale — re-hello IS the owner resync protocol."""
+        try:
+            reply = self.head.call(
+                "ClientHello",
+                {"client_id": self.client_id, "session": self._owner_session},
+                timeout=10.0,
+                retries=3,
+                retry_interval=0.2,
+            )
+        except Exception:  # noqa: BLE001 - unstamped traffic still flows
+            return
+        self._cluster_epoch = reply.get("epoch")
+        ttl = reply.get("owner_ttl_s")
+        if ttl:
+            self._owner_ttl_s = float(ttl)
+        if not reply.get("owner_liveness", True):
+            self._owner_session = False
+
+    def _owner_beat_loop(self) -> None:
+        """Heartbeat the owner session at half the lease TTL, riding the
+        ordered ClientBatch pipeline. At most one beat is ever queued: a
+        head outage must not pile beats behind the retry loop (delivery
+        of anything on the pipeline proves liveness just as well)."""
+        period = max(0.25, self._owner_ttl_s / 2.0)
+        while not self._stop_event.wait(period):
+            sender = self._sender
+            self._beat_ticket = sender.try_enqueue_once(
+                "owner_beat",
+                {"client_id": self.client_id},
+                self._beat_ticket,
+            )
 
     def _read(
         self,
@@ -1323,6 +1483,9 @@ class RemoteRuntime:
                 env_sig,
             )
             if self._lease_mgr.submit(item, shape_key):
+                # the head never sees this task's spec — WE are its
+                # lineage (resubmitted on loss via _maybe_resubmit_lost)
+                self._note_lineage(item)
                 return spec.returns
         lease = LeaseRequest(
             task_id=spec.task_id,
@@ -1796,6 +1959,99 @@ class RemoteRuntime:
         for h in unpin:
             TRACKER.decref(h)
 
+    def _note_lineage(self, item: dict) -> None:
+        """Retain a leased task's submit item as owner-side lineage (the
+        reference keeps lineage at the owner, not the GCS): if every copy
+        of its return object later dies, `_maybe_resubmit_lost` rebuilds
+        it by resubmitting this item through head scheduling. Bounded by
+        `owner_lineage_cap_mb` (LRU by submission order)."""
+        from ray_tpu.config import cfg
+
+        cap = int(cfg.owner_lineage_cap_mb) << 20
+        size = len(item.get("payload") or b"") + len(
+            item.get("fn_blob") or b""
+        )
+        if size > cap:
+            return
+        with self._lineage_lock:
+            old = self._lineage.pop(item["ref"], None)
+            if old is not None:
+                self._lineage_bytes -= old["_lineage_bytes"]
+            item["_lineage_bytes"] = size
+            item["_recon_attempts"] = 0
+            self._lineage[item["ref"]] = item
+            self._lineage_bytes += size
+            while self._lineage_bytes > cap and self._lineage:
+                _, evicted = self._lineage.popitem(last=False)
+                self._lineage_bytes -= evicted["_lineage_bytes"]
+
+    def _maybe_resubmit_lost(self, ref_hex: str, exc: BaseException) -> bool:
+        """Owner-side lineage reconstruction: the head sealed this object
+        ObjectLostError (typically "no re-executable lineage" — leased
+        direct-dispatch tasks never registered a spec head-side). If we
+        still hold the task's lineage and its retry budget isn't spent,
+        resubmit it through per-task head scheduling — SYNCHRONOUSLY, so
+        the head has already cleared the stale error entry when the
+        caller's wait loop polls again (no stale-error re-read burning
+        attempts). Returns True when the caller should keep waiting.
+
+        `max_retries=0` items never resubmit (at-most-once preserved);
+        `OwnerDiedError` is deliberately excluded — OUR owner is us, and
+        a foreign owner's death is a fate-sharing verdict, not a loss."""
+        from ray_tpu.core.object_store import ObjectLostError
+
+        if not isinstance(exc, ObjectLostError):
+            return False
+        with self._lineage_lock:
+            item = self._lineage.get(ref_hex)
+            if item is None:
+                return False
+            if item["_recon_attempts"] >= int(item.get("_max_retries", 0)):
+                return False
+            item["_recon_attempts"] += 1
+            attempt = item["_recon_attempts"]
+        lease = LeaseRequest(
+            task_id=item["task_id"],
+            name=item["name"],
+            payload=item["payload"],
+            return_ids=[item["ref"]],
+            resources=dict(item["_resources"]),
+            kind="task",
+            max_retries=item["_max_retries"],
+            arg_ids=item["arg_ids"],
+            deps=[],
+            client_id=self.client_id,
+            trace=item.get("trace"),
+            fn_blob=item["fn_blob"],
+            fn_id=item["fn_id"],
+            fn_cache=item["fn_cache"],
+            runtime_env=item.get("runtime_env"),
+        )
+        lease.attempt = attempt  # joint budget with head-side retries
+        log = logging.getLogger(__name__)
+        try:
+            self.head.call(
+                "SubmitLease",
+                lease,
+                timeout=30.0,
+                retries=3,
+                retry_interval=0.25,
+            )
+        except Exception:  # noqa: BLE001 - loss stands; caller raises
+            with self._lineage_lock:
+                if ref_hex in self._lineage:
+                    self._lineage[ref_hex]["_recon_attempts"] -= 1
+            return False
+        self.metrics["lineage_resubmits"] += 1
+        log.info(
+            "resubmitting lost leased-task object %s through head "
+            "scheduling (owner-side lineage, attempt %d/%d)",
+            ref_hex[:8],
+            attempt,
+            item["_max_retries"],
+        )
+        return True
+
     def _lease_spill(self, item: dict, may_have_run: bool = False) -> None:
         """Route a leased task back through per-task head scheduling
         (lease loss, stall recall, worker rejection) — the direct-path
@@ -2168,8 +2424,12 @@ class RemoteRuntime:
             if status == "inline":
                 return self._loads_tracking(reply["data"])
             if status == "error":
-                raise pickle.loads(reply["error"])
+                exc = pickle.loads(reply["error"])
+                if self._maybe_resubmit_lost(h, exc):
+                    continue  # owner-side lineage rebuild in flight
+                raise exc
             if status == "located":
+                gone: List[str] = []
                 for nid, addr in reply["locations"]:
                     try:
                         data = self._agent(nid, addr).call(
@@ -2177,9 +2437,32 @@ class RemoteRuntime:
                             {"object_id": ref.hex, "purpose": "get"},
                             timeout=120.0,
                         )
-                        return self._loads_tracking(data)
-                    except (RpcError, KeyError, TimeoutError):
+                    except KeyError:
+                        # definite miss: the node answered without the
+                        # object (evicted / lost mid-spill / stale row)
+                        gone.append(nid)
                         continue
+                    except (RpcError, TimeoutError):
+                        continue
+                    # deserialize OUTSIDE the try: a KeyError raised by
+                    # the payload's own unpickling must surface, not
+                    # prune a live location and re-execute the task
+                    return self._loads_tracking(data)
+                if gone:
+                    # the head prunes those locations and, if that was the
+                    # last copy, rebuilds through lineage — without this a
+                    # stale directory row loops the get forever. Epoch-
+                    # stamped: a pre-restart client's stale rows must not
+                    # prune the rebuilt head's directory
+                    try:
+                        self.head.call(
+                            "ObjectMissing",
+                            {"object_id": ref.hex, "node_ids": gone},
+                            timeout=10.0,
+                            epoch=self._cluster_epoch,
+                        )
+                    except Exception:  # noqa: BLE001 - next poll retries
+                        pass
             if deadline is not None and time.monotonic() >= deadline:
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
 
@@ -2251,7 +2534,11 @@ class RemoteRuntime:
                 if status == "inline":
                     results[h] = ("val", self._loads_tracking(rep["data"]))
                 elif status == "error":
-                    results[h] = ("err", pickle.loads(rep["error"]))
+                    err = pickle.loads(rep["error"])
+                    if not self._maybe_resubmit_lost(h, err):
+                        results[h] = ("err", err)
+                    # else: left unresolved — the next poll parks on the
+                    # owner-side lineage rebuild
                 elif status == "located":
                     located.setdefault(tuple(rep["locations"][0]), []).append(h)
             for (nid, addr), hs in located.items():
@@ -2432,6 +2719,18 @@ class RemoteRuntime:
     def shutdown(self) -> None:
         from ray_tpu.core import refcount
 
+        # idempotent: the atexit hook, __exit__, and explicit shutdown()
+        # may all fire; only the first runs the teardown
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        self._stop_event.set()
+        import atexit
+
+        try:
+            atexit.unregister(self._atexit_hook)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
         if self._lease_mgr is not None:
             self._lease_mgr.stop()  # no new grants/channels from here on
         for chan in list(self._direct_channels.values()):
@@ -2442,7 +2741,11 @@ class RemoteRuntime:
             self._callback_server = None
         if self._owns_flusher:
             # release every id this driver still counts so the cluster can
-            # free driver-owned objects (job-exit cleanup analog)
+            # free driver-owned objects (job-exit cleanup analog). BOUNDED:
+            # a wedged pipeline must not hang process exit — undelivered
+            # releases are covered by the head's disconnect reap dropping
+            # this client's holder rows
+            self._ref_wait_timeout = 10.0
             self._flusher.stop(release_all=True)
             refcount.clear_consumer(self._flusher)
         self._sender.stop()

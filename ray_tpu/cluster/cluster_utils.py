@@ -110,6 +110,11 @@ class Cluster:
         if proc is not None:
             proc.kill()
             proc.wait(timeout=10)
+            # drop the corpse so add_node's wait target counts only
+            # launched-and-living agents (killing every replica of an
+            # object then adding a recovery node must not wait forever
+            # for the dead ones to come back)
+            self._agents.pop(node_id, None)
 
     # ------------------------------------------------------------------
     # chaos fault surface (ray_tpu.chaos rides these)

@@ -100,6 +100,27 @@ TASK_LEASE_REVOKED = _MetricCounter(
     "owner disconnect).",
 )
 
+# recursive lineage reconstruction (depth 0 = the requested object's own
+# creating lease; depth N = a lost input N generations up the chain)
+OBJECTS_RECONSTRUCTED = _MetricCounter(
+    "objects_reconstructed_total",
+    "Objects rebuilt by re-executing their creating lease, by lineage "
+    "depth of the reconstruction walk that requeued them.",
+    label_names=("depth",),
+)
+RECONSTRUCTION_MS = _MetricHistogram(
+    "reconstruction_ms",
+    "Latency from an object's loss being detected to its re-seal.",
+    boundaries=(10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000, 60000),
+)
+
+# owner fate-sharing
+OWNERS_REAPED = _MetricCounter(
+    "owners_reaped_total",
+    "Owner sessions reaped, by how the owner left (disconnect|crash).",
+    label_names=("mode",),
+)
+
 
 def _best_effort(fn, *args, **kwargs):
     try:
@@ -236,6 +257,15 @@ class HeadServer:
         self._pgs_dirty = True  # retry pending PGs only after view changes
         self._kv: Dict[str, bytes] = {}
         self._jobs: Dict[str, dict] = {}
+        # owner liveness (session leases): client_id -> {"last", "strikes",
+        # "last_strike"}. Registered by ClientHello / first owner_beat;
+        # reaped by _check_owner_liveness on missed strikes or by a clean
+        # DisconnectClient.
+        self._owner_sessions: Dict[str, dict] = {}
+        # objects whose loss has been detected and whose rebuild is in
+        # flight: oid -> (t0, depth) — dedups concurrent reconstruction
+        # triggers and feeds the reconstruction metrics on re-seal
+        self._reconstructing: Dict[str, tuple] = {}
         self._shutdown = False
         self._persist_path = persist_path
         self._persist_dirty = False
@@ -260,8 +290,19 @@ class HeadServer:
         from ray_tpu.core.events import TaskEventBuffer
 
         self.events = TaskEventBuffer()
+        self._recovered_epoch = 0
         if persist_path:
             self._load_persisted()
+        # cluster epoch (epoch-fenced control plane): strictly increases
+        # across head incarnations — the persisted epoch + 1 when a
+        # snapshot survives, floored by wall-clock millis so even an
+        # UNPERSISTED restart (or a lost snapshot) still fences out
+        # pre-restart traffic. Agents/owners adopt it at registration and
+        # stamp their control RPCs; stale stamps are rejected before any
+        # handler can touch the rebuilt tables.
+        self.cluster_epoch = max(
+            int(self._recovered_epoch) + 1, int(time.time() * 1000.0)
+        )
         self.metrics: Dict[str, int] = {
             "leases_submitted": 0,
             "leases_finished": 0,
@@ -300,6 +341,8 @@ class HeadServer:
             "CancelLease": self._h_cancel_lease,
             "KillActor": self._h_kill_actor,
             "DisconnectClient": self._h_disconnect_client,
+            "ClientHello": self._h_client_hello,
+            "ObjectMissing": self._h_object_missing,
             "CreatePlacementGroup": self._h_create_pg,
             "WaitPlacementGroup": self._h_wait_pg,
             "RemovePlacementGroup": self._h_remove_pg,
@@ -332,6 +375,12 @@ class HeadServer:
 
         self.jobs = JobManager(None, on_change=self.mark_dirty)
         self._server = RpcServer(handlers, host=host, port=port)
+        if cfg.epoch_fencing:
+            self._server.epoch = self.cluster_epoch
+            # the resync protocol itself must pass the fence: RegisterNode
+            # re-attaches an agent (and hands out the new epoch),
+            # ClientHello does the same for owners, Ping is liveness
+            self._server.fence_exempt = {"RegisterNode", "ClientHello", "Ping"}
         self.address = self._server.address
         self.jobs.head_address = self.address
         for job in getattr(self, "_recovered_jobs", []):
@@ -368,6 +417,8 @@ class HeadServer:
         streams_part = self._snapshot_streams()
         with self._lock:
             return {
+                # the NEXT incarnation starts at a strictly higher epoch
+                "epoch": self.cluster_epoch,
                 "kv": dict(self._kv),
                 "named_actors": dict(self._named_actors),
                 "actors": {
@@ -468,6 +519,7 @@ class HeadServer:
         records = self._backend.wal_replay()
         if not snap and not records:
             return
+        self._recovered_epoch = int(snap.get("epoch", 0))
         self._kv = dict(snap.get("kv", {}))
         self._named_actors = dict(snap.get("named_actors", {}))
         self._actor_specs = dict(snap.get("actor_specs", {}))
@@ -552,6 +604,28 @@ class HeadServer:
             len(self._recovered_jobs),
             len(records),
         )
+        # owner sessions are in-memory only, so fate-sharing must survive
+        # the restart: re-seed a session (fresh deadline) for every owner
+        # the restored actors/leases reference. A live owner's next beat
+        # keeps it fresh; one that crashed around the restart accrues
+        # strikes and gets the full reap — otherwise its actors and
+        # leases would leak forever and dependents would hang instead of
+        # raising OwnerDiedError.
+        if cfg.owner_liveness:
+            owners = {
+                info.owner_client
+                for info in self._actors.values()
+                if info.owner_client
+                and info.lifetime != "detached"
+                and info.state != "DEAD"
+            }
+            owners.update(
+                e["client_id"]
+                for e in self._task_leases.values()
+                if e.get("client_id")
+            )
+            for cid in owners:
+                self._touch_owner(cid)
         # actors recovered as RESTARTING normally re-attach when their
         # hosting agents re-register. One registered-but-never-created
         # (the WAL window) has NO hosting agent — after a grace period,
@@ -748,7 +822,13 @@ class HeadServer:
                 )
                 self._agent_return_lease(info.node_id, lid)
         logger.info("node %s registered at %s", info.node_id, info.address)
-        return {"node_id": info.node_id, "head_address": self.address}
+        return {
+            "node_id": info.node_id,
+            "head_address": self.address,
+            # adopted by the agent: its control RPCs stamp this epoch, so
+            # a future head restart fences it until it re-registers
+            "epoch": self.cluster_epoch,
+        }
 
     def _peer_unreachable(self, node_id: str) -> None:
         """Circuit breaker opened on this peer: its transport has been
@@ -837,6 +917,7 @@ class HeadServer:
                 self._on_node_death(nid)
             self._gc_idle_streams()
             self._expire_task_leases()
+            self._check_owner_liveness()
 
     def _on_node_death(self, node_id: str) -> None:
         with self._cond:
@@ -853,10 +934,16 @@ class HeadServer:
             ]
             for lid, _ in lost_leases:
                 self._in_flight.pop(lid, None)
+            # every object ADVERTISED on the dead node, not only those
+            # whose locations are exactly {node_id}: _recover_object
+            # prunes the stale row either way, and reconstructs only when
+            # no live copy remains — a multi-copy object whose replica
+            # nodes die one by one would otherwise keep its stale rows
+            # forever and never rebuild
             lost_objects = [
                 oid
                 for oid, e in self._objects.items()
-                if e.locations == {node_id} and e.inline is None
+                if node_id in e.locations and e.inline is None
             ]
             dead_actors = [
                 a for a in self._actors.values() if a.node_id == node_id
@@ -952,60 +1039,198 @@ class HeadServer:
             if entry is None:
                 return
             entry.locations.discard(dead_node)
-            if entry.locations or entry.inline is not None:
+            if not self._object_lost_locked(entry):
                 return
-            lease_id = entry.creating_lease
-            spec = self._leases.get(lease_id) if lease_id else None
-            entry.event.clear()
-        if spec is None or spec.kind != "task":
-            self._seal_error_ids(
-                [object_id],
-                RuntimeError(f"object {object_id} lost with node {dead_node}"),
+        self._reconstruct_object(
+            object_id, f"node {dead_node} died", requeued=requeued
+        )
+
+    def _object_lost_locked(self, entry: _ObjEntry) -> bool:
+        """A sealed value with no reachable copy. Caller holds self._lock.
+        Entries still being produced (never sealed, no locations) are NOT
+        lost — their creating lease is already in flight."""
+        if entry.inline is not None or entry.error is not None:
+            return False
+        if entry.locations:
+            return not any(
+                nid in self.nodes and self.nodes[nid].alive
+                for nid in entry.locations
             )
-            return
-        if spec.task_id in requeued:
-            return  # a sibling return already resubmitted this lease
-        if spec.attempt >= spec.max_retries:
+        return entry.event.is_set()
+
+    def _note_reconstructing(self, object_id: str, depth: int) -> None:
+        with self._lock:
+            if object_id not in self._reconstructing:
+                self._reconstructing[object_id] = (time.monotonic(), depth)
+
+    def _reconstruct_object(
+        self,
+        object_id: str,
+        reason: str,
+        depth: int = 0,
+        requeued: Optional[set] = None,
+    ) -> None:
+        """Recursive lineage reconstruction (the reference's
+        ObjectRecoveryManager walk): requeue the lost object's creating
+        lease — and, FIRST, the lineage of any of its inputs that are
+        also lost, so the requeued lease's dependency wait resolves.
+        Depth-bounded by ``cfg.reconstruction_max_depth``; attempt-bounded
+        per lease by ``max_retries`` (``max_retries=0`` keeps strict
+        at-most-once semantics: the object fails instead of re-executing);
+        concurrent triggers for one object dedup through ``requeued`` and
+        the already-pending check."""
+        from ray_tpu.core.object_store import ObjectLostError
+
+        if requeued is None:
+            requeued = set()
+        max_depth = max(0, int(cfg.reconstruction_max_depth))
+        if depth > max_depth:
             self._seal_error_ids(
                 [object_id],
-                RuntimeError(
-                    f"object {object_id} lost; lineage retries exhausted"
+                ObjectLostError(
+                    f"object {object_id} lost ({reason}); rebuilding it "
+                    f"needs more than reconstruction_max_depth={max_depth} "
+                    "generations of lineage re-execution"
                 ),
             )
             return
-        requeued.add(spec.task_id)
+        with self._cond:
+            entry = self._objects.get(object_id)
+            if entry is None or not self._object_lost_locked(entry):
+                return
+            entry.event.clear()  # getters park until the re-seal (or error)
+            lease_id = entry.creating_lease
+            spec = self._leases.get(lease_id) if lease_id else None
+            pending_already = lease_id is not None and (
+                lease_id in requeued
+                or lease_id in self._in_flight
+                or any(s.task_id == lease_id for s in self._pending)
+                or any(s.task_id == lease_id for s in self._scheduling_batch)
+            )
+        if spec is None or spec.kind != "task":
+            self._seal_error_ids(
+                [object_id],
+                ObjectLostError(
+                    f"object {object_id} lost ({reason}); no re-executable "
+                    "lineage (not produced by a plain task)"
+                ),
+            )
+            return
+        self._note_reconstructing(object_id, depth)
+        if pending_already:
+            # one rebuild of this lease re-seals every lost sibling
+            # return; this trigger just joins the in-flight attempt
+            return
+        if spec.attempt >= spec.max_retries:
+            why = (
+                "max_retries=0 (at-most-once): refusing to re-execute"
+                if spec.max_retries == 0
+                else f"lineage retries exhausted ({spec.max_retries})"
+            )
+            self._seal_error_ids(
+                [object_id],
+                ObjectLostError(f"object {object_id} lost ({reason}); {why}"),
+            )
+            return
+        # lost INPUTS first: the requeued lease parks in dependency wait
+        # until they re-seal, so their lineage must be re-executing too
+        for arg in dict.fromkeys(spec.arg_ids):
+            with self._lock:
+                if arg in self._freed:
+                    broken = True
+                    arg_lost = False
+                else:
+                    broken = False
+                    ae = self._objects.get(arg)
+                    arg_lost = ae is not None and self._object_lost_locked(ae)
+            if broken:
+                # an input was already GC'd: the chain cannot re-execute
+                self._seal_error_ids(
+                    [object_id],
+                    ObjectLostError(
+                        f"object {object_id} lost ({reason}); lineage "
+                        f"input {arg} was already freed"
+                    ),
+                )
+                return
+            if arg_lost:
+                self._reconstruct_object(
+                    arg,
+                    f"lineage input of {object_id[:8]}",
+                    depth=depth + 1,
+                    requeued=requeued,
+                )
+        requeued.add(lease_id)
+        logger.info(
+            "reconstructing object %s (depth %d, attempt %d/%d): %s",
+            object_id[:8],
+            depth,
+            spec.attempt + 1,
+            spec.max_retries,
+            reason,
+        )
         spec.attempt += 1
+        spec.target_node = None
         with self._cond:
             self._pending.append(spec)
             self._cond.notify_all()
 
-    def chaos_drop_object(self, object_id: str) -> bool:
-        """Chaos fault: destroy every stored copy of a sealed object and
-        drop its directory locations, then drive the normal lineage
-        recovery path (its creating lease requeues and re-seals the same
-        id). Returns False for objects that can't be meaningfully dropped
-        (unknown, inline-valued, or never sealed)."""
+    def _h_object_missing(self, req: dict) -> None:
+        """A fetcher found an advertised copy definitively absent (the
+        peer answered without the object — evicted, lost mid-spill, or a
+        stale directory row): prune those locations, and if that was the
+        last reachable copy, rebuild through lineage. Transient fetch
+        failures never land here."""
+        oid = req["object_id"]
         with self._lock:
-            e = self._objects.get(object_id)
-            if (
-                e is None
-                or e.inline is not None
-                or e.error is not None
-                or not e.locations
-            ):
-                return False
-            victims = [
-                (nid, self._clients.get(nid)) for nid in list(e.locations)
-            ]
-            e.locations.clear()
-            e.event.clear()
-        for nid, client in victims:
+            e = self._objects.get(oid)
+            if e is None:
+                return
+            for nid in req.get("node_ids") or ():
+                e.locations.discard(nid)
+            lost = self._object_lost_locked(e)
+        if lost:
+            self._reconstruct_object(oid, "all advertised copies missing")
+
+    def chaos_drop_objects(self, object_ids: List[str]) -> int:
+        """Chaos fault: destroy every stored copy of the given sealed
+        objects and drop their directory locations BEFORE driving
+        recovery — so a chain dropped together exercises the recursive
+        walk (an object whose inputs are also gone). Returns how many
+        were actually dropped."""
+        victims: List[Tuple[str, Any, str]] = []
+        dropped: List[str] = []
+        with self._lock:
+            for oid in object_ids:
+                e = self._objects.get(oid)
+                if (
+                    e is None
+                    or e.inline is not None
+                    or e.error is not None
+                    or not e.locations
+                ):
+                    continue
+                victims.extend(
+                    (nid, self._clients.get(nid), oid)
+                    for nid in list(e.locations)
+                )
+                e.locations.clear()
+                dropped.append(oid)
+        for nid, client, oid in victims:
             if client is not None:
                 _best_effort(
-                    client.call, "DeleteObjects", {"object_ids": [object_id]}
+                    client.call, "DeleteObjects", {"object_ids": [oid]}
                 )
-        self._recover_object(object_id, "<chaos>", set())
-        return True
+        requeued: set = set()
+        for oid in dropped:
+            self._reconstruct_object(oid, "<chaos drop>", requeued=requeued)
+        return len(dropped)
+
+    def chaos_drop_object(self, object_id: str) -> bool:
+        """Single-object drop (see chaos_drop_objects). Returns False for
+        objects that can't be meaningfully dropped (unknown,
+        inline-valued, or never sealed)."""
+        return self.chaos_drop_objects([object_id]) == 1
 
     def _restart_or_kill_actor(self, info: ActorInfo, reason: str) -> None:
         with self._lock:
@@ -1096,6 +1321,13 @@ class HeadServer:
                         for inner in e.contained:
                             self._pin(inner)
                 e.event.set()
+                rec = self._reconstructing.pop(s.object_id, None)
+                if rec is not None and not s.is_error:
+                    t0, rec_depth = rec
+                    RECONSTRUCTION_MS.observe((time.monotonic() - t0) * 1e3)
+                    OBJECTS_RECONSTRUCTED.inc(
+                        labels={"depth": str(rec_depth)}
+                    )
                 check.append(s.object_id)
             self._cond.notify_all()
         for nid, oid in stale:
@@ -1182,6 +1414,8 @@ class HeadServer:
                     spec.return_ids,
                     RuntimeError(fail.get("reason", "worker failure")),
                 )
+        for miss in req.get("objects_missing", ()):
+            self._h_object_missing(miss)
         if req.get("task_leases"):
             self._apply_task_lease_reports(req["task_leases"])
         for actor_ready in req.get("actors_alive", []):
@@ -1385,17 +1619,35 @@ class HeadServer:
                         e.tracked = True
             self._maybe_free_many(undelivered)
 
-    def _seal_error_ids(self, object_ids: List[str], exc: BaseException) -> None:
+    def _seal_error_ids(
+        self,
+        object_ids: List[str],
+        exc: BaseException,
+        keep_for_owner: bool = False,
+    ) -> None:
+        """Seal error values. ``keep_for_owner`` is the owner-death mode:
+        already-produced values win over the error (the reap only fails
+        UNproduced objects) and the sealed error entry is made GC-exempt
+        so the typed OwnerDiedError outlives the dead owner's holder drop
+        (bounded: one small pickled exception per unproduced object)."""
         blob = pickle.dumps(exc)
         with self._cond:
             for oid in object_ids:
                 if oid in self._freed:
                     continue
                 e = self._objects.setdefault(oid, _ObjEntry())
+                if keep_for_owner:
+                    if e.event.is_set() and e.error is None:
+                        continue  # produced before the owner died
+                    e.tracked = False
                 e.error = blob
                 e.event.set()
+                # a failed rebuild ends the reconstruction attempt (no
+                # success metric)
+                self._reconstructing.pop(oid, None)
             self._cond.notify_all()
-        self._maybe_free_many(object_ids)
+        if not keep_for_owner:
+            self._maybe_free_many(object_ids)
 
     def _h_put_object(self, req: dict) -> dict:
         """Driver put: small values inline at the head; large ones are
@@ -1616,6 +1868,12 @@ class HeadServer:
         with self._lock:
             for oid in spec.return_ids:
                 e = self._objects.setdefault(oid, _ObjEntry())
+                if e.error is not None and spec.attempt > 0:
+                    # owner-side lineage resubmission of a LOST object:
+                    # the stale loss error must not shadow the rebuild —
+                    # getters park until the re-seal lands
+                    e.error = None
+                    e.event.clear()
                 e.creating_lease = spec.task_id
                 e.tracked = True
                 if holder and not e.owner_registered:
@@ -1725,6 +1983,18 @@ class HeadServer:
     # lease intake + the batched scheduler
     # ------------------------------------------------------------------
     def _h_submit_lease(self, spec: LeaseRequest) -> dict:
+        # reconstruction-class resubmissions (attempt > 0: owner-side
+        # lineage rebuilds, at-least-once redeliveries) dedup by task_id —
+        # one rebuild re-seals every getter's wait; first submissions
+        # (the hot path) skip the scan entirely
+        if spec.attempt > 0:
+            with self._cond:
+                if spec.task_id in self._in_flight or any(
+                    s.task_id == spec.task_id
+                    for q in (self._pending, self._scheduling_batch)
+                    for s in q
+                ):
+                    return {"queued": True, "dedup": True}
         self._register_return_holder(spec)
         if spec.streaming:
             # the stream exists from submission: a consumer's WaitStream
@@ -1770,6 +2040,8 @@ class HeadServer:
                 _best_effort(self._h_lease_renew, payload)
             elif kind == "lease_return":
                 _best_effort(self._h_lease_return, payload)
+            elif kind == "owner_beat":
+                _best_effort(self._h_owner_beat, payload)
 
     # ------------------------------------------------------------------
     # task leases (lease-cached direct dispatch): the head schedules
@@ -2836,6 +3108,74 @@ class HeadServer:
             raise ValueError(f"unknown actor {actor_id}")
         return info
 
+    # ------------------------------------------------------------------
+    # owner liveness + fate-sharing (GcsJobManager / worker-failure
+    # ownership analog): clients hold a session lease, heartbeat it on
+    # the pipelined ClientBatch, and a crashed owner is fully reaped —
+    # actors killed, worker leases revoked immediately, queued/in-flight
+    # tasks cancelled, unproduced objects failed with OwnerDiedError.
+    # ------------------------------------------------------------------
+    def _h_client_hello(self, req: dict) -> dict:
+        """Connection handshake: registers the owner session (when the
+        caller runs one) and hands out the cluster epoch the client
+        stamps its control stream with. Fence-exempt — this IS the
+        owner-side resync protocol after a head restart."""
+        cid = req.get("client_id")
+        if cid and req.get("session") and cfg.owner_liveness:
+            self._touch_owner(cid)
+        return {
+            "epoch": self.cluster_epoch,
+            "owner_ttl_s": float(cfg.owner_lease_ttl_s),
+            "owner_liveness": bool(cfg.owner_liveness),
+        }
+
+    def _touch_owner(self, cid: str) -> None:
+        with self._lock:
+            sess = self._owner_sessions.get(cid)
+            if sess is None:
+                sess = self._owner_sessions[cid] = {"last_strike": 0.0}
+            sess["last"] = time.monotonic()
+            sess["strikes"] = 0
+
+    def _h_owner_beat(self, req: dict) -> None:
+        """Owner session heartbeat (ClientBatch ``owner_beat``). Also the
+        re-registration path after a head restart: the first beat the
+        rebuilt head sees recreates the session."""
+        cid = req.get("client_id")
+        if cid and cfg.owner_liveness:
+            self._touch_owner(cid)
+
+    def _check_owner_liveness(self) -> None:
+        """Strike-based owner death detection (same shape as the node
+        health loop): an owner that misses ``owner_miss_threshold``
+        consecutive windows of ``owner_lease_ttl_s`` is declared dead and
+        fully reaped. One strike per window, not per poll."""
+        if not cfg.owner_liveness:
+            return
+        ttl = max(0.1, float(cfg.owner_lease_ttl_s))
+        threshold = max(1, int(cfg.owner_miss_threshold))
+        now = time.monotonic()
+        dead = []
+        with self._lock:
+            for cid, sess in self._owner_sessions.items():
+                gap = now - sess.get("last", now)
+                if gap <= ttl:
+                    sess["strikes"] = 0
+                    continue
+                if now - sess.get("last_strike", 0.0) >= ttl * 0.9:
+                    sess["strikes"] = sess.get("strikes", 0) + 1
+                    sess["last_strike"] = now
+                if sess.get("strikes", 0) >= threshold:
+                    dead.append(cid)
+        for cid in dead:
+            logger.warning(
+                "owner %s missed %d consecutive heartbeat windows; "
+                "declaring it dead and reaping",
+                cid[:8],
+                threshold,
+            )
+            self._reap_owner(cid, crashed=True, reason="owner heartbeat lost")
+
     def _h_disconnect_client(self, req: dict) -> None:
         """A driver disconnected cleanly: reap its NON-detached actors
         (reference job-exit semantics — actors die with their owner
@@ -2844,7 +3184,17 @@ class HeadServer:
         cid = req.get("client_id")
         if not cid:
             return
+        self._reap_owner(cid, crashed=False, reason="client disconnected")
+
+    def _reap_owner(self, cid: str, crashed: bool, reason: str) -> None:
+        """The full owner reap. Clean disconnects return worker leases
+        and kill non-detached actors; a CRASHED owner additionally has
+        its queued/in-flight tasks cancelled, its unproduced objects
+        failed with OwnerDiedError (fate-sharing — dependents raise a
+        typed error instead of hanging forever), and its holder counts
+        dropped so produced objects it alone referenced are freed."""
         with self._lock:
+            self._owner_sessions.pop(cid, None)
             victims = [
                 info.actor_id
                 for info in self._actors.values()
@@ -2857,19 +3207,24 @@ class HeadServer:
                 for lid, e in self._task_leases.items()
                 if e.get("client_id") == cid
             ]
-        # the disconnecting owner's cached worker leases go back to their
-        # pools (a crashed driver skips this; the TTL sweep reclaims them)
+        # cached worker leases go back to their pools IMMEDIATELY — a
+        # crashed owner's leases must not pin workers for 3x TTL
         for lid, node_id in dead_leases:
             with self._cond:
                 if self._drop_task_lease_locked(lid) is not None:
-                    self.metrics["task_leases_returned"] += 1
-                    TASK_LEASE_RETURNED.inc()
+                    key = (
+                        "task_leases_revoked"
+                        if crashed
+                        else "task_leases_returned"
+                    )
+                    self.metrics[key] += 1
+                    (TASK_LEASE_REVOKED if crashed else TASK_LEASE_RETURNED).inc()
                 self._cond.notify_all()
             self._wal_flush()
             if node_id:
                 self._agent_return_lease(node_id, lid)
         # reap OFF the handler thread: agent kill RPCs can block up to
-        # their timeout per victim, while the disconnecting client only
+        # their timeout per victim, while a disconnecting client only
         # waits ~5s for this reply
         for aid in victims:
             self._dispatch_pool.submit(
@@ -2877,12 +3232,80 @@ class HeadServer:
                 self._h_kill_actor,
                 {"actor_id": aid, "no_restart": True},
             )
-        if victims:
+        if crashed:
+            self._fail_owner_work(cid)
+        # produced objects fate-share through the refcount: the departed
+        # owner's holds drop, freeing anything it alone referenced. Clean
+        # disconnects normally release everything themselves first (then
+        # this is a no-op), but a bounded exit drain may leave stragglers
+        # — a client that is GONE can never send those releases later.
+        self._drop_holder(cid)
+        OWNERS_REAPED.inc(labels={"mode": "crash" if crashed else "disconnect"})
+        if victims or dead_leases or crashed:
             logger.info(
-                "client %s disconnected; reaping %d non-detached actors",
+                "owner %s reaped (%s): %d actors, %d worker leases",
                 cid[:8],
+                reason,
                 len(victims),
+                len(dead_leases),
             )
+
+    def _fail_owner_work(self, cid: str) -> None:
+        """Cancel a dead owner's queued and in-flight tasks and fail their
+        return objects with OwnerDiedError."""
+        from ray_tpu.core.object_store import OwnerDiedError
+
+        doomed: List[LeaseRequest] = []
+        in_flight: List[Tuple[str, str]] = []
+
+        def _owned(s: LeaseRequest) -> bool:
+            return s.client_id == cid and s.kind in ("task", "actor_method")
+
+        with self._cond:
+            for q in (self._pending, self._infeasible):
+                kept = [s for s in q if not _owned(s)]
+                doomed.extend(s for s in q if _owned(s))
+                q.clear()
+                q.extend(kept)
+            for s in self._scheduling_batch:
+                # mid-schedule: flag for the dispatch-time filter
+                if _owned(s):
+                    self._cancelled_leases.add(s.task_id)
+                    doomed.append(s)
+            for lid, (spec, nid) in list(self._in_flight.items()):
+                if _owned(spec):
+                    del self._in_flight[lid]
+                    self._cancelled_leases.add(lid)
+                    in_flight.append((lid, nid))
+                    doomed.append(spec)
+            self._cond.notify_all()
+        for lid, nid in in_flight:
+            client = self._clients.get(nid)
+            if client is not None:
+                self._dispatch_pool.submit(
+                    _best_effort,
+                    client.call,
+                    "CancelLease",
+                    {"task_id": lid, "force": False},
+                )
+        if not doomed:
+            return
+        err = OwnerDiedError(
+            f"the owner of this object (client {cid[:8]}) died before the "
+            "object was produced; objects fate-share with their owner"
+        )
+        ids = [oid for s in doomed for oid in s.return_ids]
+        # keep_for_owner: the typed error must outlive the owner's holder
+        # drop so dependents observe OwnerDiedError, not a generic
+        # freed-object error
+        self._seal_error_ids(ids, err, keep_for_owner=True)
+        for s in doomed:
+            if s.streaming:
+                self._fail_stream(s, "owner died")
+            self._release_lease_pins(s.task_id)
+        logger.info(
+            "owner %s: cancelled %d queued/in-flight tasks", cid[:8], len(doomed)
+        )
 
     def _h_kill_actor(self, req: dict) -> None:
         info = self._actors.get(req["actor_id"])

@@ -43,6 +43,17 @@ class RpcDeadlineError(RpcError):
     """The caller's overall deadline was exhausted across retries."""
 
 
+class RpcStaleEpochError(Exception):
+    """The caller stamped this RPC with a cluster epoch older than the
+    receiver's: the sender joined a PREVIOUS head incarnation and its
+    state (lease table rows, object locations, actor attachments) may
+    have been rebuilt since. NOT an RpcError — handler-level exceptions
+    re-raise at the caller immediately without consuming the retry
+    budget, so stale traffic can never mutate the rebuilt tables by
+    retrying its way in. The sender re-registers to adopt the new epoch
+    (re-registration is the resync protocol) and only then resumes."""
+
+
 class RpcUnknownMethodError(RpcError):
     """The peer has no handler registered for the requested method —
     dispatch-table drift (a caller invoking a kind the receiving side
@@ -55,6 +66,24 @@ class RpcUnknownMethodError(RpcError):
 class _Blackholed(Exception):
     """Injected partition: the peer is unreachable from this process.
     Handled exactly like a transport failure (retries, breaker)."""
+
+
+class FencedPayload:
+    """Wire envelope stamping a request with the sender's cluster epoch
+    (``RpcClient.call(epoch=...)``). A server whose ``epoch`` is set (the
+    head) rejects envelopes from an older epoch with
+    :class:`RpcStaleEpochError` BEFORE the handler runs — stale traffic
+    can never mutate rebuilt tables. Servers with no epoch (agents,
+    workers) and methods in ``fence_exempt`` just unwrap."""
+
+    __slots__ = ("epoch", "payload")
+
+    def __init__(self, epoch: int, payload: Any):
+        self.epoch = epoch
+        self.payload = payload
+
+    def __reduce__(self):
+        return (FencedPayload, (self.epoch, self.payload))
 
 
 class FaultInjection:
@@ -415,8 +444,34 @@ HANDLER_STATS = HandlerStats()
 
 
 class _GenericHandler(grpc.GenericRpcHandler):
-    def __init__(self, handlers: Dict[str, Callable[[Any], Any]]):
+    def __init__(
+        self,
+        handlers: Dict[str, Callable[[Any], Any]],
+        server: "Optional[RpcServer]" = None,
+    ):
         self._handlers = handlers
+        self._rpc_server = server
+
+    def _unfence(self, name: str, req: Any) -> Any:
+        """Enforce epoch fencing on a stamped request. The epoch check is
+        strictly-less-than: a sender from THIS incarnation (or a future
+        one racing a restart) passes; only provably-stale traffic — a
+        peer that registered with a PREVIOUS head — is rejected, before
+        its handler can touch any table."""
+        if not isinstance(req, FencedPayload):
+            return req
+        srv = self._rpc_server
+        if (
+            srv is not None
+            and srv.epoch is not None
+            and name not in srv.fence_exempt
+            and req.epoch < srv.epoch
+        ):
+            raise RpcStaleEpochError(
+                f"rpc {name} stamped with epoch {req.epoch} but the "
+                f"cluster epoch is {srv.epoch}; re-register to resync"
+            )
+        return req.payload
 
     def service(self, handler_call_details):
         name = handler_call_details.method.rsplit("/", 1)[-1]
@@ -444,7 +499,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
         def unary(request_bytes, context):
             t0 = time.perf_counter()
             try:
-                req = wire.loads(request_bytes)
+                req = self._unfence(name, wire.loads(request_bytes))
                 return wire.dumps((True, fn(req)))
             except BaseException as exc:  # noqa: BLE001 - shipped to caller
                 try:
@@ -474,11 +529,18 @@ class RpcServer:
         port: int = 0,
         max_workers: int = 32,
     ):
+        # epoch fencing (set by the head after recovery): stamped requests
+        # older than this are rejected with RpcStaleEpochError; methods in
+        # fence_exempt (the resync protocol itself) always pass
+        self.epoch: Optional[int] = None
+        self.fence_exempt: set = set()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=_OPTIONS,
         )
-        self._server.add_generic_rpc_handlers((_GenericHandler(handlers),))
+        self._server.add_generic_rpc_handlers(
+            (_GenericHandler(handlers, server=self),)
+        )
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise RpcError(f"could not bind RPC server on {host}:{port}")
@@ -534,6 +596,7 @@ class RpcClient:
         retries: int = 0,
         retry_interval: float = 0.1,
         deadline_s: Optional[float] = None,
+        epoch: Optional[int] = None,
     ) -> Any:
         """Round-trip ``payload`` to handler ``method``.
 
@@ -541,11 +604,16 @@ class RpcClient:
         caller's OVERALL budget — no retry sequence (attempts + backoff)
         ever exceeds it, and per-attempt timeouts shrink to the remaining
         budget. Transport failures (gRPC errors, injected drops/partitions)
-        consume the retry budget; handler exceptions re-raise immediately."""
+        consume the retry budget; handler exceptions re-raise immediately.
+        ``epoch`` stamps the request with the sender's cluster epoch
+        (epoch-fenced control plane): an epoch-checking receiver rejects
+        stale stamps with a non-retryable RpcStaleEpochError."""
         import random
 
         from ray_tpu.config import cfg
 
+        if epoch is not None:
+            payload = FencedPayload(int(epoch), payload)
         data = wire.dumps(payload)
         attempt = 0
         deadline = (

@@ -122,6 +122,12 @@ class Worker:
                 from ray_tpu.native import NativeObjectStore
 
                 self.store = NativeObjectStore(path=store_path, create=False)
+                # crash-durable view-pin sidecar: if this worker is
+                # SIGKILLed with zero-copy views outstanding, the agent
+                # replays the log and releases the pins (zombie-pin
+                # reclamation) instead of leaking arena space until the
+                # next arena restart
+                self.store.enable_pin_tracking()
             except Exception:  # noqa: BLE001
                 logger.warning("worker could not open shm store %s", store_path)
         self._actors: Dict[str, Any] = {}

@@ -436,6 +436,63 @@ define(
 )
 
 # ---------------------------------------------------------------------------
+# owner liveness + lineage reconstruction + epoch fencing (robustness)
+# ---------------------------------------------------------------------------
+define(
+    "owner_liveness",
+    True,
+    "Owner fate-sharing: clients heartbeat a session lease to the head "
+    "(riding the pipelined ClientBatch); an owner that misses "
+    "owner_miss_threshold consecutive windows of owner_lease_ttl_s is "
+    "declared dead and fully reaped — non-detached actors killed, cached "
+    "worker leases revoked immediately, queued/in-flight tasks cancelled, "
+    "and unproduced objects failed with OwnerDiedError. Off: crashed "
+    "owners leak actors until explicit kill and leases until 3x TTL.",
+)
+define(
+    "owner_lease_ttl_s",
+    10.0,
+    "Owner session heartbeat window; clients beat at half this period. "
+    "Death is declared after owner_miss_threshold consecutive missed "
+    "windows (total detection ~ttl x threshold).",
+)
+define(
+    "owner_miss_threshold",
+    3,
+    "Consecutive missed owner heartbeat windows before the head declares "
+    "the owner dead and reaps its actors/leases/objects.",
+)
+define(
+    "owner_lineage_cap_mb",
+    64,
+    "Byte budget (MiB) for the owner-side lineage cache: leased direct-"
+    "dispatch tasks never register a spec with the head, so the OWNER "
+    "retains each task's payload keyed by its return ref and resubmits "
+    "through head scheduling when the head reports the object lost "
+    "without re-executable lineage (the reference's ownership model — "
+    "lineage lives with the owner). Oldest entries evict past the cap; "
+    "an evicted object's loss is then permanent (ObjectLostError).",
+)
+define(
+    "reconstruction_max_depth",
+    8,
+    "Bound on the recursive lineage reconstruction walk: an object whose "
+    "rebuild requires re-executing more than this many generations of "
+    "lost inputs fails with a reconstruction-depth error instead of "
+    "walking an unbounded chain.",
+)
+define(
+    "epoch_fencing",
+    True,
+    "Epoch-fenced control plane: head restarts bump a persisted cluster "
+    "epoch; agents and owners stamp their control RPCs with the epoch "
+    "they joined under, and stale-epoch traffic is rejected with a "
+    "non-retryable RpcStaleEpochError (the sender re-registers to adopt "
+    "the new epoch). Off: a partitioned pre-restart agent's reports can "
+    "land on a rebuilt head unfenced.",
+)
+
+# ---------------------------------------------------------------------------
 # compiled DAG
 # ---------------------------------------------------------------------------
 define(

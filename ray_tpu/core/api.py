@@ -12,6 +12,7 @@ from .object_store import (  # noqa: F401  (re-exported errors)
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,
+    OwnerDiedError,
     TaskError,
 )
 from .runtime import (

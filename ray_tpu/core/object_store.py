@@ -41,6 +41,14 @@ class ObjectLostError(Exception):
     pass
 
 
+class OwnerDiedError(Exception):
+    """The process that owned this object (submitted its creating task or
+    held its only record) died before the object could be produced.
+    Objects fate-share with their owner — the reference's OwnerDiedError
+    (python/ray/exceptions.py): dependents raise this typed error instead
+    of hanging on an object that will never seal."""
+
+
 class GetTimeoutError(TimeoutError):
     pass
 

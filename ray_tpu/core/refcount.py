@@ -345,11 +345,21 @@ class RefFlusher:
                 "flusher release_all: %d ids", len(rel)
             )
             if rel:
-                with self._send_lock:
-                    try:
-                        self._send([], rel)
-                    except Exception:  # noqa: BLE001
-                        pass
+                # BOUNDED acquire: a flush thread wedged mid-send on a dead
+                # head (enqueue ack-wait) holds _send_lock forever — exit
+                # must not block on it. Undelivered releases are covered by
+                # the head's disconnect reap of this holder's rows.
+                if not self._send_lock.acquire(timeout=5.0):
+                    logging.getLogger("ray_tpu.refcount").debug(
+                        "flusher release_all skipped: send lock wedged"
+                    )
+                    return
+                try:
+                    self._send([], rel)
+                except Exception:  # noqa: BLE001
+                    pass
+                finally:
+                    self._send_lock.release()
 
 
 def loads_tracking(flusher: "RefFlusher", data):

@@ -364,6 +364,20 @@ uint8_t* rtpu_store_base(void* handle) {
   return ((Store*)handle)->arena;
 }
 
+// Deleted-with-outstanding-pins entries still holding arena space. A
+// nonzero count after every reader released (or died and had its pins
+// released by the agent) is a leak; the chaos soak asserts zero.
+uint64_t rtpu_store_zombie_count(void* handle) {
+  Store* s = (Store*)handle;
+  if (lock_hdr(s->hdr) != 0) return 0;
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < s->hdr->table_slots; i++) {
+    if (s->table[i].state == kEntryZombie) n++;
+  }
+  pthread_mutex_unlock(&s->hdr->mutex);
+  return n;
+}
+
 void rtpu_store_stats(void* handle, uint64_t* capacity, uint64_t* used,
                       uint64_t* num_objects) {
   Store* s = (Store*)handle;

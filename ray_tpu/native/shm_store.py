@@ -27,6 +27,74 @@ from .build import build_native
 _ID_LEN = 28
 
 
+def pin_log_path(arena_path: str, pid: int) -> str:
+    return f"{arena_path}.pins.{pid}"
+
+
+class PinLog:
+    """Append-only, crash-durable sidecar recording one process's
+    outstanding ``get_view`` pins as ``P <id> <offset>`` / ``R <id>
+    <offset>`` lines.
+
+    The shared-memory refcount lives in the arena header, so a reader
+    that dies (SIGKILL) leaks its pins — the arena can't know. The log
+    lets the AGENT net out the dead reader's outstanding pins and
+    release them (id, offset)-precise. Ordering is chosen so a crash in
+    any window can only leak (bounded, reclaimed at the next arena
+    restart), never double-release: the pin record lands AFTER the pin
+    is taken, and the release record lands BEFORE the refcount drops —
+    replay therefore never releases a share the process still held."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # O_APPEND: each record is one short write, atomic per POSIX
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
+
+    def pin(self, oid: bytes, offset: int) -> None:
+        try:
+            os.write(self._fd, b"P %s %d\n" % (oid, offset))
+        except OSError:
+            pass  # best-effort: a full disk must not fail reads
+
+    def release(self, oid: bytes, offset: int) -> None:
+        try:
+            os.write(self._fd, b"R %s %d\n" % (oid, offset))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        # the file itself is NOT unlinked here: even a clean exit can
+        # leave un-finalized views, and the agent's death replay is what
+        # nets the log out and removes it
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+
+def read_outstanding_pins(path: str):
+    """Net a pin log down to its outstanding ``(id, offset) -> count``
+    entries. Tolerates a torn trailing record (crash mid-write)."""
+    from collections import Counter
+
+    out: "Counter" = Counter()
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return out
+    for line in data.split(b"\n"):
+        parts = line.split()
+        if len(parts) != 3 or parts[0] not in (b"P", b"R"):
+            continue
+        try:
+            key = (bytes(parts[1]), int(parts[2]))
+        except ValueError:
+            continue
+        out[key] += 1 if parts[0] == b"P" else -1
+    return out
+
+
 class NativeObjectStore:
     def __init__(
         self,
@@ -67,6 +135,8 @@ class NativeObjectStore:
         ]
         lib.rtpu_store_base.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_zombie_count.restype = ctypes.c_uint64
+        lib.rtpu_store_zombie_count.argtypes = [ctypes.c_void_p]
         lib.rtpu_store_stats.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_uint64),
@@ -85,6 +155,19 @@ class NativeObjectStore:
         )
         if not self._h:
             raise OSError(f"failed to open native store at {self.path}")
+        # per-pid crash-safe registry of outstanding view pins (see
+        # PinLog): enabled by long-lived readers (workers) so the agent
+        # can release a SIGKILLed reader's pins release_at-precise
+        # instead of leaking arena zombies until restart
+        self._pin_log: Optional[PinLog] = None
+
+    def enable_pin_tracking(self) -> None:
+        """Track this process's ``get_view`` pins in a crash-durable
+        per-pid sidecar (``<arena>.pins.<pid>``). The agent replays the
+        sidecar when this process dies and releases every outstanding
+        pin, so a SIGKILLed reader no longer leaks zombie entries."""
+        if self._pin_log is None:
+            self._pin_log = PinLog(pin_log_path(self.path, os.getpid()))
 
     # -- raw bytes ------------------------------------------------------
     def _norm_id(self, object_id: str) -> bytes:
@@ -180,6 +263,10 @@ class NativeObjectStore:
         concurrent delete defers the arena free until then."""
         oid = self._norm_id(object_id)
         off, size = self.get_buffer(object_id)  # pins
+        if self._pin_log is not None:
+            # recorded AFTER the pin exists: a crash between the two can
+            # only leak this one pin, never replay-release a live share
+            self._pin_log.pin(oid, off)
         base = self._lib.rtpu_store_base(self._h)
         raw = (ctypes.c_uint8 * size).from_address(
             ctypes.addressof(base.contents) + off
@@ -192,10 +279,41 @@ class NativeObjectStore:
 
     def _release_pin(self, oid: bytes, off: int) -> None:
         if self._h:
+            if self._pin_log is not None:
+                # logged BEFORE the refcount drops: a crash in between
+                # leaks (reclaimed next restart) instead of letting the
+                # agent's replay double-release a freed entry
+                self._pin_log.release(oid, off)
             try:
                 self._lib.rtpu_store_release_at(self._h, oid, off)
             except Exception:  # noqa: BLE001 - interpreter teardown
                 pass
+
+    def release_dead_pins(self, pid: int) -> int:
+        """Replay a dead reader's pin log and release every pin it still
+        held ((id, offset)-precise — exactly what its finalizers would
+        have done). Returns the number of pins released; removes the
+        log. The agent calls this from its worker-death path so a
+        SIGKILLed reader's zombies are reclaimed immediately instead of
+        at the next arena restart."""
+        path = pin_log_path(self.path, pid)
+        outstanding = read_outstanding_pins(path)
+        released = 0
+        for (oid, off), n in outstanding.items():
+            for _ in range(max(0, n)):
+                if self._lib.rtpu_store_release_at(self._h, oid, off) == 0:
+                    released += 1
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return released
+
+    def zombie_count(self) -> int:
+        """Entries deleted while readers still pinned them and not yet
+        reclaimed. Nonzero after every reader released (or died and had
+        its pin log replayed) means a leak; the chaos soak asserts 0."""
+        return int(self._lib.rtpu_store_zombie_count(self._h))
 
     # -- zero-copy numpy ------------------------------------------------
     def put_numpy(self, object_id: str, arr: np.ndarray) -> None:
@@ -250,6 +368,9 @@ class NativeObjectStore:
         }
 
     def close(self, unlink: bool = False) -> None:
+        if self._pin_log is not None:
+            self._pin_log.close()
+            self._pin_log = None
         if self._h:
             self._lib.rtpu_store_close(self._h)
             self._h = None

@@ -72,6 +72,16 @@ class SpillingStore:
     def store_path(self) -> str:  # workers map the inner arena
         return getattr(self.inner, "path", "")
 
+    def release_dead_pins(self, pid: int) -> int:
+        """Replay a dead reader's view-pin log against the inner arena
+        (zombie-pin reclamation); 0 with the in-memory fallback store."""
+        fn = getattr(self.inner, "release_dead_pins", None)
+        return int(fn(pid)) if fn is not None else 0
+
+    def zombie_count(self) -> int:
+        fn = getattr(self.inner, "zombie_count", None)
+        return int(fn()) if fn is not None else 0
+
     # -- bookkeeping ---------------------------------------------------
     def note_external(self, oid: str, size: int) -> None:
         """A worker sealed this object straight into the shared arena."""

@@ -31,6 +31,8 @@ def test_plan_is_deterministic_per_seed():
         "straggler",
         "object_drop",
         "kill_node",
+        "owner_kill",
+        "zygote_kill",
         "head_restart",
     }
 
@@ -111,13 +113,18 @@ def test_fast_deterministic_chaos_tier():
 @pytest.mark.slow
 def test_chaos_soak_twenty_faults_zero_acked_loss(monkeypatch):
     """The acceptance soak: >=20 faults across every kind (kills,
-    partitions, head restarts included) against a running workload —
-    zero acked-object loss, all restartable actors recovered, all
+    partitions, head restarts, owner kills, zygote kills included)
+    against a running workload — zero acked-object loss, zero leaked
+    arena zombies, zero leaked actors/leases after owner death, all
     invariant checks green."""
     # tight-but-real failure detection: the soak spends its wall clock on
     # faults, not on twenty 8s death timeouts
     monkeypatch.setenv("RAY_TPU_HEALTH_TIMEOUT_S", "4.0")
     monkeypatch.setenv("RAY_TPU_RPC_BREAKER_WINDOW_S", "2.0")
+    # owner-death detection ~ ttl x threshold: keep it a few seconds so
+    # each owner_kill fault converges well inside its budget
+    monkeypatch.setenv("RAY_TPU_OWNER_LEASE_TTL_S", "1.5")
+    monkeypatch.setenv("RAY_TPU_OWNER_MISS_THRESHOLD", "2")
     seed = chaos_seed(default=20260803)
     result = _run_chaos(
         num_faults=20,
@@ -135,6 +142,13 @@ def test_chaos_soak_twenty_faults_zero_acked_loss(monkeypatch):
     counts = result.summary()["fault_counts"]
     assert counts.get("kill_node", 0) >= 1
     assert counts.get("partition", 0) >= 1
+    assert counts.get("owner_kill", 0) >= 1
+    assert counts.get("zygote_kill", 0) >= 1
     assert result.objects_acked >= 20
+    # zombie-pin reclamation: no arena entry may stay deleted-with-pins
+    # once every reader released or died (pin-log replay)
+    assert result.arena_zombies_after == 0, (
+        f"{result.arena_zombies_after} arena zombies leaked after soak"
+    )
     # replaying the seed reproduces the same schedule
     assert make_plan(seed, 20) == make_plan(seed, 20)

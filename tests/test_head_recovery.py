@@ -313,3 +313,208 @@ def test_wal_recovered_actor_resubmits_creation(tmp_path, monkeypatch):
     finally:
         h2._server.stop()
         h2._shutdown = True
+
+
+# ---------------------------------------------------------------------------
+# recursive lineage reconstruction + epoch-fenced control plane (PR 5)
+# ---------------------------------------------------------------------------
+
+# > inline_object_max (100KiB): the chain's objects are store-resident,
+# so losing their node genuinely loses the bytes
+_CHAIN_PAD = 256 * 1024
+
+
+def _chain_seed():
+    return b"a" * _CHAIN_PAD
+
+
+def _chain_step(prev, tag):
+    # deterministic transform: the tail value proves every upstream
+    # re-execution reproduced its input exactly
+    import hashlib
+
+    return hashlib.sha256(prev).digest() + tag.encode() * _CHAIN_PAD
+
+
+def _touch_and_seed(marker_path):
+    with open(marker_path, "a") as f:
+        f.write("x")
+    return b"o" * _CHAIN_PAD
+
+
+def test_deep_lineage_reconstruction_after_node_kill(monkeypatch):
+    """3-task chain seed -> mid -> tail; SIGKILL the node holding the
+    mid-chain object. The reconstruction walk re-executes mid's creating
+    lease — and, recursively, seed's too when its copy died with the same
+    node — and both the mid and tail values stay correct
+    (ObjectRecoveryManager's recursive re-execution analog)."""
+    monkeypatch.setenv("RAY_TPU_HEALTH_TIMEOUT_S", "4.0")
+    c = Cluster(use_device_scheduler=False)
+    c.add_node({"CPU": 2.0}, num_workers=2)
+    c.add_node({"CPU": 2.0}, num_workers=2)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        seed = ray_tpu.remote(_chain_seed)
+        step = ray_tpu.remote(_chain_step)
+        a = seed.remote()
+        b = step.remote(a, "b")
+        tail = step.remote(b, "t")
+        expect_b = _chain_step(_chain_seed(), "b")
+        expect_tail = _chain_step(expect_b, "t")
+        assert ray_tpu.get(tail, timeout=120) == expect_tail
+        head = c.head
+        with head._lock:
+            locs = set(head._objects[b.hex].locations)
+        assert locs, "mid-chain object never landed in the store"
+        for nid in locs:
+            c.kill_node(nid)
+        with head._lock:
+            survivors = [
+                nid
+                for nid, n in head.nodes.items()
+                if n.alive and nid not in locs
+            ]
+        if not survivors:
+            # the chain colocated on every node we killed: reconstruction
+            # still needs somewhere to run
+            c.add_node({"CPU": 2.0}, num_workers=2)
+        # the get parks until the health loop declares the node dead and
+        # the requeued lineage re-seals the same object ids
+        assert ray_tpu.get(b, timeout=120) == expect_b
+        assert ray_tpu.get(tail, timeout=120) == expect_tail
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_recursive_reconstruction_of_dropped_chain():
+    """Drop the intermediate object AND its producer's input in one shot:
+    rebuilding mid requires first re-executing seed's lineage (the
+    recursive walk), and the reconstruction metrics record the depth-1
+    rebuild."""
+    from ray_tpu.cluster.head import OBJECTS_RECONSTRUCTED
+
+    c = Cluster(use_device_scheduler=False)
+    c.add_node({"CPU": 4.0}, num_workers=2)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        seed = ray_tpu.remote(_chain_seed)
+        step = ray_tpu.remote(_chain_step)
+        a = seed.remote()
+        b = step.remote(a, "b")
+        expect_b = _chain_step(_chain_seed(), "b")
+        assert ray_tpu.get(b, timeout=120) == expect_b
+        depth1_before = OBJECTS_RECONSTRUCTED.value(labels={"depth": "1"})
+        # mid FIRST: its reconstruction must DISCOVER the lost input and
+        # recurse (passing the input first would trivially rebuild it at
+        # depth 0 before the walk ever reaches it)
+        dropped = c.head.chaos_drop_objects([b.hex, a.hex])
+        assert dropped == 2, "chain objects were not both store-resident"
+        assert ray_tpu.get(b, timeout=120) == expect_b
+        # seed was rebuilt as depth-1 lineage of mid's depth-0 rebuild
+        assert (
+            OBJECTS_RECONSTRUCTED.value(labels={"depth": "1"})
+            >= depth1_before + 1
+        )
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_max_retries_zero_object_fails_not_reexecuted(tmp_path):
+    """At-most-once semantics survive reconstruction: a max_retries=0
+    object that loses its only copy FAILS (ObjectLostError) instead of
+    silently re-running its task."""
+    from ray_tpu import ObjectLostError
+
+    c = Cluster(use_device_scheduler=False)
+    c.add_node({"CPU": 2.0}, num_workers=2)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        marker = str(tmp_path / "ran")
+        task = ray_tpu.remote(_touch_and_seed)
+        r = task.options(max_retries=0).remote(marker)
+        assert ray_tpu.get(r, timeout=120) == b"o" * _CHAIN_PAD
+        assert c.head.chaos_drop_objects([r.hex]) == 1
+        with pytest.raises(ObjectLostError, match="at-most-once"):
+            ray_tpu.get(r, timeout=60)
+        with open(marker) as f:
+            assert f.read() == "x", "max_retries=0 task was re-executed"
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_stale_epoch_rpc_rejected_after_head_restart(tmp_path):
+    """Epoch-fenced control plane: a peer that registered with the
+    PREVIOUS head incarnation stamps its RPCs with the old epoch; the
+    rebuilt head rejects them (RpcStaleEpochError, non-retryable — not an
+    RpcError) BEFORE any handler can touch the rebuilt tables."""
+    from ray_tpu.cluster.common import SealInfo
+    from ray_tpu.cluster.rpc import RpcClient, RpcError, RpcStaleEpochError
+
+    c = Cluster(
+        persist_path=str(tmp_path / "head_state.pkl"),
+        use_device_scheduler=False,
+    )
+    c.add_node({"CPU": 2.0}, num_workers=1)
+    try:
+        old_epoch = c.head.cluster_epoch
+        c.restart_head()
+        assert c.head.cluster_epoch > old_epoch, "epoch must bump on restart"
+        head = c.head
+        with head._lock:
+            leases_before = dict(head._task_leases)
+        phantom_oid = "ee" * 14
+        stale_report = {
+            "node_id": "phantom-pre-restart-node",
+            "seals": [
+                SealInfo(
+                    object_id=phantom_oid,
+                    node_id="phantom-pre-restart-node",
+                    size=1,
+                )
+            ],
+            "task_leases": [{"lease_id": "phantom-lease", "ok": True}],
+        }
+        client = RpcClient(c.address)
+        try:
+            with pytest.raises(RpcStaleEpochError) as exc_info:
+                client.call(
+                    "ReportSeals",
+                    stale_report,
+                    timeout=10.0,
+                    retries=5,
+                    epoch=old_epoch,
+                )
+            # non-retryable by construction: a handler-level exception,
+            # NOT a transport RpcError eating the retry budget
+            assert not isinstance(exc_info.value, RpcError)
+            with head._lock:
+                assert phantom_oid not in head._objects, (
+                    "stale seal mutated the rebuilt object directory"
+                )
+                assert head._task_leases == leases_before, (
+                    "stale report mutated the rebuilt lease table"
+                )
+            # the SAME payload stamped with the current epoch passes the
+            # fence (and a fence-exempt Ping always does)
+            assert client.call("Ping", None, timeout=5.0) == "pong"
+            client.call(
+                "ReportSeals",
+                stale_report,
+                timeout=10.0,
+                epoch=head.cluster_epoch,
+            )
+            with head._lock:
+                assert phantom_oid in head._objects
+        finally:
+            client.close()
+    finally:
+        c.shutdown()

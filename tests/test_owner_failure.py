@@ -1,0 +1,170 @@
+"""Owner-crash fate-sharing: a SIGKILLed driver (no DisconnectClient,
+no atexit) is detected through missed owner-session heartbeats and
+fully reaped — non-detached actors killed within the liveness window,
+cached worker leases revoked immediately, and unproduced objects failed
+with a typed ``OwnerDiedError`` so dependents raise instead of hanging.
+
+Reference semantics: objects fate-share with their owner and actors die
+with their owning job (GcsJobManager job-exit + OwnerDiedError,
+python/ray/exceptions.py). The owner here is a REAL separate process
+(`ray_tpu.chaos.owner_proc`) so the kill is a genuine crash.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import OwnerDiedError
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.object_store import ObjectRef
+from ray_tpu.core.runtime import set_runtime
+
+
+def _start_owner(address: str, info_file: str, actors: int) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu.chaos.owner_proc",
+            "--head",
+            address,
+            "--info-file",
+            info_file,
+            "--actors",
+            str(actors),
+            "--hang-task",
+        ]
+    )
+
+
+def _wait_info(proc: subprocess.Popen, info_file: str, timeout: float) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, "owner process died during setup"
+        if os.path.exists(info_file):
+            with open(info_file) as f:
+                return json.load(f)
+        time.sleep(0.2)
+    raise AssertionError("owner process never reported ready")
+
+
+def test_owner_sigkill_reaps_actors_leases_and_fails_objects(
+    tmp_path, monkeypatch
+):
+    # tight liveness: detection ~ ttl x threshold (plus health-loop poll
+    # cadence), so the reap lands in a few seconds instead of ~30
+    monkeypatch.setenv("RAY_TPU_OWNER_LEASE_TTL_S", "1.0")
+    monkeypatch.setenv("RAY_TPU_OWNER_MISS_THRESHOLD", "2")
+    monkeypatch.setenv("RAY_TPU_HEALTH_TIMEOUT_S", "4.0")
+    c = Cluster(use_device_scheduler=False)
+    c.add_node({"CPU": 4.0}, num_workers=3)
+    rt = c.client()
+    set_runtime(rt)
+    proc = None
+    try:
+        info_file = str(tmp_path / "owner.json")
+        proc = _start_owner(c.address, info_file, actors=2)
+        info = _wait_info(proc, info_file, timeout=120.0)
+        cid = info["client_id"]
+        head = c.head
+        with head._lock:
+            assert cid in head._owner_sessions, "owner session not registered"
+        assert info["hang_ref"], "owner never parked its unproduced object"
+
+        # mid-wave SIGKILL: no clean disconnect path runs
+        proc.kill()
+        proc.wait(timeout=10)
+        t_kill = time.monotonic()
+
+        # the full reap must land within (a slack multiple of) one
+        # liveness window: ttl=1 x threshold=2 + poll cadence << 30s
+        live_actors, leases, session = ["?"], ["?"], True
+        deadline = t_kill + 30.0
+        while time.monotonic() < deadline:
+            with head._lock:
+                live_actors = [
+                    a.actor_id
+                    for a in head._actors.values()
+                    if a.owner_client == cid and a.state != "DEAD"
+                ]
+                leases = [
+                    lid
+                    for lid, e in head._task_leases.items()
+                    if e.get("client_id") == cid
+                ]
+                session = cid in head._owner_sessions
+            if not live_actors and not leases and not session:
+                break
+            time.sleep(0.2)
+        assert not live_actors, f"leaked live actors after owner death: {live_actors}"
+        assert not leases, f"leaked worker leases after owner death: {leases}"
+        assert not session, "owner session never declared dead"
+        # every one of the owner's actors is DEAD, not merely detached
+        with head._lock:
+            states = [
+                a.state
+                for a in head._actors.values()
+                if a.owner_client == cid
+            ]
+        assert states and all(s == "DEAD" for s in states)
+
+        # dependents observe the typed error instead of hanging: the
+        # owner's parked max_retries=0 task can never produce its object
+        with pytest.raises(OwnerDiedError):
+            ray_tpu.get(ObjectRef(info["hang_ref"]), timeout=30)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_clean_disconnect_skips_crash_detection(tmp_path, monkeypatch):
+    """A clean shutdown (context-manager exit) sends DisconnectClient:
+    actors are reaped right away through the disconnect path — never via
+    the (slower) missed-heartbeat crash path — and the session is gone
+    the moment shutdown returns."""
+    monkeypatch.setenv("RAY_TPU_OWNER_LEASE_TTL_S", "30.0")  # crash path idle
+    c = Cluster(use_device_scheduler=False)
+    c.add_node({"CPU": 2.0}, num_workers=2)
+    head = c.head
+
+    class Ephemeral:
+        def ping(self):
+            return "pong"
+
+    try:
+        with c.client() as rt:
+            set_runtime(rt)
+            cid = rt.client_id
+            Actor = ray_tpu.remote(Ephemeral)
+            h = Actor.remote()
+            assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+            with head._lock:
+                assert cid in head._owner_sessions
+            set_runtime(None)
+        # __exit__ ran shutdown(): session deregistered synchronously, and
+        # the non-detached actor is reaped without waiting out any TTL
+        with head._lock:
+            assert cid not in head._owner_sessions
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with head._lock:
+                live = [
+                    a.actor_id
+                    for a in head._actors.values()
+                    if a.owner_client == cid and a.state != "DEAD"
+                ]
+            if not live:
+                break
+            time.sleep(0.1)
+        assert not live, f"clean disconnect leaked actors: {live}"
+    finally:
+        set_runtime(None)
+        c.shutdown()

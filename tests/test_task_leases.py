@@ -265,3 +265,50 @@ def test_idle_lease_returns_to_pool(cluster, monkeypatch):
     finally:
         set_runtime(None)
         rt.shutdown()
+
+
+def _big_payload(i, n):
+    return bytes([i % 251]) * n
+
+
+def test_owner_lineage_rebuilds_lost_leased_object(cluster, client):
+    """Leased direct-dispatch tasks never register a spec with the head —
+    the OWNER is their lineage. When every copy of such an object dies
+    and the head seals it ObjectLostError (no head-side lineage), the
+    owner's get transparently resubmits the retained task item through
+    head scheduling and returns the rebuilt value; the resubmitted lease
+    ALSO registers head-side lineage for any future loss."""
+    task = ray_tpu.remote(_big_payload)
+    refs = []
+    # waves keep the queue deep so the shape turns hot and leases carry
+    # the traffic (payload > inline_object_max: store-resident, droppable)
+    for wave in range(6):
+        batch = [
+            task.options(max_retries=3).remote(wave * 8 + k, 150_000)
+            for k in range(8)
+        ]
+        refs.extend(batch)
+        for r in batch:
+            ray_tpu.get(r, timeout=60)
+    head = cluster.head
+    naked = []
+    with head._lock:
+        for i, r in enumerate(refs):
+            e = head._objects.get(r.hex)
+            if e is not None and e.creating_lease is None and e.locations:
+                naked.append((i, r))
+    assert naked, "no lease-dispatched store-resident objects this run"
+    idx, victim = naked[-1]
+    before = client.metrics["lineage_resubmits"]
+    assert head.chaos_drop_objects([victim.hex]) == 1
+    # the owner-held direct copy (when present) would serve the get
+    # locally; the loss path under test is the head-reported one
+    with client._direct_cv:
+        client._direct_results.pop(victim.hex, None)
+    assert ray_tpu.get(victim, timeout=60) == bytes([idx % 251]) * 150_000
+    assert client.metrics["lineage_resubmits"] == before + 1
+    with head._lock:
+        e = head._objects.get(victim.hex)
+        assert e is not None and e.creating_lease is not None, (
+            "resubmission should register head-side lineage"
+        )
