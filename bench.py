@@ -1179,6 +1179,72 @@ def chaos_bench(num_faults: int = 20, seed: int = None) -> dict:
         cluster.shutdown()
 
 
+def sim_sched_bench() -> dict:
+    """Tier 2b: simulated-scale scheduler. A 10k-node synthetic topology
+    with a six-figure pending-demand backlog driven through the REAL head
+    scheduling path (scheduler/sim.py: HeadServer + scheduler thread +
+    kernel rounds, no agents/RPC), once with pipelined rounds and once
+    with the RAY_TPU_SCHED_PIPELINE=0 synchronous fallback on the SAME
+    demand stream. Publishes delivered placements/s for both modes, the
+    round-latency percentiles, the mode speedup, and the placement
+    divergence count (must be 0: both modes place every spec on the same
+    node). This is the reproducible form of the ROADMAP 10k-node x
+    1M-pending scale target — RAY_TPU_BENCH_SIM_DEMANDS=1000000 runs the
+    full-size backlog."""
+    from ray_tpu.scheduler.sim import run_sim_pair
+
+    num_nodes = int(os.environ.get("RAY_TPU_BENCH_SIM_NODES", 10_000))
+    num_demands = int(os.environ.get("RAY_TPU_BENCH_SIM_DEMANDS", 200_000))
+    # The pair's explicit warmup run compiles the exact kernels the
+    # measured runs dispatch; the background prewarm grid would only add
+    # compile contention to the measured window on small hosts.
+    prewarm_before = os.environ.get("RAY_TPU_SCHED_PREWARM")
+    os.environ["RAY_TPU_SCHED_PREWARM"] = "0"
+    t0 = time.perf_counter()
+    try:
+        pair = run_sim_pair(
+            num_nodes,
+            num_demands,
+            timeout_s=max(300.0, num_demands / 1000.0),
+        )
+    finally:
+        if prewarm_before is None:
+            os.environ.pop("RAY_TPU_SCHED_PREWARM", None)
+        else:
+            os.environ["RAY_TPU_SCHED_PREWARM"] = prewarm_before
+    piped, sync = pair["pipelined"], pair["sync"]
+    out = {
+        "sim_nodes": num_nodes,
+        "sim_demands": num_demands,
+        "sim_10k_placements_per_s": piped["placements_per_s"],
+        "sim_10k_sync_placements_per_s": sync["placements_per_s"],
+        "sim_pipeline_speedup": pair["pipeline_speedup"],
+        "sim_placement_divergence": pair["placement_divergence"],
+        "sim_completed": bool(piped["completed"] and sync["completed"]),
+        "sched_round_p50_ms": piped["sched_round_p50_ms"],
+        "sched_round_p99_ms": piped["sched_round_p99_ms"],
+        "sched_sync_round_p50_ms": sync["sched_round_p50_ms"],
+        "sched_sync_round_p99_ms": sync["sched_round_p99_ms"],
+        "sim_bench_s": round(time.perf_counter() - t0, 1),
+    }
+    # env-tunable regression floor, mirroring the other tiers' floors: CI
+    # sets RAY_TPU_BENCH_SCHED_FLOOR_PLACEMENTS_PER_S to fail the run
+    # loudly when delivered pipelined placements/s regresses below it —
+    # or when the two modes' placements diverge at all
+    floor = float(
+        os.environ.get("RAY_TPU_BENCH_SCHED_FLOOR_PLACEMENTS_PER_S", "0")
+        or 0.0
+    )
+    if floor > 0:
+        out["sched_floor_placements_per_s"] = floor
+        out["sched_floor_ok"] = bool(
+            piped["placements_per_s"] >= floor
+            and pair["placement_divergence"] == 0
+            and out["sim_completed"]
+        )
+    return out
+
+
 def main():
     out = {}
     tiers = None
@@ -1198,6 +1264,14 @@ def main():
         except Exception:  # noqa: BLE001
             pass
         kernel = {}
+    if os.environ.get("RAY_TPU_BENCH_SIM", "1") != "0":
+        # simulated-scale scheduler tier runs before the e2e cluster
+        # spawns its process tree: the pipelined-vs-sync comparison wants
+        # a quiet host
+        try:
+            out.update(sim_sched_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            out["sim_sched_error"] = repr(exc)
     try:
         cluster = cluster_bench(
             int(os.environ.get("RAY_TPU_BENCH_E2E_TASKS", 10_000))
@@ -1266,10 +1340,12 @@ def main():
         or out.get("data_floor_ok") is False
         or out.get("tasks_floor_ok") is False
         or out.get("recovery_p95_ok") is False
+        or out.get("sched_floor_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
-        # RAY_TPU_BENCH_TASKS_FLOOR_PER_S / RAY_TPU_BENCH_RECOVERY_P95_S):
+        # RAY_TPU_BENCH_TASKS_FLOOR_PER_S / RAY_TPU_BENCH_RECOVERY_P95_S /
+        # RAY_TPU_BENCH_SCHED_FLOOR_PLACEMENTS_PER_S):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

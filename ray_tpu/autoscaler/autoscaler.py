@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ray_tpu.scheduler.binpack import (
+    DeltaBinPacker,
     bin_pack_residual,
     pick_best_node_type,
     sort_demands,
@@ -91,6 +92,9 @@ class Autoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.tick_interval_s = tick_interval_s
         self._idle_since: Dict[str, float] = {}
+        # device-resident residual packer: node rows stay on the scheduler
+        # device across ticks; only changed rows are pushed per reconcile
+        self._packer = DeltaBinPacker()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -158,6 +162,7 @@ class Autoscaler:
                 [self.vocab.pack(d).astype(np.float32) for d in demands]
             )[:, :width]
             dmat = dmat[sort_demands(dmat)]
+            avail_keys = [n["NodeID"] for n in nodes]
             avail_rows = [
                 self.vocab.pack(n["Available"])[:width] for n in nodes
             ]
@@ -174,10 +179,14 @@ class Autoscaler:
                     self.node_types[type_name].resources
                 )[:width]
                 avail_rows.extend([row] * count)
+                avail_keys.extend(
+                    f"hypothetical:{type_name}:{i}" for i in range(count)
+                )
             if avail_rows:
-                avail = np.stack(avail_rows)
-                res = bin_pack_residual(avail, dmat)
-                unfulfilled = dmat[np.asarray(res.node) < 0]
+                # delta-synced: node rows live on the scheduler device
+                # across ticks, changed rows scatter-push (binpack.py)
+                packed = self._packer.pack(avail_keys, avail_rows, dmat)
+                unfulfilled = dmat[packed < 0]
             else:
                 # zero nodes (cold cluster): everything is unfulfilled —
                 # the packing kernel needs at least one bin

@@ -34,7 +34,11 @@ from ray_tpu.scheduler import (
     hybrid_schedule_reference,
     schedule_bundles,
 )
+from ray_tpu.scheduler.hybrid import hardest_first_order
 from ray_tpu.scheduler.device import (
+    SCHED_KERNEL_MS,
+    SCHED_READBACK_MS,
+    SCHED_UPLOAD_MS,
     DeviceSchedulerState,
     device_scheduler_default,
 )
@@ -122,6 +126,17 @@ OWNERS_REAPED = _MetricCounter(
 )
 
 
+def _shape_key_of(spec) -> tuple:
+    """Memoized resource-shape identity of a spec — the ONE key the
+    dense-row cache, the fair-batch classes, and the device ring all
+    index by (they must agree, so there is exactly one derivation)."""
+    key = getattr(spec, "_shape_key", None)
+    if key is None:
+        key = tuple(sorted(spec.resources.items()))
+        spec._shape_key = key
+    return key
+
+
 def _best_effort(fn, *args, **kwargs):
     try:
         fn(*args, **kwargs)
@@ -201,8 +216,22 @@ class HeadServer:
         from ray_tpu.scheduler.device import LazyDeviceState
 
         self._lazy_device = LazyDeviceState(use_device_scheduler)
+        # pipelined rounds (scheduler/pipeline.py): created lazily on the
+        # scheduler thread at the first device round; None means rounds
+        # are synchronous (RAY_TPU_SCHED_PIPELINE=0 or host golden model)
+        self._pipeline = None
+        # specs mid-flight in a dispatched-but-uncompleted pipelined round:
+        # still pending demand for the autoscaler, already popped from
+        # every scannable queue
+        self._deferred_rounds: Dict[int, List[LeaseRequest]] = {}
         self._parked_at_change = -1
         self._last_park_retry = 0.0
+        # per-shape dense demand rows at the current resource-axis width
+        # (_round_shapes); None value = oversized/infeasible at this width
+        self._dense_cache: Tuple[int, Dict[tuple, Optional[np.ndarray]]] = (
+            -1,
+            {},
+        )
         self._rng = np.random.default_rng(0)
         self._seed = 0
         self._spread_rr = 0  # SPREAD round-robin cursor
@@ -1106,6 +1135,11 @@ class HeadServer:
                 or lease_id in self._in_flight
                 or any(s.task_id == lease_id for s in self._pending)
                 or any(s.task_id == lease_id for s in self._scheduling_batch)
+                or any(
+                    s.task_id == lease_id
+                    for specs in self._deferred_rounds.values()
+                    for s in specs
+                )
             )
         if spec is None or spec.kind != "task":
             self._seal_error_ids(
@@ -1991,7 +2025,13 @@ class HeadServer:
             with self._cond:
                 if spec.task_id in self._in_flight or any(
                     s.task_id == spec.task_id
-                    for q in (self._pending, self._scheduling_batch)
+                    for q in (
+                        self._pending,
+                        self._scheduling_batch,
+                        # dispatched-but-uncompleted pipelined rounds hold
+                        # specs no other queue shows
+                        *self._deferred_rounds.values(),
+                    )
                     for s in q
                 ):
                     return {"queued": True, "dedup": True}
@@ -2303,16 +2343,19 @@ class HeadServer:
                 # can stall for seconds in XLA backend bring-up)
                 self._scheduling_batch = batch
             t_round = time.perf_counter()
+            deferred = False
             try:
                 self._try_schedule_pgs()
                 if batch:
-                    self._schedule_batch(batch)
+                    deferred = bool(self._schedule_batch(batch))
             except Exception:  # pragma: no cover - scheduler must survive
                 logger.exception("scheduler round failed; requeueing")
                 with self._cond:
                     self._pending.extend(batch)
             finally:
-                if batch:
+                # pipelined rounds observe dispatch→grant latency from the
+                # completion thread instead (the loop only dispatched)
+                if batch and not deferred:
                     SCHED_ROUND_MS.observe(
                         (time.perf_counter() - t_round) * 1e3
                     )
@@ -2355,21 +2398,64 @@ class HeadServer:
         Constrained specs (strategy / PG / target-node routed) don't fit
         the shape-capacity math and unpark slack-at-a-time. Caller holds
         ``self._cond``."""
-        from ray_tpu.scheduler.unpark import UNPARK_SLACK, select_unparkable
+        from ray_tpu.scheduler.unpark import (
+            UNPARK_SLACK,
+            select_unparkable_resilient,
+        )
 
         parked = self._infeasible
+        device_state = self._lazy_device._result
         if not parked:
+            self._reconcile_ring(device_state)
             return
         if len(parked) <= UNPARK_SLACK:
             # below the slack there is nothing to cap: skip the view
             # lock + array copies entirely (steady-state common case)
             self._pending.extend(parked)
             self._infeasible = []
+            self._reconcile_ring(device_state)
             return
-        with self._lock:
-            _, a0, al0 = self.view.active_arrays()
-            avail = a0.copy()
-            alive = al0.copy()
+        keep_ring: List[LeaseRequest] = []
+        rest = parked
+        if device_state is not None and device_state.ring_slots > 0:
+            # ring-resident shapes place straight off the device (no
+            # demand re-upload, no trip back through the round path);
+            # the remainder below is constrained / unknown-resource /
+            # ring-overflow work
+            try:
+                rest, keep_ring = self._unpark_via_ring(device_state, parked)
+            except Exception:  # noqa: BLE001 - scheduler must survive
+                # this runs OUTSIDE the loop's _schedule_batch guard: an
+                # XLA error here must not kill the scheduler thread. No
+                # grants were sent (the kernel/readback precedes every
+                # side effect except harmless ring parks), but the ring
+                # round may have deducted on device — purge via full
+                # re-sync and retry everything through the host path.
+                logger.exception("ring unpark failed; host fallback")
+                device_state.invalidate()
+                rest, keep_ring = parked, []
+        if not rest:
+            self._infeasible = keep_ring
+            self._reconcile_ring(device_state)
+            return
+        slots_fn = None
+        if device_state is not None and cfg.sched_unpark_device:
+            try:
+                with self._lock:
+                    device_state.sync(self.view)
+                    _, avail, alive = self.view.active_arrays()
+                # batched slot estimate over the RESIDENT arrays —
+                # avail/alive above are only consulted for the
+                # resource-axis width
+                slots_fn = device_state.shape_slots
+            except Exception:  # noqa: BLE001 - scheduler must survive
+                logger.exception("device unpark sync failed; host scan")
+                device_state.invalidate()
+        if slots_fn is None:
+            with self._lock:
+                _, a0, al0 = self.view.active_arrays()
+                avail = a0.copy()
+                alive = al0.copy()
         # grants in flight (worker leases being placed) consume capacity
         # the availability arrays won't show until the agent's next
         # report: count their demand against the slot estimate
@@ -2380,10 +2466,18 @@ class HeadServer:
             for lid, e in self._task_leases.items()
             if e["state"] == "granting" and self._leases.get(lid) is not None
         ]
-        take, keep = select_unparkable(
-            parked,
+        def _refetch():
+            with self._lock:
+                _, a0, al0 = self.view.active_arrays()
+                return a0.copy(), al0.copy()
+
+        take, keep = select_unparkable_resilient(
+            rest,
             avail,
             alive,
+            device_state=device_state,
+            slots_fn=slots_fn,
+            refetch=_refetch,
             is_constrained=lambda s: (
                 s.strategy is not None or s.target_node or s.pg_reservation
             ),
@@ -2392,7 +2486,97 @@ class HeadServer:
             reserved=reserved or None,
         )
         self._pending.extend(take)
-        self._infeasible = keep
+        self._infeasible = keep + keep_ring
+        self._reconcile_ring(device_state)
+
+    def _reconcile_ring(self, device_state) -> None:
+        """Drop ring slots whose shape has no parked spec left. Specs
+        routinely leave the parked state WITHOUT passing the in-ring
+        drain that calls ring_drop (the small-queue fast path and
+        select_unparkable's take list above) — without this sweep, 64
+        distinct ever-parked shapes would permanently exhaust the ring
+        and silently disable it for the life of the process. Caller
+        holds self._cond."""
+        if device_state is None or not device_state.ring_occupancy():
+            return
+        still = {_shape_key_of(s) for s in self._infeasible}
+        for key in device_state.ring_keys():
+            if key not in still:
+                device_state.ring_drop(key)
+
+    def _unpark_via_ring(
+        self, device_state, parked: List[LeaseRequest]
+    ) -> Tuple[List[LeaseRequest], List[LeaseRequest]]:
+        """Place ring-eligible parked specs straight from the on-device
+        parked-demand ring. Returns (rest, still_parked): specs the ring
+        cannot serve (constrained, unknown resource, ring full), and
+        ring-eligible specs the cluster had no capacity for. Placed specs
+        are granted here (same optimistic-deduction + grant-or-reject
+        contract as a kernel round). Caller holds self._cond."""
+        with self._lock:
+            r = self.view.totals.shape[1]
+        ring_q: Dict[tuple, List[LeaseRequest]] = {}
+        rest: List[LeaseRequest] = []
+        for spec in parked:
+            if (
+                spec.strategy is not None
+                or spec.target_node
+                or spec.pg_reservation
+            ):
+                rest.append(spec)
+                continue
+            req = self._spec_req(spec)
+            if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                rest.append(spec)
+                continue
+            key = _shape_key_of(spec)
+            if (
+                device_state.ring_slot_of(key) is None
+                and not device_state.ring_park(key, req.dense(r))
+            ):
+                rest.append(spec)  # ring full: normal unpark path
+                continue
+            ring_q.setdefault(key, []).append(spec)
+        if not ring_q:
+            return rest, []
+        with self._lock:
+            device_state.sync(self.view)
+        counts = {
+            device_state.ring_slot_of(key): len(q)
+            for key, q in ring_q.items()
+        }
+        placed, per_node = device_state.ring_schedule(
+            counts, spread_threshold=self.hybrid_config.spread_threshold
+        )
+        still_parked: List[LeaseRequest] = []
+        grants: Dict[str, List[LeaseRequest]] = {}
+        n = per_node.shape[1]
+        for key, q in ring_q.items():
+            slot = device_state.ring_slot_of(key)
+            k = min(int(placed[slot]), len(q))
+            if k:
+                # per-node placement counts → node row per FIFO rank; the
+                # host mirror deducts EXACTLY what the kernel deducted
+                # (k × shape), keeping the two copies convergent
+                node_rows = np.repeat(np.arange(n), per_node[slot])[:k]
+                d = self._spec_req(q[0]).dense(r)
+                with self._lock:
+                    self.view.subtract_many(
+                        node_rows, np.broadcast_to(d, (k, r))
+                    )
+                    for spec, row in zip(q[:k], node_rows):
+                        grants.setdefault(
+                            self.view.node_id(int(row)), []
+                        ).append(spec)
+            still_parked.extend(q[k:])
+            if k == len(q):
+                device_state.ring_drop(key)  # queue drained: free the slot
+        if grants:
+            self.metrics["leases_unparked_ring"] = self.metrics.get(
+                "leases_unparked_ring", 0
+            ) + sum(len(v) for v in grants.values())
+            self._send_grants(grants)
+        return rest, still_parked
 
     def _pop_fair_batch(self) -> List[LeaseRequest]:
         """Take up to MAX_BATCH leases. When the queue overflows one round,
@@ -2420,7 +2604,9 @@ class HeadServer:
         by_class: Dict[tuple, deque] = {}
         order: List[tuple] = []
         for spec in scanned:
-            key = tuple(sorted(spec.resources.items()))
+            # same cached key _round_shapes uses: a spec re-scanned every
+            # storm round must not re-sort its resources dict each time
+            key = _shape_key_of(spec)
             q = by_class.get(key)
             if q is None:
                 q = by_class[key] = deque()
@@ -2454,7 +2640,10 @@ class HeadServer:
             spec._req_cache = req
         return req
 
-    def _schedule_batch(self, batch: List[LeaseRequest]) -> None:
+    def _schedule_batch(self, batch: List[LeaseRequest]) -> bool:
+        """Route and place one popped batch. Returns True when the kernel
+        half was dispatched into the pipeline (grants fan out from the
+        completion thread); False when the round completed inline."""
         self.metrics["sched_rounds"] += 1
         kernel_batch: List[LeaseRequest] = []
         spread_batch: List[LeaseRequest] = []
@@ -2467,7 +2656,7 @@ class HeadServer:
         if spread_batch:
             self._schedule_spread(spread_batch)
         if not kernel_batch:
-            return
+            return False
         totals = avail = alive = None
         # crossover: tiny rounds pay more in device dispatch than the
         # kernel saves — below the threshold use the host golden model
@@ -2497,31 +2686,64 @@ class HeadServer:
         if n == 0 or not any_alive:
             with self._cond:
                 self._infeasible.extend(kernel_batch)
-            return
-        reqs = [self._spec_req(s) for s in kernel_batch]
-        # a demand column past the view's resource axis names a resource no
-        # node has ever reported — unplaceable until the cluster changes
-        sched: List[Tuple[LeaseRequest, np.ndarray]] = []
-        with self._cond:
-            for spec, req in zip(kernel_batch, reqs):
-                if any(c >= r and fp > 0 for c, fp in req.demands.items()):
-                    self._infeasible.append(spec)
-                else:
-                    sched.append((spec, req.dense(r)))
-        if not sched:
-            return
-        demands = np.stack([d for _, d in sched])
+            return False
+        specs, shape_rows, sids, infeasible = self._round_shapes(
+            kernel_batch, r
+        )
+        if infeasible:
+            # a demand column past the view's resource axis names a
+            # resource no node has ever reported — unplaceable until the
+            # cluster changes
+            with self._cond:
+                self._infeasible.extend(infeasible)
+        if not specs:
+            return False
+        sched = (specs, shape_rows, sids)
         if device_state is not None:
             # the default path: shape-grouped waterfall kernel over the
-            # device-resident view (device.py module docstring)
-            rows = device_state.schedule(
-                demands, spread_threshold=self.hybrid_config.spread_threshold
-            )
-            granted = rows >= 0
+            # device-resident view (device.py module docstring). Pipelined
+            # (cfg.sched_pipeline): dispatch round N+1 while round N's
+            # placements are still being read back — the avail chain
+            # sequences the rounds on device, and grants fan out from the
+            # pipeline's completion thread.
+            if cfg.sched_pipeline:
+                pending = device_state.schedule_async(
+                    spread_threshold=self.hybrid_config.spread_threshold,
+                    ctx=sched,
+                    shapes=(shape_rows, sids),
+                )
+                with self._cond:
+                    self._deferred_rounds[id(sched)] = specs
+                try:
+                    self._ensure_pipeline().submit(pending)
+                except Exception:
+                    # pipeline stopped (shutdown race) or submit died. The
+                    # kernel already dispatched — its deductions sit on the
+                    # resident avail with no completion to mirror them, so
+                    # purge via full re-sync and respill ONLY this round's
+                    # specs: re-raising would make the loop requeue the
+                    # whole batch, duplicating specs _schedule_spread
+                    # already granted (at-most-once violation) and specs
+                    # already parked infeasible.
+                    logger.exception(
+                        "pipeline submit failed; respilling round"
+                    )
+                    device_state.invalidate()
+                    with self._cond:
+                        self._deferred_rounds.pop(id(sched), None)
+                        self._pending.extend(specs)
+                        self._cond.notify_all()
+                    return False
+                return True
+            rows = device_state.schedule_async(
+                spread_threshold=self.hybrid_config.spread_threshold,
+                shapes=(shape_rows, sids),
+            ).result()
         else:
-            prefer = np.zeros(len(sched), dtype=np.int32)
-            force_spill = np.zeros(len(sched), dtype=bool)
-            rows, granted, _ = hybrid_schedule_reference(
+            demands = shape_rows[sids]
+            prefer = np.zeros(len(specs), dtype=np.int32)
+            force_spill = np.zeros(len(specs), dtype=bool)
+            rows, _granted, _ = hybrid_schedule_reference(
                 totals,
                 avail,
                 alive,
@@ -2531,21 +2753,172 @@ class HeadServer:
                 config=self.hybrid_config,
                 rng=self._rng,
             )
-        # group the round's grants per node: ONE ExecuteLeaseBatch per node
-        # per round instead of one RPC per lease
-        grants: Dict[str, List[LeaseRequest]] = {}
-        for (spec, demand), row, ok in zip(sched, rows, granted):
-            if row < 0 or not ok:
-                with self._cond:
-                    self._infeasible.append(spec)
+            # feasible-but-unavailable picks are not grants: park them
+            rows = np.where(np.asarray(_granted), rows, -1)
+        self._fan_out_grants(sched, np.asarray(rows))
+        return False
+
+    def _round_shapes(self, batch: List[LeaseRequest], r: int):
+        """Round demand prep off the per-shape dense-row cache:
+        ``(specs, shape_rows f32[U,r], sids int32[B], infeasible)`` in the
+        hardest-first shape order the waterfall kernel expects. Replaces
+        the per-spec ``dense()`` + stack + ``np.unique`` pass (O(B·R), the
+        dominant host cost of a round at 10k nodes) with one dict lookup
+        per spec and an O(U log U) sort over the round's unique shapes."""
+        cache_r, cache = self._dense_cache
+        if cache_r != r or len(cache) > 8192:
+            # width change invalidates; the size cap bounds a workload
+            # that never repeats a shape (per-task fractional demands) —
+            # steady shape sets rebuild in one round
+            cache = {}
+            self._dense_cache = (r, cache)
+        slots: Dict[tuple, int] = {}
+        rows_l: List[np.ndarray] = []
+        specs: List[LeaseRequest] = []
+        sid_l: List[int] = []
+        infeasible: List[LeaseRequest] = []
+        for spec in batch:
+            key = _shape_key_of(spec)
+            if key in cache:
+                row = cache[key]
+            else:
+                req = self._spec_req(spec)
+                if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                    row = None  # oversized at width r: infeasible for now
+                else:
+                    row = req.dense(r)
+                cache[key] = row
+            if row is None:
+                infeasible.append(spec)
                 continue
-            with self._lock:
-                node_id = self.view.node_id(int(row))
-                # optimistic deduction so later rounds see the placement; the
-                # agent's authoritative report will overwrite the row.
-                self.view.subtract(int(row), demand)
-            grants.setdefault(node_id, []).append(spec)
+            slot = slots.get(key)
+            if slot is None:
+                slot = len(rows_l)
+                slots[key] = slot
+                rows_l.append(row)
+            specs.append(spec)
+            sid_l.append(slot)
+        if not specs:
+            return specs, None, None, infeasible
+        shape_rows = np.stack(rows_l).astype(np.float32, copy=False)
+        sids = np.asarray(sid_l, dtype=np.int32)
+        order = hardest_first_order(shape_rows)
+        remap = np.empty(shape_rows.shape[0], dtype=np.int32)
+        remap[order] = np.arange(shape_rows.shape[0], dtype=np.int32)
+        return specs, shape_rows[order], remap[sids], infeasible
+
+    def _ensure_pipeline(self):
+        """The completion-side of pipelined rounds; created on first use
+        (scheduler thread only — no construction race)."""
+        if self._pipeline is None:
+            from ray_tpu.scheduler.pipeline import SchedulerPipeline
+
+            self._pipeline = SchedulerPipeline(
+                on_complete=self._finish_round,
+                on_error=self._round_failed,
+            )
+        return self._pipeline
+
+    def _finish_round(self, sched, rows: np.ndarray, round_ms: float) -> None:
+        """Completion-thread half of a pipelined round: the dispatch side
+        has long moved on to later rounds; this fans the read-back
+        placements out into grants."""
+        SCHED_ROUND_MS.observe(round_ms)
+        try:
+            self._fan_out_grants(sched, rows)
+        except Exception:  # noqa: BLE001 - must not reach _round_failed
+            # a PARTIAL fan-out is not safely unwindable (unplaced specs
+            # already parked, host deductions applied, some grants sent):
+            # letting this reach the pipeline's on_error would respill
+            # the whole round and double-schedule the handled specs.
+            # _round_failed's respill-everything recovery is only correct
+            # for result() failures, where nothing has happened yet.
+            logger.exception("grant fan-out failed mid-round")
+        finally:
+            with self._cond:
+                self._deferred_rounds.pop(id(sched), None)
+                self._cond.notify_all()
+
+    def _round_failed(self, sched, exc: Exception) -> None:
+        """A pipelined round died (kernel/readback error): respill its
+        specs to the pending queue — same recovery as a synchronous round
+        raising in the scheduler loop. The dead round's deductions were
+        committed to the resident avail at dispatch but will never reach
+        the host mirror, so force a full device re-sync to purge the
+        phantom capacity loss."""
+        device_state = self._lazy_device._result
+        if device_state is not None:
+            device_state.invalidate()
+        with self._cond:
+            self._deferred_rounds.pop(id(sched), None)
+            self._pending.extend(sched[0])
+            self._cond.notify_all()
+
+    def _fan_out_grants(self, sched, rows: np.ndarray) -> None:
+        """Turn one round's placement rows into per-node grant batches.
+        ``sched`` is a ``(specs, shape_rows, sids)`` round context
+        (_round_shapes). Unplaced specs park (and pin their shape in the
+        device ring); placements deduct from the host mirror in ONE
+        vectorized scatter-subtract and group per node off one argsort —
+        the per-spec lock/subtract/setdefault loop dominated the host
+        cost of a full round at 10k nodes."""
+        specs, shape_rows, sids = sched
+        placed_mask = rows >= 0
+        unplaced = [specs[i] for i in np.flatnonzero(~placed_mask)]
+        if unplaced:
+            with self._cond:
+                if self._cancelled_leases:
+                    # cancelled / owner-reaped while the round was in
+                    # flight: the dispatch-time filter in _send_grants
+                    # only covers the granted half — drop, don't park
+                    kept = []
+                    for s in unplaced:
+                        if s.task_id in self._cancelled_leases:
+                            self._cancelled_leases.discard(s.task_id)
+                        else:
+                            kept.append(s)
+                    unplaced = kept
+                self._infeasible.extend(unplaced)
+            if unplaced:
+                self._ring_park_specs(unplaced)
+        idx = np.flatnonzero(placed_mask)
+        if idx.size == 0:
+            return
+        demands_mat = shape_rows[sids[idx]]
+        row_arr = rows[idx].astype(np.int64)
+        order = np.argsort(row_arr, kind="stable")
+        srt = row_arr[order]
+        starts = np.flatnonzero(
+            np.concatenate([[True], srt[1:] != srt[:-1]])
+        )
+        grants: Dict[str, List[LeaseRequest]] = {}
+        with self._lock:
+            # optimistic deduction so later rounds see the placement; the
+            # agent's authoritative report will overwrite the rows.
+            self.view.subtract_many(row_arr, demands_mat)
+            for k, start in enumerate(starts):
+                end = starts[k + 1] if k + 1 < len(starts) else srt.size
+                grants[self.view.node_id(int(srt[start]))] = [
+                    specs[idx[order[j]]] for j in range(start, end)
+                ]
         self._send_grants(grants)
+
+    def _ring_park_specs(self, specs: List[LeaseRequest]) -> None:
+        """Pin freshly-parked kernel shapes in the on-device parked-demand
+        ring so their retries run count-driven off resident rows
+        (device.py ring_schedule) instead of re-uploading demand."""
+        device_state = self._lazy_device._result
+        if device_state is None or device_state.ring_slots <= 0:
+            return
+        with self._lock:
+            r = self.view.totals.shape[1]
+        for spec in specs:
+            if spec.strategy is not None or spec.target_node or spec.pg_reservation:
+                continue
+            req = self._spec_req(spec)
+            if any(c >= r and fp > 0 for c, fp in req.demands.items()):
+                continue
+            device_state.ring_park(_shape_key_of(spec), req.dense(r))
 
     def _dispatch_batch_blocking(
         self, specs: List[LeaseRequest], node_id: str, client: RpcClient
@@ -2665,10 +3038,22 @@ class HeadServer:
                     self._pending.extend(specs)
                     self._cond.notify_all()
                 continue
-            self._prestart_hint(client, specs)
-            self._dispatch_pool.submit(
-                self._dispatch_batch_blocking, specs, node_id, client
-            )
+            try:
+                self._prestart_hint(client, specs)
+                self._dispatch_pool.submit(
+                    self._dispatch_batch_blocking, specs, node_id, client
+                )
+            except RuntimeError:
+                # dispatch pool shut down mid-round: respill like a dead
+                # client. Raising here would make the caller's recovery
+                # respill the WHOLE round — duplicating specs already
+                # submitted to other nodes (at-most-once violation for
+                # max_retries=0 leases).
+                with self._cond:
+                    for s in specs:
+                        self._in_flight.pop(s.task_id, None)
+                    self._pending.extend(specs)
+                    self._cond.notify_all()
 
     def _prestart_hint(
         self, client: RpcClient, specs: List[LeaseRequest]
@@ -3004,10 +3389,16 @@ class HeadServer:
                         q.remove(s)
                         dropped = True
             # mid-schedule: the round popped it out of every queue above
-            # (this window spans the first round's XLA bring-up) — flag it
-            # for the dispatch-time filter
+            # (this window spans the first round's XLA bring-up and any
+            # dispatched-but-uncompleted pipelined round) — flag it for
+            # the dispatch-time filter
             if not dropped and any(
-                s.task_id == lid for s in self._scheduling_batch
+                s.task_id == lid
+                for q in (
+                    self._scheduling_batch,
+                    *self._deferred_rounds.values(),
+                )
+                for s in q
             ):
                 self._cancelled_leases.add(lid)
                 dropped = True
@@ -3069,6 +3460,14 @@ class HeadServer:
                 for s in self._scheduling_batch
                 if s.resources and id(s) not in seen
             ]
+            seen |= {id(s) for s in self._scheduling_batch}
+            # specs in dispatched-but-unread pipelined rounds are demand too
+            for specs in self._deferred_rounds.values():
+                out += [
+                    dict(s.resources)
+                    for s in specs
+                    if s.resources and id(s) not in seen
+                ]
             for pg in self._pending_pgs:
                 if not pg.ready.is_set() and not pg.removed:
                     out.extend(dict(b) for b in pg.bundles)
@@ -3267,11 +3666,18 @@ class HeadServer:
                 doomed.extend(s for s in q if _owned(s))
                 q.clear()
                 q.extend(kept)
-            for s in self._scheduling_batch:
-                # mid-schedule: flag for the dispatch-time filter
-                if _owned(s):
-                    self._cancelled_leases.add(s.task_id)
-                    doomed.append(s)
+            for q in (
+                self._scheduling_batch,
+                # dispatched-but-uncompleted pipelined rounds: the
+                # dispatch-time filter (and _fan_out_grants' unplaced
+                # drop) honors the flag when the round completes
+                *self._deferred_rounds.values(),
+            ):
+                for s in q:
+                    # mid-schedule: flag for the dispatch-time filter
+                    if _owned(s):
+                        self._cancelled_leases.add(s.task_id)
+                        doomed.append(s)
             for lid, (spec, nid) in list(self._in_flight.items()):
                 if _owned(spec):
                     del self._in_flight[lid]
@@ -3380,15 +3786,30 @@ class HeadServer:
                     self._cond.notify_all()
 
     def _schedule_pg(self, state: _PGState) -> bool:
+        # device residency for the bundle packer too: when the scheduler
+        # device is up, the PACK/SPREAD kernels read the RESIDENT arrays
+        # (delta-synced dirty rows) instead of re-uploading a fresh host
+        # copy of the cluster matrices per PG attempt. The capacity rows
+        # beyond num_nodes are alive=False and score out of every kernel.
+        # The refs are immutable jax values (nothing is donated), so
+        # later rounds replacing device_state._avail can't invalidate a
+        # pack in flight.
+        device_state = self._lazy_device._result
         with self._lock:
-            t0, a0, al0 = self.view.active_arrays()
-            totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
             num_nodes = self.view.num_nodes
-        if num_nodes == 0 or not alive.any():
+            any_alive = bool(self.view.alive.any())
+            width = self.view.totals.shape[1]
+            if device_state is not None and num_nodes > 0:
+                device_state.sync(self.view)
+                totals, avail, alive = device_state.resident_arrays()
+            else:
+                t0, a0, al0 = self.view.active_arrays()
+                totals, avail, alive = t0.copy(), a0.copy(), al0.copy()
+        if num_nodes == 0 or not any_alive:
             return False
         bundles = np.stack(
             [
-                ResourceRequest.from_map(self.vocab, b).dense(totals.shape[1])
+                ResourceRequest.from_map(self.vocab, b).dense(width)
                 for b in state.bundles
             ]
         )
@@ -3572,6 +3993,32 @@ class HeadServer:
                     "infeasible": len(self._infeasible),
                     "in_flight": len(self._in_flight),
                 }
+            if kind == "sched":
+                # the scheduling plane: round-latency decomposition,
+                # pipeline occupancy, delta-sync and parked-ring state
+                ds = self._lazy_device._result
+                return {
+                    "pipeline_enabled": bool(cfg.sched_pipeline),
+                    "pipeline": (
+                        self._pipeline.stats()
+                        if self._pipeline is not None
+                        else None
+                    ),
+                    "rounds_deferred": len(self._deferred_rounds),
+                    "round_ms": SCHED_ROUND_MS.summary(),
+                    "upload_ms": SCHED_UPLOAD_MS.summary(),
+                    "kernel_ms": SCHED_KERNEL_MS.summary(),
+                    "readback_ms": SCHED_READBACK_MS.summary(),
+                    "device": dict(ds.stats) if ds is not None else None,
+                    "ring_occupancy": (
+                        ds.ring_occupancy() if ds is not None else 0
+                    ),
+                    "ring_slots": ds.ring_slots if ds is not None else 0,
+                    "unparked_via_ring": self.metrics.get(
+                        "leases_unparked_ring", 0
+                    ),
+                    "sched_rounds": self.metrics["sched_rounds"],
+                }
             if kind == "dispatch":
                 # the task-lease dispatch plane (lease-cached direct
                 # dispatch): active leases + per-owner counts + lifecycle
@@ -3610,6 +4057,11 @@ class HeadServer:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+        if self._pipeline is not None:
+            # drain in-flight rounds (their grants are already paid for on
+            # the device mirror) before tearing the completion thread down
+            self._pipeline.flush(timeout=5.0)
+            self._pipeline.stop()
         if self._persist_path:
             # UNCONDITIONAL final snapshot: hot-path dirtying is rate-gated
             # (_mark_hot_dirty), so the dirty bit alone can't prove the

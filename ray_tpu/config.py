@@ -139,6 +139,47 @@ define(
     "kernel gains for tiny rounds; 0 = always use the device kernels).",
 )
 define(
+    "sched_pipeline",
+    True,
+    "Pipelined scheduling rounds: round N+1's kernel dispatches while "
+    "round N's placements are still being read back (async host copy, "
+    "double-buffered through the donated avail chain); grants fan out "
+    "from a completion thread. Off: every round blocks on its own "
+    "readback inside the scheduler loop (the pre-pipeline behavior).",
+)
+define(
+    "sched_pipeline_depth",
+    3,
+    "Max scheduling rounds in flight (dispatched, readback pending) "
+    "before submit blocks. Bounds host-mirror lag and grant latency; "
+    "1 degenerates to the synchronous round with the completion thread "
+    "still off the scheduler loop.",
+)
+define(
+    "sched_prewarm",
+    True,
+    "Background-compile the scheduling kernel for the bucketed "
+    "(batch, unique-shape) grid at first device sync (and again after "
+    "node-capacity growth), so first-touch rounds stop paying "
+    "multi-second jit compile spikes visible as sched_round_ms outliers.",
+)
+define(
+    "sched_ring_slots",
+    64,
+    "Slots in the on-device parked-demand ring: resource shapes that "
+    "failed placement stay resident on the scheduler device (one row "
+    "per shape) and retry via a count-driven kernel without re-uploading "
+    "demand matrices. 0 disables the ring (parked specs retry through "
+    "the normal round path).",
+)
+define(
+    "sched_unpark_device",
+    True,
+    "Estimate per-shape grantable slots for capacity-capped unparking "
+    "on the scheduler device (one batched kernel over the resident "
+    "availability arrays) instead of per-shape host NumPy scans.",
+)
+define(
     "spill_storage_uri",
     "",
     "External spill storage for the object plane (external_storage.py "

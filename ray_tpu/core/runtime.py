@@ -550,7 +550,10 @@ class Runtime:
         """Move parked specs back to pending, capped per resource shape
         at what the view could grant (scheduler/unpark.py, shared with
         the cluster head). Caller holds self._cond."""
-        from ray_tpu.scheduler.unpark import UNPARK_SLACK, select_unparkable
+        from ray_tpu.scheduler.unpark import (
+            UNPARK_SLACK,
+            select_unparkable_resilient,
+        )
 
         parked = self._infeasible
         if not parked:
@@ -559,11 +562,34 @@ class Runtime:
             self._pending.extend(parked)
             self._infeasible = []
             return
+        # slot estimation on the resident device arrays when the XLA
+        # scheduler is already up (one batched kernel instead of a host
+        # scan per shape) — mirrors the cluster head's unpark path
+        from ray_tpu.config import cfg as _cfg
+
+        device_state = self._lazy_device._result
+        slots_fn = None
         _, a0, al0 = self.view.active_arrays()
-        take, keep = select_unparkable(
+        if device_state is not None and _cfg.sched_unpark_device:
+            try:
+                device_state.sync(self.view)
+                slots_fn = device_state.shape_slots
+            except Exception:  # noqa: BLE001 - scheduler must survive
+                logger.exception("device unpark sync failed; host scan")
+                device_state.invalidate()
+        if slots_fn is None:
+            a0, al0 = a0.copy(), al0.copy()
+        def _refetch():
+            _, f0, fl0 = self.view.active_arrays()
+            return f0.copy(), fl0.copy()
+
+        take, keep = select_unparkable_resilient(
             parked,
-            a0.copy(),
-            al0.copy(),
+            a0,
+            al0,
+            device_state=device_state,
+            slots_fn=slots_fn,
+            refetch=_refetch,
             # "DEFAULT" routes through the hybrid kernels like None —
             # only real placement constraints skip the capacity math
             is_constrained=lambda s: s.strategy is not None

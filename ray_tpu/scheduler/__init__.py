@@ -29,8 +29,10 @@ from .hybrid import (  # noqa: F401
 )
 from .bundles import schedule_bundles, sort_bundles  # noqa: F401
 from .binpack import (  # noqa: F401
+    DeltaBinPacker,
     bin_pack_residual,
     pick_best_node_type,
     sort_demands,
     utilization_scores,
 )
+from .pipeline import SchedulerPipeline  # noqa: F401

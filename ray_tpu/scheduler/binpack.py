@@ -116,6 +116,74 @@ def utilization_scores(
     return TypeScore(f, g, m, mn, me)
 
 
+class DeltaBinPacker:
+    """Device-resident node rows for the autoscaler's residual packing.
+
+    The autoscaler re-packed its availability matrix from python dicts and
+    re-uploaded it every tick. This keeps the node rows resident on the
+    scheduler device under the same host-mirror/dirty-row protocol as
+    DeviceSchedulerState (scheduler/device.py): per tick, rows whose host
+    value changed are scatter-pushed; membership or geometry changes
+    trigger a full re-upload. Node and demand axes are bucket-padded
+    (zero rows — a real demand never fits one, and first-fit prefers the
+    earlier real rows for zero demands) so steady ticks hit the jit cache.
+    """
+
+    def __init__(self):
+        self._ids: Tuple = ()
+        self._mirror = None   # f32[C,R] host
+        self._dev = None      # f32[C,R] device
+        self._push = None
+
+    @staticmethod
+    def _bucket(n: int, floor: int = 8) -> int:
+        from .device import _bucket
+
+        return _bucket(n, floor)
+
+    def pack(self, node_ids, rows, demands: np.ndarray) -> np.ndarray:
+        """First-fit ``demands`` onto the keyed node ``rows``; returns
+        int32[B] row index per demand (-1 = unfulfilled). ``node_ids``
+        key the delta detection — reordered/renamed ids full-sync."""
+        import jax
+
+        rows = np.asarray(rows, dtype=np.float32)
+        n, r = rows.shape
+        ids = tuple(node_ids)
+        n_pad = self._bucket(n)
+        if self._push is None:
+            self._push = jax.jit(
+                lambda a, rws, vals: a.at[rws].set(vals), donate_argnums=(0,)
+            )
+        if (
+            self._mirror is None
+            or ids != self._ids
+            or self._mirror.shape != (n_pad, r)
+        ):
+            self._mirror = np.zeros((n_pad, r), dtype=np.float32)
+            self._mirror[:n] = rows
+            self._dev = jax.device_put(self._mirror)
+            self._ids = ids
+        else:
+            dirty = np.flatnonzero(np.any(self._mirror[:n] != rows, axis=1))
+            if dirty.size:
+                from .device import pad_scatter
+
+                self._mirror[dirty] = rows[dirty]
+                drows, dvals = pad_scatter(
+                    dirty.astype(np.int32), self._mirror[dirty]
+                )
+                self._dev = self._push(self._dev, drows, dvals)
+        b = demands.shape[0]
+        b_pad = self._bucket(b, 1)
+        dmat = np.zeros((b_pad, r), dtype=np.float32)
+        dmat[:b] = demands
+        res = bin_pack_residual(self._dev, dmat)
+        nodes = np.asarray(res.node)[:b].copy()
+        nodes[nodes >= n] = -1  # a pad row can never really host a demand
+        return nodes
+
+
 def pick_best_node_type(scores: TypeScore) -> int:
     """Lexicographic argmax over (gpu_ok, num_matching, min_util, mean_util);
     -1 if no type is feasible. Host-side: T is small."""

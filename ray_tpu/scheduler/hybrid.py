@@ -70,6 +70,32 @@ def _critical_score(totals: jax.Array, avail: jax.Array, threshold: float) -> ja
     return jnp.where(score < threshold, 0.0, score)
 
 
+def _shape_capacity(
+    totals: jax.Array,     # f32[N,R]
+    avail_run: jax.Array,  # f32[N,R]
+    alive: jax.Array,      # bool[N]
+    d: jax.Array,          # f32[R] one demand shape
+) -> tuple:
+    """(cap f32[N], has_demand bool[]): how many requests of shape ``d``
+    each node can absorb right now (inf for a zero-demand shape on a
+    feasible node; 0 on dead/infeasible nodes). The ONE definition of
+    per-node shape capacity — the round kernel, the parked-ring kernel,
+    and the unpark slot estimator must deduct/estimate with identical
+    math or the host mirror's convergence accounting drifts."""
+    feas = alive & jnp.all(totals >= d[None, :] - _EPS, axis=1)
+    demanded = d > 0
+    ratio = jnp.where(
+        demanded[None, :],
+        jnp.floor((avail_run + _EPS) / jnp.where(demanded, d, 1.0)[None, :]),
+        jnp.inf,
+    )
+    cap = jnp.min(ratio, axis=1)  # [N] how many fit
+    has_demand = jnp.any(demanded)
+    cap = jnp.where(has_demand, cap, jnp.inf)  # zero-demand: no cap
+    cap = jnp.where(feas, jnp.maximum(cap, 0.0), 0.0)
+    return cap, has_demand
+
+
 def _fits(view: jax.Array, demand: jax.Array) -> jax.Array:
     """bool[N]: every resource of ``demand`` fits in ``view`` rows."""
     return jnp.all(view >= demand[None, :] - _EPS - 1e-6 * demand[None, :], axis=1)
@@ -337,17 +363,7 @@ def hybrid_schedule_shapes_impl(
 
     def per_shape(avail_run, uidx):
         d = shape_demands[uidx]
-        feas = alive & jnp.all(totals >= d[None, :] - _EPS, axis=1)
-        demanded = d > 0
-        ratio = jnp.where(
-            demanded[None, :],
-            jnp.floor((avail_run + _EPS) / jnp.where(demanded, d, 1.0)[None, :]),
-            jnp.inf,
-        )
-        cap = jnp.min(ratio, axis=1)  # [N] how many fit
-        has_demand = jnp.any(demanded)
-        cap = jnp.where(has_demand, cap, jnp.inf)  # zero-demand shape: no cap
-        cap = jnp.where(feas, jnp.maximum(cap, 0.0), 0.0)
+        cap, has_demand = _shape_capacity(totals, avail_run, alive, d)
         score = _critical_score(totals, avail_run, spread_threshold)
         key = jax.random.fold_in(base_key, uidx)
         # quantized score + random jitter == uniform pick among near-tied
@@ -355,13 +371,19 @@ def hybrid_schedule_shapes_impl(
         jitter = jax.random.uniform(key, (n,), dtype=jnp.float32)
         cost = jnp.floor(score * 16.0) + jitter
         cost = jnp.where(cap > 0, cost, jnp.inf)
-        node_order = jnp.argsort(cost)
+        # top-k beats a full argsort ~3x on CPU XLA and is exact here: a
+        # request at rank r within its shape needs at most r+1 nodes of
+        # the cost order, ranks are < b <= k, and every cap>0 node sorts
+        # ahead of the cost=inf (cap=0) ones — so the k cheapest nodes
+        # cover every placement the full order could make.
+        k = min(n, b)
+        _, node_order = jax.lax.top_k(-cost, k)
         cap_sorted = cap[node_order]
         cumcap = jnp.cumsum(jnp.where(jnp.isfinite(cap_sorted), cap_sorted, 2.0 * b))
         sel = sorted_ids == uidx
         pos = jnp.searchsorted(cumcap, rank_sorted.astype(cumcap.dtype), side="right")
-        valid = sel & (rank_sorted < cumcap[-1]) & (pos < n)
-        safe_pos = jnp.minimum(pos, n - 1)
+        valid = sel & (rank_sorted < cumcap[-1]) & (pos < k)
+        safe_pos = jnp.minimum(pos, k - 1)
         node_u = jnp.where(valid, node_order[safe_pos], -1)
         counts = jax.ops.segment_sum(
             jnp.where(valid, 1.0, 0.0),
@@ -390,14 +412,108 @@ hybrid_schedule_shapes = functools.partial(
 )(hybrid_schedule_shapes_impl)
 
 
+class RingResult(NamedTuple):
+    placed: jax.Array    # int32[S] requests placed per ring slot
+    per_node: jax.Array  # int32[S,N] placements per node per slot
+    avail_out: jax.Array  # f32[N,R]
+
+
+def ring_schedule_impl(
+    totals: jax.Array,       # f32[N,R]
+    avail: jax.Array,        # f32[N,R]
+    alive: jax.Array,        # bool[N]
+    ring_shapes: jax.Array,  # f32[S,R] parked demand shapes (device-resident)
+    counts: jax.Array,       # int32[S] pending requests per shape
+    seed: jax.Array,
+    *,
+    spread_threshold: float = 0.5,
+) -> RingResult:
+    """Count-driven waterfall over the parked-demand ring.
+
+    Same placement math as ``hybrid_schedule_shapes_impl`` (per-shape node
+    capacity, score+jitter node ordering, cumulative-capacity fill), but
+    demand arrives as (resident shape row, count) pairs instead of
+    per-request rows — repeatedly-unplaceable shapes retry without
+    re-uploading a demand matrix or shape-id vector, and the readback is
+    per-node placement COUNTS (the caller assigns its FIFO-parked specs to
+    nodes rank-by-rank), not per-request rows.
+    """
+    n = totals.shape[0]
+    s = ring_shapes.shape[0]
+    base_key = jax.random.PRNGKey(seed)
+
+    def per_shape(avail_run, uidx):
+        d = ring_shapes[uidx]
+        want = counts[uidx].astype(jnp.float32)
+        cap, has_demand = _shape_capacity(totals, avail_run, alive, d)
+        score = _critical_score(totals, avail_run, spread_threshold)
+        key = jax.random.fold_in(base_key, uidx)
+        jitter = jax.random.uniform(key, (n,), dtype=jnp.float32)
+        cost = jnp.floor(score * 16.0) + jitter
+        cost = jnp.where(cap > 0, cost, jnp.inf)
+        node_order = jnp.argsort(cost)
+        cap_sorted = cap[node_order]
+        # zero-demand shapes have infinite per-node capacity: the first
+        # (cheapest) node absorbs the whole count
+        cap_fin = jnp.where(jnp.isfinite(cap_sorted), cap_sorted, want)
+        cum_prev = jnp.concatenate(
+            [jnp.zeros((1,), cap_fin.dtype), jnp.cumsum(cap_fin)[:-1]]
+        )
+        take_sorted = jnp.clip(want - cum_prev, 0.0, cap_fin)
+        per_node = jnp.zeros((n,), jnp.float32).at[node_order].set(take_sorted)
+        avail_run = jnp.where(
+            has_demand, avail_run - per_node[:, None] * d[None, :], avail_run
+        )
+        placed = jnp.sum(take_sorted)
+        return avail_run, (placed.astype(jnp.int32), per_node.astype(jnp.int32))
+
+    avail_out, (placed, per_node) = jax.lax.scan(
+        per_shape, avail, jnp.arange(s, dtype=jnp.int32)
+    )
+    return RingResult(placed, per_node, avail_out)
+
+
+def shape_slots_impl(
+    totals: jax.Array,   # f32[N,R]
+    avail: jax.Array,    # f32[N,R]
+    alive: jax.Array,    # bool[N]
+    shapes: jax.Array,   # f32[S,R]
+) -> jax.Array:
+    """int32[S]: grantable-slot estimate per demand shape — how many
+    requests of each shape the current availability could absorb. The
+    device form of the unpark estimator's per-shape host scan
+    (scheduler/unpark.py): one batched dispatch over the RESIDENT arrays
+    instead of S NumPy passes over a fresh host copy. ``lax.map`` keeps
+    the intermediate at [N,R] per shape (no [S,N,R] blow-up at 10k nodes)."""
+
+    def one(d):
+        slots, _ = _shape_capacity(totals, avail, alive, d)
+        # zero-demand shapes report "huge", clamped to int32-safe
+        return jnp.minimum(jnp.sum(slots), 2.0**31 - 1).astype(jnp.int32)
+
+    return jax.lax.map(one, shapes)
+
+
+def hardest_first_order(shape_rows: np.ndarray) -> np.ndarray:
+    """Stable shape-priority order (SortRequiredResources semantics): more
+    distinct resources first, then heavier. The ONE definition of the
+    waterfall kernel's placement order — shared by ``dedupe_shapes`` and
+    the head's cached-shape round prep (head._round_shapes), which must
+    order identical demand sets identically."""
+    return np.lexsort(
+        (
+            np.arange(shape_rows.shape[0]),
+            -shape_rows.sum(axis=1),
+            -(shape_rows > 0).sum(axis=1),
+        )
+    )
+
+
 def dedupe_shapes(demands: np.ndarray):
     """Host helper: unique demand shapes (priority-sorted hardest-first, like
     SortRequiredResources) + per-request shape ids."""
     uniq, inverse = np.unique(demands, axis=0, return_inverse=True)
-    # hardest first: more distinct resources, then heavier
-    order = np.lexsort(
-        (np.arange(len(uniq)), -uniq.sum(axis=1), -(uniq > 0).sum(axis=1))
-    )
+    order = hardest_first_order(uniq)
     remap = np.empty(len(uniq), dtype=np.int32)
     remap[order] = np.arange(len(uniq), dtype=np.int32)
     return uniq[order].astype(np.float32), remap[inverse].astype(np.int32)

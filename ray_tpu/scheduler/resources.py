@@ -384,6 +384,21 @@ class ClusterView:
         self.dirty_rows.add(row)
         self.change_counter += 1
 
+    def subtract_many(self, rows: np.ndarray, demands: np.ndarray) -> None:
+        """Vectorized grant deduction: one duplicate-safe scatter-add for a
+        whole round's placements instead of a per-spec Python call (the
+        per-grant loop was the dominant host cost of a 4k-lease round at
+        10k nodes). ``rows`` int[B], ``demands`` f32[B,<=R]."""
+        if rows.size == 0:
+            return
+        np.subtract.at(
+            self.avail[:, : demands.shape[1]],
+            rows,
+            demands,
+        )
+        self.dirty_rows.update(int(r) for r in np.unique(rows))
+        self.change_counter += 1
+
     def add(self, row: int, demand: np.ndarray) -> None:
         self.avail[row, : len(demand)] += demand
         self.dirty_rows.add(row)

@@ -1,0 +1,215 @@
+"""Simulated-scale scheduler harness: 10k nodes, up to 1M pending demands.
+
+Drives the REAL head scheduling path — ``HeadServer`` with its scheduler
+thread, fair batch popping, kernel rounds (pipelined or synchronous),
+capacity-capped unparking, and the device-resident mirror — against a
+synthetic topology with no agents and no RPC: nodes are injected straight
+into the cluster view, and ``_send_grants`` is replaced by a local sink
+that tallies delivered placements (the network boundary is exactly where
+a simulated cluster stops being real, so that is the seam).
+
+This is how the 10k-node × 1M-pending-task scale target (ROADMAP items
+1/3) is measured reproducibly on any host: delivered placements/s
+end-to-end through ``head._schedule_batch``, plus the round-latency
+percentiles over the run's window. ``bench.py``'s ``sim_sched`` tier runs
+it in both pipeline modes and publishes the ratio; tests run it small and
+assert zero placement divergence between the modes on identical streams.
+
+Health checking is inert by construction: a node that never appears in
+``head._last_report`` reads as gap 0 (the agent-report liveness contract
+starts at first report), so the synthetic nodes stay alive without a
+reporter thread.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.util.metrics import percentile_from_buckets
+
+
+def build_demand_maps(
+    num_demands: int, seed: int = 0
+) -> List[Dict[str, float]]:
+    """The bench workload's CPU/memory mixture (bench.py build_demands),
+    minus the TPU slice — the sim asserts full delivery, so every shape
+    must be cluster-placeable."""
+    rng = np.random.default_rng(seed)
+    kind = rng.choice(3, num_demands, p=[0.70, 0.15, 0.15])
+    shapes = (
+        {"CPU": 0.25},
+        {"CPU": 0.5, "memory": 1.0},
+        {"CPU": 1.0},
+    )
+    return [dict(shapes[k]) for k in kind]
+
+
+def run_sim(
+    num_nodes: int = 10_000,
+    num_demands: int = 1_000_000,
+    *,
+    pipeline: bool = True,
+    seed: int = 0,
+    cpu_per_node: float = 64.0,
+    memory_per_node: float = 256.0,
+    collect_assignments: bool = False,
+    timeout_s: float = 900.0,
+) -> dict:
+    """One sim run; returns delivered placements/s + round percentiles.
+
+    ``pipeline`` toggles RAY_TPU_SCHED_PIPELINE for the run (restored
+    after), selecting pipelined vs synchronous rounds through the exact
+    production code path. All demands are enqueued under the head lock
+    BEFORE the scheduler thread can pop, so two runs with the same seed
+    see identical batch streams — the basis of the divergence check.
+    """
+    from ray_tpu.cluster.common import LeaseRequest, NodeInfo
+    from ray_tpu.cluster.head import SCHED_ROUND_MS, HeadServer
+
+    env_before = os.environ.get("RAY_TPU_SCHED_PIPELINE")
+    os.environ["RAY_TPU_SCHED_PIPELINE"] = "1" if pipeline else "0"
+    head = None
+    try:
+        head = HeadServer(dashboard_port=None)
+        delivered = 0
+        assignments: Dict[str, str] = {}
+        done = threading.Event()
+        sink_lock = threading.Lock()
+
+        def grant_sink(grants: Dict[str, List[LeaseRequest]]) -> None:
+            nonlocal delivered
+            n = sum(len(v) for v in grants.values())
+            with sink_lock:
+                if collect_assignments:
+                    for nid, specs in grants.items():
+                        for s in specs:
+                            assignments[s.task_id] = nid
+                delivered += n
+                if delivered >= num_demands:
+                    done.set()
+
+        head._send_grants = grant_sink
+
+        with head._cond:
+            for i in range(num_nodes):
+                nid = f"simnode-{i}"
+                head.nodes[nid] = NodeInfo(
+                    node_id=nid,
+                    address="",
+                    resources={
+                        "CPU": cpu_per_node,
+                        "memory": memory_per_node,
+                    },
+                )
+                head.view.add_node(nid, head.nodes[nid].resources)
+
+        specs = [
+            LeaseRequest(
+                task_id=f"sim-{i}",
+                name="sim",
+                payload=b"",
+                return_ids=[],
+                resources=res,
+                max_retries=0,
+            )
+            for i, res in enumerate(build_demand_maps(num_demands, seed))
+        ]
+
+        round_buckets0 = SCHED_ROUND_MS.buckets_snapshot()
+        t0 = time.perf_counter()
+        with head._cond:
+            head._pending.extend(specs)
+            head._cond.notify_all()
+        completed = done.wait(timeout=timeout_s)
+        elapsed = time.perf_counter() - t0
+        round_buckets1 = SCHED_ROUND_MS.buckets_snapshot()
+        delta = [b1 - b0 for b0, b1 in zip(round_buckets0, round_buckets1)]
+
+        ds = head._lazy_device._result
+        out = {
+            "pipeline": pipeline,
+            "num_nodes": num_nodes,
+            "num_demands": num_demands,
+            "delivered": delivered,
+            "completed": completed,
+            "elapsed_s": round(elapsed, 3),
+            "placements_per_s": round(delivered / elapsed, 1)
+            if elapsed > 0
+            else 0.0,
+            "sched_round_p50_ms": round(
+                percentile_from_buckets(
+                    SCHED_ROUND_MS.boundaries, delta, 0.50
+                ),
+                3,
+            ),
+            "sched_round_p99_ms": round(
+                percentile_from_buckets(
+                    SCHED_ROUND_MS.boundaries, delta, 0.99
+                ),
+                3,
+            ),
+            "sched_rounds": int(sum(delta)),
+            "device_stats": dict(ds.stats) if ds is not None else None,
+            "pipeline_stats": (
+                head._pipeline.stats() if head._pipeline is not None else None
+            ),
+            "ring_occupancy": ds.ring_occupancy() if ds is not None else 0,
+        }
+        if collect_assignments:
+            out["assignments"] = assignments
+        return out
+    finally:
+        if head is not None:
+            head.shutdown(stop_agents=False)
+        if env_before is None:
+            os.environ.pop("RAY_TPU_SCHED_PIPELINE", None)
+        else:
+            os.environ["RAY_TPU_SCHED_PIPELINE"] = env_before
+
+
+def run_sim_pair(
+    num_nodes: int, num_demands: int, *, seed: int = 0, **kw
+) -> dict:
+    """Pipelined + synchronous runs over the SAME demand stream on the
+    same host: the speedup ratio and the divergence count (both modes
+    must place every spec, on identical nodes per spec when the stream
+    is deterministic). This is the bench tier's workhorse.
+
+    A throwaway warmup run at the same node geometry populates the
+    process-wide jit cache first — without it the sync run (which goes
+    first) pays every kernel compile and the comparison flatters the
+    pipeline."""
+    from ray_tpu.config import cfg
+
+    warm_demands = min(num_demands, 3 * int(cfg.sched_max_batch))
+    run_sim(num_nodes, warm_demands, pipeline=False, seed=seed, **kw)
+    sync = run_sim(
+        num_nodes, num_demands, pipeline=False, seed=seed,
+        collect_assignments=True, **kw
+    )
+    piped = run_sim(
+        num_nodes, num_demands, pipeline=True, seed=seed,
+        collect_assignments=True, **kw
+    )
+    a_sync = sync.pop("assignments")
+    a_piped = piped.pop("assignments")
+    divergent = sum(
+        1
+        for tid, nid in a_sync.items()
+        if a_piped.get(tid) != nid
+    ) + sum(1 for tid in a_piped if tid not in a_sync)
+    speedup = (
+        piped["placements_per_s"] / sync["placements_per_s"]
+        if sync["placements_per_s"]
+        else 0.0
+    )
+    return {
+        "sync": sync,
+        "pipelined": piped,
+        "placement_divergence": divergent,
+        "pipeline_speedup": round(speedup, 2),
+    }

@@ -104,17 +104,32 @@ class Histogram(_Metric):
             self._counts[k] = self._counts.get(k, 0) + 1
 
     def summary(self, labels: Optional[Dict[str, str]] = None) -> Dict[str, float]:
-        """(count, sum, mean) for one label set — observability surfaces
-        (agent DebugState, bench) read spawn-latency aggregates here."""
+        """(count, sum, mean, p50, p99) for one label set — observability
+        surfaces (agent DebugState, head QueryState, bench) read latency
+        aggregates here. Percentiles are bucket-interpolated estimates."""
         k = self._key(labels)
         with self._lock:
             count = self._counts.get(k, 0)
             total = self._sums.get(k, 0.0)
+            buckets = list(self._buckets.get(k, ()))
         return {
             "count": count,
             "sum": total,
             "mean": (total / count) if count else 0.0,
+            "p50": percentile_from_buckets(self.boundaries, buckets, 0.50),
+            "p99": percentile_from_buckets(self.boundaries, buckets, 0.99),
         }
+
+    def buckets_snapshot(
+        self, labels: Optional[Dict[str, str]] = None
+    ) -> List[int]:
+        """Copy of the per-bucket (disjoint, NOT Prometheus-cumulative)
+        counts, len(boundaries)+1 — callers diff two snapshots to get
+        percentiles over a window (``percentile_from_buckets``, which
+        expects this disjoint form)."""
+        k = self._key(labels)
+        with self._lock:
+            return list(self._buckets.get(k, [0] * (len(self.boundaries) + 1)))
 
     def samples(self) -> List[str]:
         out: List[str] = []
@@ -133,6 +148,31 @@ class Histogram(_Metric):
                 out.append(f"{self.name}_sum{tail} {self._sums[k]}")
                 out.append(f"{self.name}_count{tail} {self._counts[k]}")
         return out
+
+
+def percentile_from_buckets(
+    boundaries: Sequence[float], buckets: Sequence[int], q: float
+) -> float:
+    """Bucket-interpolated percentile estimate (Prometheus
+    histogram_quantile semantics): linear within the target bucket, the
+    last (+Inf) bucket reports its lower bound. 0.0 on no observations."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, count in enumerate(buckets):
+        if count <= 0:
+            continue
+        if cum + count >= rank:
+            lo = boundaries[i - 1] if i > 0 else 0.0
+            if i >= len(boundaries):  # +Inf bucket
+                return float(boundaries[-1])
+            hi = boundaries[i]
+            frac = (rank - cum) / count
+            return float(lo + (hi - lo) * frac)
+        cum += count
+    return float(boundaries[-1])
 
 
 def prometheus_text() -> str:

@@ -1,0 +1,444 @@
+"""Pipelined device-resident scheduling plane (ISSUE 6).
+
+Covers the tentpole's correctness obligations:
+  - host-mirror/device-mirror equivalence under a randomized stream of
+    node joins, deaths, grants, returns, and dirty pushes (both the
+    full-sync and delta-push paths),
+  - async round ordering: a dispatched round's deductions are visible to
+    the next round before anything has been read back (the avail chain),
+  - zero placement divergence between pipelined and synchronous modes on
+    identical demand streams through the REAL head path (scheduler/sim),
+  - the parked-demand ring, the batched unpark slot estimator, the
+    autoscaler's delta-synced bin-packer, and QueryState("sched").
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.scheduler.device import DeviceSchedulerState
+from ray_tpu.scheduler.pipeline import SchedulerPipeline
+from ray_tpu.scheduler.resources import ClusterView, ResourceVocab
+
+
+def make_view(n_nodes=4, cpu=8.0, mem=64.0):
+    vocab = ResourceVocab()
+    view = ClusterView(vocab)
+    for i in range(n_nodes):
+        view.add_node(f"node{i}", {"CPU": cpu, "memory": mem})
+    return vocab, view
+
+
+def device_avail(st):
+    return np.asarray(st._avail)
+
+
+# ---------------------------------------------------------------------------
+# host mirror / device mirror equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_mirror_equivalence_randomized_stream():
+    """Random joins/deaths/grants/returns/pushes: after every sync the
+    device avail matrix must equal the host mirror bit-for-bit, whether
+    the sync took the full-upload or the dirty-row delta path."""
+    rng = np.random.default_rng(1234)
+    vocab, view = make_view(4, cpu=16.0)
+    st = DeviceSchedulerState()
+    st.sync(view)
+    joined = 4
+    full_syncs = delta_pushes = 0
+    for step in range(200):
+        op = rng.choice(["grant", "return", "join", "death", "noop"],
+                        p=[0.45, 0.25, 0.08, 0.07, 0.15])
+        rows = view.totals.shape[0]
+        if op == "grant":
+            row = int(rng.integers(0, view.num_nodes))
+            d = np.zeros(view.totals.shape[1], dtype=np.float32)
+            d[0] = float(rng.choice([0.25, 0.5, 1.0]))
+            if rng.random() < 0.5:
+                view.subtract(row, d)
+            else:
+                k = int(rng.integers(1, 4))
+                view.subtract_many(
+                    rng.integers(0, view.num_nodes, k),
+                    np.broadcast_to(d, (k, d.shape[0])).copy(),
+                )
+        elif op == "return":
+            row = int(rng.integers(0, view.num_nodes))
+            d = np.zeros(view.totals.shape[1], dtype=np.float32)
+            d[0] = 0.25
+            view.add(row, d)
+        elif op == "join":
+            view.add_node(f"extra{step}", {"CPU": 8.0, "memory": 32.0})
+            joined += 1
+        elif op == "death":
+            nid = f"node{int(rng.integers(0, 4))}"
+            if view.alive[view.row_of(nid)]:
+                view.remove_node(nid)
+            else:  # rejoin at full capacity (fresh totals row)
+                view.add_node(nid, {"CPU": 16.0, "memory": 64.0})
+        before_full = st.stats["full_syncs"]
+        before_delta = st.stats["delta_pushes"]
+        st.sync(view)
+        full_syncs += st.stats["full_syncs"] - before_full
+        delta_pushes += st.stats["delta_pushes"] - before_delta
+        dev = device_avail(st)
+        np.testing.assert_array_equal(
+            dev, view.avail, err_msg=f"diverged after step {step} ({op})"
+        )
+        assert not view.dirty_rows  # sync consumed them
+    # the stream must have exercised BOTH protocols
+    assert full_syncs >= 1
+    assert delta_pushes >= 10
+
+
+def test_mirror_equivalence_through_kernel_rounds():
+    """Kernel-round deductions flow device→host (the readback applies the
+    same subtraction to the mirror); interleaved with dirty pushes the
+    two copies must still converge after each sync."""
+    rng = np.random.default_rng(7)
+    vocab, view = make_view(3, cpu=8.0)
+    st = DeviceSchedulerState()
+    st.sync(view)
+    r = view.totals.shape[1]
+    for step in range(20):
+        d = np.zeros(r, dtype=np.float32)
+        d[0] = float(rng.choice([0.5, 1.0]))
+        batch = np.stack([d] * int(rng.integers(1, 5)))
+        rows = st.schedule(batch)
+        for row in rows:
+            if row >= 0:
+                view.subtract(int(row), d)  # what the head's fan-out does
+        if rng.random() < 0.5:  # agent report overwrites a row
+            nid = f"node{int(rng.integers(0, 3))}"
+            view.update_available(nid, {"CPU": 8.0, "memory": 64.0})
+        st.sync(view)
+        np.testing.assert_allclose(
+            device_avail(st), view.avail, atol=1e-4,
+            err_msg=f"diverged after round {step}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# async pipeline ordering
+# ---------------------------------------------------------------------------
+
+
+def test_async_round_deductions_visible_before_readback():
+    """Round N+1 dispatched before round N's result() is consumed must
+    still see N's deductions (the avail chain orders rounds on device)."""
+    vocab, view = make_view(2, cpu=1.0)
+    st = DeviceSchedulerState()
+    st.sync(view)
+    r = view.totals.shape[1]
+    d = np.zeros(r, dtype=np.float32)
+    d[0] = 1.0
+    p1 = st.schedule_async(np.stack([d, d]))          # fills both nodes
+    p2 = st.schedule_async(np.stack([d]))             # dispatched behind it
+    rows2 = p2.result()
+    rows1 = p1.result()
+    assert sorted(rows1.tolist()) == [0, 1]
+    assert rows2.tolist() == [-1]  # round 1's deductions were visible
+
+
+def test_pipeline_backpressure_flush_and_order():
+    """submit() blocks at depth; completions run strictly in dispatch
+    order on the completion thread; flush() drains everything."""
+    done = []
+    gate = threading.Event()
+
+    class FakeRound:
+        def __init__(self, i):
+            self.ctx = i
+            self.dispatched_at = time.perf_counter()
+
+        def result(self):
+            # loud on timeout: silently proceeding would release a depth
+            # slot early and flake the backpressure assertion under load
+            assert gate.wait(timeout=60.0)
+            return np.array([self.ctx])
+
+    pipe = SchedulerPipeline(
+        on_complete=lambda ctx, rows, ms: done.append(ctx), depth=2
+    )
+    try:
+        pipe.submit(FakeRound(0))
+        pipe.submit(FakeRound(1))
+        # queue is at depth: the next submit must block until a slot frees
+        blocked = threading.Event()
+        unblocked = threading.Event()
+
+        def third():
+            blocked.set()
+            pipe.submit(FakeRound(2))
+            unblocked.set()
+
+        t = threading.Thread(target=third, daemon=True)
+        t.start()
+        blocked.wait(timeout=5.0)
+        time.sleep(0.2)
+        assert not unblocked.is_set()  # still parked on backpressure
+        gate.set()
+        assert pipe.flush(timeout=10.0)
+        t.join(timeout=5.0)
+        assert done == [0, 1, 2]  # strict dispatch order
+        assert pipe.stats()["completed"] == 3
+    finally:
+        pipe.stop()
+
+
+def test_pipeline_error_reports_and_survives():
+    """An on_complete raise must hit on_error and leave the completion
+    thread alive for later rounds."""
+    errors, done = [], []
+
+    class Boom:
+        ctx = "boom"
+        dispatched_at = 0.0
+
+        def result(self):
+            raise RuntimeError("kernel died")
+
+    class Ok:
+        ctx = "ok"
+
+        def __init__(self):
+            self.dispatched_at = time.perf_counter()
+
+        def result(self):
+            return np.array([1])
+
+    pipe = SchedulerPipeline(
+        on_complete=lambda ctx, rows, ms: done.append(ctx),
+        on_error=lambda ctx, exc: errors.append((ctx, str(exc))),
+        depth=2,
+    )
+    try:
+        pipe.submit(Boom())
+        pipe.submit(Ok())
+        assert pipe.flush(timeout=10.0)
+        assert errors == [("boom", "kernel died")]
+        assert done == ["ok"]
+    finally:
+        pipe.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipelined vs synchronous equivalence through the real head path
+# ---------------------------------------------------------------------------
+
+
+def test_sim_modes_place_identically():
+    """Both modes must deliver every demand and place each spec on the
+    SAME node (the acceptance criterion's divergence check, small)."""
+    from ray_tpu.scheduler.sim import run_sim_pair
+
+    pair = run_sim_pair(16, 600, timeout_s=120.0)
+    assert pair["sync"]["completed"] and pair["pipelined"]["completed"]
+    assert pair["sync"]["delivered"] == 600
+    assert pair["pipelined"]["delivered"] == 600
+    assert pair["placement_divergence"] == 0
+
+
+# ---------------------------------------------------------------------------
+# parked-demand ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_park_schedule_drop():
+    vocab, view = make_view(2, cpu=2.0)
+    st = DeviceSchedulerState()
+    st.sync(view)
+    r = view.totals.shape[1]
+    d = np.zeros(r, dtype=np.float32)
+    d[0] = 1.0
+    key = (("CPU", 1.0),)
+    assert st.ring_park(key, d)
+    assert st.ring_park(key, d)  # idempotent
+    assert st.ring_occupancy() == 1
+    slot = st.ring_slot_of(key)
+    placed, per_node = st.ring_schedule({slot: 10})
+    # 2 nodes x 2 CPU = 4 slots for a 1-CPU shape
+    assert int(placed[slot]) == 4
+    assert int(per_node[slot].sum()) == 4
+    # the kernel deducted on device; mirror the grants on the host like
+    # head._unpark_via_ring does, then verify convergence
+    rows = np.repeat(np.arange(per_node.shape[1]), per_node[slot])
+    view.subtract_many(rows, np.broadcast_to(d, (rows.shape[0], r)).copy())
+    st.sync(view)
+    np.testing.assert_allclose(device_avail(st), view.avail, atol=1e-4)
+    st.ring_drop(key)
+    assert st.ring_occupancy() == 0
+    assert st.ring_slot_of(key) is None
+
+
+def test_ring_full_falls_back():
+    import os
+
+    os.environ["RAY_TPU_SCHED_RING_SLOTS"] = "1"
+    try:
+        vocab, view = make_view(1, cpu=4.0)
+        st = DeviceSchedulerState()
+        st.sync(view)
+        r = view.totals.shape[1]
+        d = np.zeros(r, dtype=np.float32)
+        d[0] = 1.0
+        assert st.ring_park((("CPU", 1.0),), d)
+        d2 = d.copy()
+        d2[0] = 2.0
+        assert not st.ring_park((("CPU", 2.0),), d2)  # full → caller fallback
+    finally:
+        os.environ.pop("RAY_TPU_SCHED_RING_SLOTS", None)
+
+
+# ---------------------------------------------------------------------------
+# batched unpark slot estimation
+# ---------------------------------------------------------------------------
+
+
+def test_shape_slots_matches_host_scan():
+    vocab, view = make_view(3, cpu=4.0, mem=8.0)
+    st = DeviceSchedulerState()
+    view.subtract(0, np.asarray(
+        [2.0] + [0.0] * (view.totals.shape[1] - 1), dtype=np.float32))
+    st.sync(view)
+    r = view.totals.shape[1]
+    shapes = np.zeros((3, r), dtype=np.float32)
+    shapes[0, 0] = 1.0                  # CPU 1.0
+    shapes[1, 0], shapes[1, 1] = 2.0, 4.0  # CPU 2 + mem 4
+    shapes[2, 0] = 8.0                  # larger than any node: 0 slots
+    got = st.shape_slots(shapes)
+    for i in range(3):
+        d = shapes[i]
+        cols = d > 0
+        slots = np.floor(view.avail[:, cols] / d[cols][None, :]).min(axis=1)
+        slots = np.where(view.alive, np.maximum(slots, 0.0), 0.0)
+        # only real nodes' totals can satisfy the shape; capacity padding
+        # rows are alive=False already
+        feas = (view.totals >= d[None, :] - 1e-6).all(axis=1)
+        expect = int((slots * feas).sum())
+        assert int(got[i]) == expect, (i, int(got[i]), expect)
+
+
+def test_select_unparkable_device_estimator_agrees_with_host():
+    from ray_tpu.scheduler.unpark import select_unparkable
+
+    class Spec:
+        def __init__(self, res):
+            self.resources = res
+
+    vocab, view = make_view(2, cpu=2.0)
+    st = DeviceSchedulerState()
+    st.sync(view)
+    r = view.totals.shape[1]
+    from ray_tpu.scheduler.resources import ResourceRequest
+
+    parked = [Spec({"CPU": 1.0}) for _ in range(100)]
+    common = dict(
+        is_constrained=lambda s: False,
+        resources_of=lambda s: s.resources,
+        request_of=lambda s: ResourceRequest.from_map(vocab, s.resources),
+        slack=8,
+    )
+    take_host, keep_host = select_unparkable(
+        parked, view.avail.copy(), view.alive.copy(), **common
+    )
+    take_dev, keep_dev = select_unparkable(
+        parked, view.avail, view.alive,
+        slots_fn=st.shape_slots, **common
+    )
+    assert len(take_dev) == len(take_host)
+    assert len(keep_dev) == len(keep_host)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler delta-synced bin packer
+# ---------------------------------------------------------------------------
+
+
+def test_delta_binpacker_matches_direct_pack():
+    from ray_tpu.scheduler.binpack import DeltaBinPacker, bin_pack_residual
+
+    rng = np.random.default_rng(3)
+    packer = DeltaBinPacker()
+    ids = [f"n{i}" for i in range(6)]
+    rows = rng.uniform(1.0, 8.0, (6, 4)).astype(np.float32)
+    for tick in range(6):
+        # mutate a couple of rows per tick (reports landing), keep ids
+        for j in rng.integers(0, 6, 2):
+            rows[j] = rng.uniform(1.0, 8.0, 4).astype(np.float32)
+        demands = rng.uniform(0.5, 3.0, (5, 4)).astype(np.float32)
+        got = packer.pack(ids, rows, demands)
+        want = np.asarray(bin_pack_residual(rows, demands).node)
+        np.testing.assert_array_equal(got, want)
+    # membership change → full resync path, still exact
+    ids2 = ids + ["n6"]
+    rows2 = np.vstack([rows, rng.uniform(1.0, 8.0, (1, 4))]).astype(
+        np.float32
+    )
+    demands = rng.uniform(0.5, 3.0, (5, 4)).astype(np.float32)
+    got = packer.pack(ids2, rows2, demands)
+    want = np.asarray(bin_pack_residual(rows2, demands).node)
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_query_state_sched_surface():
+    from ray_tpu.cluster.common import LeaseRequest, NodeInfo
+    from ray_tpu.cluster.head import HeadServer
+
+    head = HeadServer(dashboard_port=None)
+    try:
+        with head._cond:
+            head.nodes["n0"] = NodeInfo(
+                node_id="n0", address="", resources={"CPU": 8.0}
+            )
+            head.view.add_node("n0", {"CPU": 8.0})
+        delivered = threading.Event()
+        head._send_grants = lambda grants: delivered.set()
+        specs = [
+            LeaseRequest(
+                task_id=f"t{i}", name="t", payload=b"", return_ids=[],
+                resources={"CPU": 1.0}, max_retries=0,
+            )
+            for i in range(4)
+        ]
+        with head._cond:
+            head._pending.extend(specs)
+            head._cond.notify_all()
+        assert delivered.wait(timeout=60.0)
+        out = head._h_query_state({"kind": "sched"})
+        assert "pipeline_enabled" in out
+        assert "round_ms" in out and "count" in out["round_ms"]
+        for k in ("upload_ms", "kernel_ms", "readback_ms"):
+            assert "p99" in out[k]
+        assert "ring_occupancy" in out and "ring_slots" in out
+        assert out["device"] is None or "delta_pushes" in out["device"]
+        assert out["sched_rounds"] >= 1
+    finally:
+        head.shutdown(stop_agents=False)
+
+
+def test_histogram_percentiles_and_snapshot():
+    from ray_tpu.util.metrics import Histogram, percentile_from_buckets
+
+    h = Histogram("t_ms_test_pipeline", "t", boundaries=(1, 2, 4, 8))
+    for v in (0.5, 1.5, 1.5, 3.0, 6.0, 100.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 6
+    assert 0.0 < s["p50"] <= 4.0
+    assert s["p99"] == 8.0  # +Inf bucket reports the last boundary
+    snap0 = h.buckets_snapshot()
+    h.observe(3.0)
+    snap1 = h.buckets_snapshot()
+    delta = [b1 - b0 for b0, b1 in zip(snap0, snap1)]
+    assert sum(delta) == 1
+    p = percentile_from_buckets((1, 2, 4, 8), delta, 0.5)
+    assert 2.0 <= p <= 4.0
