@@ -1245,6 +1245,89 @@ def sim_sched_bench() -> dict:
     return out
 
 
+def sim_weights_bench() -> dict:
+    """Tier 2c: multi-objective scheduling measurement (ISSUE 7). The
+    same 10k-node heterogeneous topology under a skewed, over-subscribed
+    CHURN stream (capacity returns hold_rounds after each grant), run
+    once at single-objective weights (1,0,0,0) and once at the
+    multi-objective set — SAME seeded stream. Publishes both modes'
+    delivered placements/s, the stranded-capacity percentage, the
+    large-shape wait percentiles, and the preemption counters, plus two
+    env-tunable exit-1 ceilings:
+
+      RAY_TPU_BENCH_FRAG_CEILING_PCT        — multi-objective
+        fragmentation_pct must not exceed this
+      RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS — multi-objective large-shape
+        p99 wait (rounds) must not exceed this
+    """
+    from ray_tpu.scheduler.sim import run_sim_weights_pair
+
+    num_nodes = int(os.environ.get("RAY_TPU_BENCH_SIM_NODES", 10_000))
+    num_demands = int(
+        os.environ.get(
+            "RAY_TPU_BENCH_SIM_WEIGHTS_DEMANDS",
+            os.environ.get("RAY_TPU_BENCH_SIM_DEMANDS", 200_000),
+        )
+    )
+    prewarm_before = os.environ.get("RAY_TPU_SCHED_PREWARM")
+    os.environ["RAY_TPU_SCHED_PREWARM"] = "0"
+    t0 = time.perf_counter()
+    try:
+        pair = run_sim_weights_pair(
+            num_nodes,
+            num_demands,
+            timeout_s=max(300.0, num_demands / 1000.0),
+        )
+    finally:
+        if prewarm_before is None:
+            os.environ.pop("RAY_TPU_SCHED_PREWARM", None)
+        else:
+            os.environ["RAY_TPU_SCHED_PREWARM"] = prewarm_before
+    single, multi = pair["single"], pair["multi"]
+    out = {
+        "sim_weights": list(pair["weights"]),
+        "sim_multiobj_placements_per_s": multi["placements_per_s"],
+        "sim_singleobj_placements_per_s": single["placements_per_s"],
+        "sim_multiobj_vs_single": pair["multi_vs_single_throughput"],
+        "sim_weights_completed": bool(
+            single["completed"] and multi["completed"]
+        ),
+        "sim_fragmentation_pct": pair["frag_pct_multi"],
+        "sim_fragmentation_pct_single": pair["frag_pct_single"],
+        "sim_p99_wait_rounds_large_shapes": pair[
+            "p99_wait_rounds_large_multi"
+        ],
+        "sim_p99_wait_rounds_large_shapes_single": pair[
+            "p99_wait_rounds_large_single"
+        ],
+        # sim nodes have no agents, so nominations cannot resolve to
+        # victim kills here — executed preemptions are exercised (and
+        # chaos-gated) by tests/test_preemption.py on a real cluster
+        "sim_preempt_nominations_total": pair["preempt_nominations"],
+        "sim_preemptions_total": pair["preemptions"],
+        "sim_weights_bench_s": round(time.perf_counter() - t0, 1),
+    }
+    frag_ceiling = float(
+        os.environ.get("RAY_TPU_BENCH_FRAG_CEILING_PCT", "0") or 0.0
+    )
+    if frag_ceiling > 0:
+        out["frag_ceiling_pct"] = frag_ceiling
+        out["frag_ceiling_ok"] = bool(
+            out["sim_weights_completed"]
+            and pair["frag_pct_multi"] <= frag_ceiling
+        )
+    wait_ceiling = float(
+        os.environ.get("RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS", "0") or 0.0
+    )
+    if wait_ceiling > 0:
+        out["wait_p99_ceiling_rounds"] = wait_ceiling
+        out["wait_p99_ok"] = bool(
+            out["sim_weights_completed"]
+            and pair["p99_wait_rounds_large_multi"] <= wait_ceiling
+        )
+    return out
+
+
 def main():
     out = {}
     tiers = None
@@ -1272,6 +1355,10 @@ def main():
             out.update(sim_sched_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             out["sim_sched_error"] = repr(exc)
+        try:
+            out.update(sim_weights_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            out["sim_weights_error"] = repr(exc)
     try:
         cluster = cluster_bench(
             int(os.environ.get("RAY_TPU_BENCH_E2E_TASKS", 10_000))
@@ -1341,11 +1428,15 @@ def main():
         or out.get("tasks_floor_ok") is False
         or out.get("recovery_p95_ok") is False
         or out.get("sched_floor_ok") is False
+        or out.get("frag_ceiling_ok") is False
+        or out.get("wait_p99_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
         # RAY_TPU_BENCH_TASKS_FLOOR_PER_S / RAY_TPU_BENCH_RECOVERY_P95_S /
-        # RAY_TPU_BENCH_SCHED_FLOOR_PLACEMENTS_PER_S):
+        # RAY_TPU_BENCH_SCHED_FLOOR_PLACEMENTS_PER_S /
+        # RAY_TPU_BENCH_FRAG_CEILING_PCT /
+        # RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

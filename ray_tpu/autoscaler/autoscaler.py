@@ -184,8 +184,12 @@ class Autoscaler:
                 )
             if avail_rows:
                 # delta-synced: node rows live on the scheduler device
-                # across ticks, changed rows scatter-push (binpack.py)
-                packed = self._packer.pack(avail_keys, avail_rows, dmat)
+                # across ticks, changed rows scatter-push; big demand
+                # batches route through the projected-gradient solve,
+                # small ones through the exact first-fit scan (binpack.py)
+                packed = self._packer.pack_or_solve(
+                    avail_keys, avail_rows, dmat
+                )
                 unfulfilled = dmat[packed < 0]
             else:
                 # zero nodes (cold cluster): everything is unfulfilled —

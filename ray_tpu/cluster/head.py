@@ -125,6 +125,22 @@ OWNERS_REAPED = _MetricCounter(
     label_names=("mode",),
 )
 
+# preemption / migration (ISSUE 7): the kernel nominates a victim node
+# per starving shape; the head kills-and-requeues concrete victims there
+SCHED_PREEMPT_NOMINATED = _MetricCounter(
+    "sched_preempt_nominated_total",
+    "Preemption nominations emitted by the round/ring kernels (starving "
+    "shape with unmet demand and zero capacity anywhere).",
+)
+SCHED_PREEMPTIONS = _MetricCounter(
+    "sched_preemptions_total",
+    "Victim leases actually preempted, by victim class (queued = "
+    "cancelled before start, requeued attempt-free; worker_lease = "
+    "revoked, owner spills; running = force-killed retryable task, "
+    "requeued attempt-free through the lineage machinery).",
+    label_names=("kind",),
+)
+
 
 def _shape_key_of(spec) -> tuple:
     """Memoized resource-shape identity of a spec — the ONE key the
@@ -257,6 +273,21 @@ class HeadServer:
         # lease ids cancelled while mid-schedule: dropped at dispatch time
         # (the round already popped them out of every scannable queue)
         self._cancelled_leases: set = set()
+        # --- starvation / preemption state (ISSUE 7) ---
+        # per-shape wait age in park-retry rounds: bumped every time a
+        # round leaves the shape (partly) unplaced, cleared when the
+        # shape's parked queue fully drains. Normalized by
+        # cfg.sched_starve_rounds and uploaded with the demand rows
+        # (kernel term d: starvation discount + preemption arming).
+        self._shape_wait: Dict[tuple, int] = {}
+        # lease ids whose running worker the head force-killed to
+        # preempt: the agent's worker-death "failed" report requeues them
+        # WITHOUT consuming a retry attempt (a preemption is a scheduler
+        # action, not a task failure)
+        self._preempted_leases: set = set()
+        # per-shape monotonic deadline before the next preemption action
+        # (freed capacity takes an agent report round-trip to appear)
+        self._preempt_cooldown: Dict[tuple, float] = {}
         self._in_flight: Dict[str, Tuple[LeaseRequest, str]] = {}
         # streaming-generator state: task_id -> {"items": [hex...],
         # "done": bool, "consumed": int, "touched": monotonic}
@@ -341,6 +372,8 @@ class HeadServer:
             "task_leases_granted": 0,
             "task_leases_returned": 0,
             "task_leases_revoked": 0,
+            "preempt_nominations": 0,
+            "preemptions": 0,
         }
 
         self._dispatch_pool = ThreadPoolExecutor(
@@ -1047,8 +1080,14 @@ class HeadServer:
                 self._fail_stream(spec, reason)
             self._release_lease_pins(spec.task_id)
             return
-        if spec.attempt < spec.max_retries:
-            spec.attempt += 1
+        with self._cond:
+            preempted = spec.task_id in self._preempted_leases
+            self._preempted_leases.discard(spec.task_id)
+        if preempted or spec.attempt < spec.max_retries:
+            # a victim whose preemption kill raced node death still
+            # requeues attempt-free (the kill was the scheduler's doing)
+            if not preempted:
+                spec.attempt += 1
             spec.target_node = None
             with self._cond:
                 self.metrics["leases_spilled_back"] += 1
@@ -1433,6 +1472,18 @@ class HeadServer:
             if spec.task_id in self._cancelled_leases:
                 self._cancelled_leases.discard(spec.task_id)
                 continue  # force-cancel kill: already sealed cancelled
+            preempted = spec.task_id in self._preempted_leases
+            if preempted:
+                # preemption kill (migration): a scheduler action, not a
+                # task failure — requeue with NO retry attempt burned;
+                # the next round places it on a different node
+                with self._cond:
+                    self._preempted_leases.discard(spec.task_id)
+                    self.metrics["leases_spilled_back"] += 1
+                    spec.target_node = None
+                    self._pending.append(spec)
+                    self._cond.notify_all()
+                continue
             if fail.get("requeue"):
                 # contention spillback: back to the queue, no retry burned
                 with self._cond:
@@ -2484,6 +2535,7 @@ class HeadServer:
             resources_of=lambda s: s.resources,
             request_of=self._spec_req,
             reserved=reserved or None,
+            age_of=lambda k: self._shape_wait.get(k, 0),
         )
         self._pending.extend(take)
         self._infeasible = keep + keep_ring
@@ -2545,11 +2597,21 @@ class HeadServer:
             device_state.ring_slot_of(key): len(q)
             for key, q in ring_q.items()
         }
-        placed, per_node = device_state.ring_schedule(
-            counts, spread_threshold=self.hybrid_config.spread_threshold
+        starve_rounds = max(1, int(cfg.sched_starve_rounds))
+        ages = {
+            device_state.ring_slot_of(key): (
+                self._shape_wait.get(key, 0) / starve_rounds
+            )
+            for key in ring_q
+        }
+        placed, per_node, pre_rows = device_state.ring_schedule(
+            counts,
+            spread_threshold=self.hybrid_config.spread_threshold,
+            ages_by_slot=ages,
         )
         still_parked: List[LeaseRequest] = []
         grants: Dict[str, List[LeaseRequest]] = {}
+        nominations: List[Tuple[tuple, int]] = []
         n = per_node.shape[1]
         for key, q in ring_q.items():
             slot = device_state.ring_slot_of(key)
@@ -2571,6 +2633,20 @@ class HeadServer:
             still_parked.extend(q[k:])
             if k == len(q):
                 device_state.ring_drop(key)  # queue drained: free the slot
+                self._shape_wait.pop(key, None)
+                self._preempt_cooldown.pop(key, None)
+            elif k > 0:
+                # class made progress: not starving (see _fan_out_grants)
+                self._shape_wait.pop(key, None)
+            else:
+                # the ring retry IS this shape's scheduling round: age it
+                self._shape_wait[key] = self._shape_wait.get(key, 0) + 1
+                if int(pre_rows[slot]) >= 0:
+                    nominations.append(
+                        (key, int(pre_rows[slot]), self._spec_req(q[0]).dense(r))
+                    )
+        if nominations:
+            self._handle_ring_preempt(nominations)
         if grants:
             self.metrics["leases_unparked_ring"] = self.metrics.get(
                 "leases_unparked_ring", 0
@@ -2687,7 +2763,7 @@ class HeadServer:
             with self._cond:
                 self._infeasible.extend(kernel_batch)
             return False
-        specs, shape_rows, sids, infeasible = self._round_shapes(
+        specs, shape_rows, sids, infeasible, keys, ages = self._round_shapes(
             kernel_batch, r
         )
         if infeasible:
@@ -2698,7 +2774,6 @@ class HeadServer:
                 self._infeasible.extend(infeasible)
         if not specs:
             return False
-        sched = (specs, shape_rows, sids)
         if device_state is not None:
             # the default path: shape-grouped waterfall kernel over the
             # device-resident view (device.py module docstring). Pipelined
@@ -2709,9 +2784,11 @@ class HeadServer:
             if cfg.sched_pipeline:
                 pending = device_state.schedule_async(
                     spread_threshold=self.hybrid_config.spread_threshold,
-                    ctx=sched,
                     shapes=(shape_rows, sids),
+                    ages=ages,
                 )
+                sched = (specs, shape_rows, sids, keys, pending)
+                pending.ctx = sched
                 with self._cond:
                     self._deferred_rounds[id(sched)] = specs
                 try:
@@ -2735,10 +2812,13 @@ class HeadServer:
                         self._cond.notify_all()
                     return False
                 return True
-            rows = device_state.schedule_async(
+            pending = device_state.schedule_async(
                 spread_threshold=self.hybrid_config.spread_threshold,
                 shapes=(shape_rows, sids),
-            ).result()
+                ages=ages,
+            )
+            sched = (specs, shape_rows, sids, keys, pending)
+            rows = pending.result()
         else:
             demands = shape_rows[sids]
             prefer = np.zeros(len(specs), dtype=np.int32)
@@ -2755,16 +2835,29 @@ class HeadServer:
             )
             # feasible-but-unavailable picks are not grants: park them
             rows = np.where(np.asarray(_granted), rows, -1)
+            sched = (specs, shape_rows, sids, keys)
         self._fan_out_grants(sched, np.asarray(rows))
+        if len(sched) > 4:
+            self._handle_preempt(sched, sched[4].preempt_rows())
         return False
 
     def _round_shapes(self, batch: List[LeaseRequest], r: int):
         """Round demand prep off the per-shape dense-row cache:
-        ``(specs, shape_rows f32[U,r], sids int32[B], infeasible)`` in the
-        hardest-first shape order the waterfall kernel expects. Replaces
-        the per-spec ``dense()`` + stack + ``np.unique`` pass (O(B·R), the
-        dominant host cost of a round at 10k nodes) with one dict lookup
-        per spec and an O(U log U) sort over the round's unique shapes."""
+        ``(specs, shape_rows f32[U,r], sids int32[B], infeasible,
+        keys, ages f32[U])`` in the waterfall kernel's shape order.
+        Replaces the per-spec ``dense()`` + stack + ``np.unique`` pass
+        (O(B·R), the dominant host cost of a round at 10k nodes) with one
+        dict lookup per spec and an O(U log U) sort over the round's
+        unique shapes.
+
+        Shape order: hardest-first (``hardest_first_order``), with
+        STARVING shapes (integer wait-age buckets, from ``_shape_wait``)
+        stably promoted to the front — a shape that has waited longest
+        claims capacity first, the fairness half of the starvation term.
+        With no waiting shapes the order is byte-identical to the
+        single-objective prep. ``ages`` are normalized by
+        ``sched_starve_rounds`` and ride the demand upload (kernel
+        starvation discount + preemption arming)."""
         cache_r, cache = self._dense_cache
         if cache_r != r or len(cache) > 8192:
             # width change invalidates; the size cap bounds a workload
@@ -2774,6 +2867,7 @@ class HeadServer:
             self._dense_cache = (r, cache)
         slots: Dict[tuple, int] = {}
         rows_l: List[np.ndarray] = []
+        keys_l: List[tuple] = []
         specs: List[LeaseRequest] = []
         sid_l: List[int] = []
         infeasible: List[LeaseRequest] = []
@@ -2796,16 +2890,36 @@ class HeadServer:
                 slot = len(rows_l)
                 slots[key] = slot
                 rows_l.append(row)
+                keys_l.append(key)
             specs.append(spec)
             sid_l.append(slot)
         if not specs:
-            return specs, None, None, infeasible
+            return specs, None, None, infeasible, None, None
         shape_rows = np.stack(rows_l).astype(np.float32, copy=False)
         sids = np.asarray(sid_l, dtype=np.int32)
         order = hardest_first_order(shape_rows)
+        starve_rounds = max(1, int(cfg.sched_starve_rounds))
+        with self._cond:  # _shape_wait is shared with the completion thread
+            ages = np.asarray(
+                [self._shape_wait.get(k, 0) / starve_rounds for k in keys_l],
+                dtype=np.float32,
+            )
+        if ages.any():
+            # starving-first, stable within equal age buckets (all-zero
+            # ages leave the hardest-first order untouched)
+            buckets = np.minimum(ages[order], 8.0).astype(np.int32)
+            order = order[np.argsort(-buckets, kind="stable")]
         remap = np.empty(shape_rows.shape[0], dtype=np.int32)
         remap[order] = np.arange(shape_rows.shape[0], dtype=np.int32)
-        return specs, shape_rows[order], remap[sids], infeasible
+        keys = [keys_l[i] for i in order]
+        return (
+            specs,
+            shape_rows[order],
+            remap[sids],
+            infeasible,
+            keys,
+            ages[order],
+        )
 
     def _ensure_pipeline(self):
         """The completion-side of pipelined rounds; created on first use
@@ -2826,6 +2940,8 @@ class HeadServer:
         SCHED_ROUND_MS.observe(round_ms)
         try:
             self._fan_out_grants(sched, rows)
+            if len(sched) > 4:
+                self._handle_preempt(sched, sched[4].preempt_rows())
         except Exception:  # noqa: BLE001 - must not reach _round_failed
             # a PARTIAL fan-out is not safely unwindable (unplaced specs
             # already parked, host deductions applied, some grants sent):
@@ -2856,14 +2972,63 @@ class HeadServer:
 
     def _fan_out_grants(self, sched, rows: np.ndarray) -> None:
         """Turn one round's placement rows into per-node grant batches.
-        ``sched`` is a ``(specs, shape_rows, sids)`` round context
-        (_round_shapes). Unplaced specs park (and pin their shape in the
-        device ring); placements deduct from the host mirror in ONE
-        vectorized scatter-subtract and group per node off one argsort —
-        the per-spec lock/subtract/setdefault loop dominated the host
-        cost of a full round at 10k nodes."""
-        specs, shape_rows, sids = sched
+        ``sched`` is a ``(specs, shape_rows, sids[, keys[, pending]])``
+        round context (_round_shapes). Unplaced specs park (and pin their
+        shape in the device ring); placements deduct from the host mirror
+        in ONE vectorized scatter-subtract and group per node off one
+        argsort — the per-spec lock/subtract/setdefault loop dominated
+        the host cost of a full round at 10k nodes. Shape wait-ages bump
+        for shapes the round left (partly) unplaced and clear for fully
+        placed ones (the starvation term's input)."""
+        specs, shape_rows, sids = sched[0], sched[1], sched[2]
+        keys = sched[3] if len(sched) > 3 else None
         placed_mask = rows >= 0
+        if keys is not None:
+            u = shape_rows.shape[0]
+            total_per_shape = np.bincount(sids, minlength=u)
+            placed_per_shape = np.bincount(
+                sids[placed_mask], minlength=u
+            )
+            # under the lock: the scheduler thread (_round_shapes ages
+            # read, ring-path bumps), RPC threads (QueryState), and this
+            # completion thread all touch the wait tables
+            with self._cond:
+                for i, key in enumerate(keys):
+                    if total_per_shape[i] == 0:
+                        continue
+                    if placed_per_shape[i] > 0:
+                        # the CLASS made progress this round: it is not
+                        # starving, even with instances left over —
+                        # aging a continuously-served shape made it
+                        # "starve" and preempt its own running peers in
+                        # a kill/requeue livelock
+                        self._shape_wait.pop(key, None)
+                        if placed_per_shape[i] >= total_per_shape[i]:
+                            self._preempt_cooldown.pop(key, None)
+                    else:
+                        self._shape_wait[key] = (
+                            self._shape_wait.get(key, 0) + 1
+                        )
+                if len(self._shape_wait) > 4096:
+                    # bound the tables: entries normally clear on full
+                    # placement; cancelled-last-spec shapes can leak —
+                    # evict the youngest half (oldest = closest to
+                    # starving, keep) and their cooldown rows with them
+                    for k in sorted(
+                        self._shape_wait, key=self._shape_wait.get
+                    )[:2048]:
+                        self._shape_wait.pop(k, None)
+                        self._preempt_cooldown.pop(k, None)
+                if len(self._preempt_cooldown) > 4096:
+                    # cooldowns for shapes that drained while parked
+                    # have no other reaper: drop the expired ones
+                    now = time.monotonic()
+                    for k in [
+                        k
+                        for k, t in self._preempt_cooldown.items()
+                        if t <= now
+                    ]:
+                        self._preempt_cooldown.pop(k, None)
         unplaced = [specs[i] for i in np.flatnonzero(~placed_mask)]
         if unplaced:
             with self._cond:
@@ -2919,6 +3084,225 @@ class HeadServer:
             if any(c >= r and fp > 0 for c, fp in req.demands.items()):
                 continue
             device_state.ring_park(_shape_key_of(spec), req.dense(r))
+
+    # ------------------------------------------------------------------
+    # preemption / migration (ISSUE 7): the kernel NOMINATES (per
+    # starving shape, the lowest-cost feasible-by-totals node); the head
+    # maps the node to concrete victim leases and kill-and-requeues
+    # through the PR 5 lineage/fate-sharing machinery. State machine per
+    # victim (COMPONENTS.md):
+    #   queued-on-agent  --CancelLease--> requeued (no attempt burned)
+    #   worker_lease     --revoke------->  owner spills to head path
+    #   running retryable --force kill--> worker-death report -->
+    #                                     requeued via _preempted_leases
+    #                                     (no attempt burned)
+    #   running max_retries=0            NEVER a victim (at-most-once)
+    # ------------------------------------------------------------------
+
+    def _nominate(self, key: tuple, row: int, need: np.ndarray) -> bool:
+        """One nomination: per-shape cooldown gate, metrics, node
+        resolution, and the async victim fan-out. The ONE copy of the
+        nomination policy, shared by the round-kernel and ring paths.
+        Returns False when the dispatch pool is gone (caller stops)."""
+        now = time.monotonic()
+        with self._lock:  # cooldown table is shared across threads
+            if self._preempt_cooldown.get(key, 0.0) > now:
+                return True
+            self._preempt_cooldown[key] = (
+                now + float(cfg.sched_preempt_cooldown_s)
+            )
+            self.metrics["preempt_nominations"] += 1
+            if row >= self.view.num_nodes:
+                node_id = None
+            else:
+                node_id = self.view.node_id(row)
+        SCHED_PREEMPT_NOMINATED.inc()
+        if node_id is None:
+            return True
+        # victim kills do RPCs: off the completion thread
+        try:
+            self._dispatch_pool.submit(
+                self._preempt_on_node, node_id, need, key
+            )
+        except RuntimeError:  # dispatch pool shut down
+            return False
+        return True
+
+    def _handle_preempt(self, sched, pre_rows: Optional[np.ndarray]) -> None:
+        """Fan one round's preemption nominations out into victim kills.
+        ``sched`` = (specs, shape_rows, sids, keys, pending)."""
+        if pre_rows is None or not cfg.sched_preempt:
+            return
+        keys, shape_rows = sched[3], sched[1]
+        for u, row in enumerate(np.asarray(pre_rows)):
+            if row < 0 or keys is None or u >= len(keys):
+                continue
+            if not self._nominate(keys[u], int(row), shape_rows[u]):
+                return
+
+    def _handle_ring_preempt(
+        self, nominations: List[Tuple[tuple, int, np.ndarray]]
+    ) -> None:
+        """Ring-round nominations: (shape key, node row, dense demand)
+        triples from ``_unpark_via_ring`` — same cooldown + victim
+        fan-out as the round-kernel path (``_nominate``)."""
+        if not cfg.sched_preempt:
+            return
+        for key, row, need in nominations:
+            if not self._nominate(key, row, need):
+                return
+
+    def _pick_preemption_victims(
+        self, node_id: str, need: np.ndarray
+    ) -> Tuple[List[str], List[Tuple[LeaseRequest, bool]]]:
+        """(worker-lease victims, (task spec, may_force) victims) on
+        ``node_id``, lowest-cost-first, accumulating until the freed
+        demand covers ``need`` on its demanded columns (bounded by
+        sched_preempt_max_per_round). Lowest cost = least work lost:
+        worker leases (spill, nothing re-executes) before task leases
+        (smallest resource footprint first). Running max_retries=0 work
+        is never force-killable; queued work of any retry class is (it
+        has not started — requeue is not re-execution). Victims must be
+        STRICTLY CHEAPER than the starving shape (demand sum): a shape
+        preempting peers of its own size just swaps who waits while
+        losing work — observed as a kill/requeue livelock. Caller need
+        not hold the lock."""
+        cols = need > 0
+        need_sum = float(need.sum())
+        limit = max(1, int(cfg.sched_preempt_max_per_round))
+        lease_victims: List[str] = []
+        task_victims: List[Tuple[LeaseRequest, bool]] = []
+        freed = np.zeros_like(need)
+        with self._cond:
+            cands: List[Tuple[float, str, object]] = []
+            for lid, e in self._task_leases.items():
+                if e.get("node_id") != node_id or e["state"] != "active":
+                    continue
+                spec = self._leases.get(lid)
+                d = (
+                    self._spec_req(spec).dense(need.shape[0])
+                    if spec is not None
+                    else self.vocab.pack(e["resources"])[: need.shape[0]]
+                )
+                if not (d[cols] > 0).any():
+                    continue  # frees nothing the starving shape needs
+                if float(d.sum()) >= need_sum:
+                    continue  # not strictly cheaper: peer churn, skip
+                cands.append((float(d.sum()), "lease", (lid, d)))
+            for lid, (spec, nid) in self._in_flight.items():
+                if nid != node_id or spec.kind != "task":
+                    continue
+                d = self._spec_req(spec).dense(need.shape[0])
+                if not (d[cols] > 0).any():
+                    continue
+                if float(d.sum()) >= need_sum:
+                    continue  # not strictly cheaper: peer churn, skip
+                # +1.0 sort bias: prefer worker leases at equal footprint
+                cands.append((float(d.sum()) + 1.0, "task", (spec, d)))
+            cands.sort(key=lambda c: c[0])
+            for _, kind, payload in cands:
+                if (
+                    len(lease_victims) + len(task_victims) >= limit
+                    or np.all(freed[cols] >= need[cols])
+                ):
+                    break
+                if kind == "lease":
+                    lid, d = payload
+                    lease_victims.append(lid)
+                    freed = freed + d
+                else:
+                    spec, d = payload
+                    may_force = (
+                        bool(cfg.sched_preempt_running)
+                        and spec.attempt < spec.max_retries
+                    )
+                    task_victims.append((spec, may_force))
+                    freed = freed + d
+        return lease_victims, task_victims
+
+    def _preempt_on_node(
+        self, node_id: str, need: np.ndarray, shape_key: tuple
+    ) -> None:
+        """Execute one nomination: revoke/kill the chosen victims so the
+        starving shape's next round finds capacity on ``node_id``."""
+        lease_victims, task_victims = self._pick_preemption_victims(
+            node_id, need
+        )
+        for lid in lease_victims:
+            with self._cond:
+                if self._drop_task_lease_locked(lid) is None:
+                    continue
+                self.metrics["task_leases_revoked"] += 1
+                TASK_LEASE_REVOKED.inc()
+                self.metrics["preemptions"] += 1
+                SCHED_PREEMPTIONS.inc(labels={"kind": "worker_lease"})
+                self._cond.notify_all()
+            self._wal_flush()
+            logger.info(
+                "preempted worker lease %s on %s for starving shape %r",
+                lid[:8],
+                node_id,
+                shape_key,
+            )
+            self._agent_return_lease(node_id, lid)
+        if not task_victims:
+            return
+        client = self._clients.get(node_id)
+        if client is None:
+            return
+        for spec, may_force in task_victims:
+            lid = spec.task_id
+            try:
+                reply = client.call(
+                    "CancelLease", {"task_id": lid, "force": False},
+                    timeout=10.0,
+                )
+            except RpcError:
+                continue  # unreachable: the health path owns this node
+            if reply.get("cancelled"):
+                # still queued agent-side: it never started — requeue
+                # with no attempt burned (a preemption is a scheduler
+                # action, not a task failure)
+                with self._cond:
+                    self._in_flight.pop(lid, None)
+                    spec.target_node = None
+                    self._pending.append(spec)
+                    self.metrics["preemptions"] += 1
+                    SCHED_PREEMPTIONS.inc(labels={"kind": "queued"})
+                    self._cond.notify_all()
+                logger.info(
+                    "preempted queued lease %s on %s (requeued)",
+                    lid[:8],
+                    node_id,
+                )
+                continue
+            if not may_force:
+                continue  # running and not safely re-executable: skip
+            # running retryable task: kill-and-requeue. The flag makes
+            # the agent's worker-death "failed" report requeue WITHOUT
+            # consuming a retry attempt (_h_report_seals).
+            with self._cond:
+                self._preempted_leases.add(lid)
+            try:
+                reply = client.call(
+                    "CancelLease", {"task_id": lid, "force": True},
+                    timeout=10.0,
+                )
+                if reply.get("cancelled"):
+                    self.metrics["preemptions"] += 1
+                    SCHED_PREEMPTIONS.inc(labels={"kind": "running"})
+                    logger.info(
+                        "preempted running lease %s on %s (migrating)",
+                        lid[:8],
+                        node_id,
+                    )
+                else:
+                    # finished (or vanished) before the kill landed
+                    with self._cond:
+                        self._preempted_leases.discard(lid)
+            except RpcError:
+                with self._cond:
+                    self._preempted_leases.discard(lid)
 
     def _dispatch_batch_blocking(
         self, specs: List[LeaseRequest], node_id: str, client: RpcClient
@@ -3995,7 +4379,17 @@ class HeadServer:
                 }
             if kind == "sched":
                 # the scheduling plane: round-latency decomposition,
-                # pipeline occupancy, delta-sync and parked-ring state
+                # pipeline occupancy, delta-sync and parked-ring state,
+                # multi-objective weights + starvation/preemption state,
+                # and the autoscaler solver's health — observable without
+                # a bench run
+                from ray_tpu.scheduler.binpack import (
+                    SOLVER_FALLBACKS,
+                    SOLVER_ITERS,
+                    SOLVER_RUNS,
+                )
+                from ray_tpu.scheduler.device import score_weights_from_cfg
+
                 ds = self._lazy_device._result
                 return {
                     "pipeline_enabled": bool(cfg.sched_pipeline),
@@ -4009,7 +4403,14 @@ class HeadServer:
                     "upload_ms": SCHED_UPLOAD_MS.summary(),
                     "kernel_ms": SCHED_KERNEL_MS.summary(),
                     "readback_ms": SCHED_READBACK_MS.summary(),
+                    # device stats carry the delta-sync counters incl.
+                    # delta_rows_hwm (largest single dirty-row push)
                     "device": dict(ds.stats) if ds is not None else None,
+                    "delta_rows_hwm": (
+                        ds.stats.get("delta_rows_hwm", 0)
+                        if ds is not None
+                        else 0
+                    ),
                     "ring_occupancy": (
                         ds.ring_occupancy() if ds is not None else 0
                     ),
@@ -4018,6 +4419,25 @@ class HeadServer:
                         "leases_unparked_ring", 0
                     ),
                     "sched_rounds": self.metrics["sched_rounds"],
+                    "score_weights": tuple(score_weights_from_cfg()),
+                    "shape_wait_max_rounds": (
+                        max(self._shape_wait.values())
+                        if self._shape_wait
+                        else 0
+                    ),
+                    "shapes_waiting": len(self._shape_wait),
+                    "preempt_nominations": self.metrics[
+                        "preempt_nominations"
+                    ],
+                    "preemptions": self.metrics["preemptions"],
+                    "preemptions_by_kind": (
+                        SCHED_PREEMPTIONS.values_by_label()
+                    ),
+                    "autoscaler_solver": {
+                        "runs": SOLVER_RUNS.value(),
+                        "fallbacks": SOLVER_FALLBACKS.value(),
+                        "iters_per_solve": SOLVER_ITERS.value(),
+                    },
                 }
             if kind == "dispatch":
                 # the task-lease dispatch plane (lease-cached direct

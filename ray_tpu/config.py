@@ -179,6 +179,97 @@ define(
     "on the scheduler device (one batched kernel over the resident "
     "availability arrays) instead of per-shape host NumPy scans.",
 )
+# --- multi-objective scoring weights (hybrid.ScoreWeights) ---
+# (1, 0, 0, 0) recovers the single-objective kernel bit-for-bit; the
+# extra terms are skipped at trace time, so the defaults cost nothing.
+define(
+    "sched_w_util",
+    1.0,
+    "Weight of the reference-compatible critical-utilization term in the "
+    "multi-objective scheduling cost (quantized spread score).",
+)
+define(
+    "sched_w_het",
+    0.0,
+    "Weight of the heterogeneity term (Gavel-style per-(shape, node-type)"
+    " effective-throughput penalty from ClusterView.type_throughput).",
+)
+define(
+    "sched_w_frag",
+    0.0,
+    "Weight of the fragmentation term (post-placement stranded-capacity "
+    "estimate vs the round's largest demand shape): >0 packs small "
+    "shapes onto already-broken nodes instead of stranding whole ones.",
+)
+define(
+    "sched_w_starve",
+    0.0,
+    "Starvation discount of the soft het/frag terms: a shape parked "
+    "w_starve-scaled wait-ages stops holding out for a well-scored node "
+    "and takes any available one.",
+)
+define(
+    "sched_starve_rounds",
+    32,
+    "Park-retry rounds before a demand shape counts as STARVING: its "
+    "normalized wait-age crosses 1.0, arming preemption nomination and "
+    "maxing the starvation discount.",
+)
+define(
+    "sched_preempt",
+    True,
+    "Preemption as a first-class scheduler action: a starving shape with "
+    "zero capacity anywhere nominates its lowest-cost feasible node in "
+    "the round kernel, and the head kills-and-requeues preemptable "
+    "victims there (queued leases respill untouched; active worker "
+    "leases revoke and spill; running retryable tasks may be killed — "
+    "see sched_preempt_running). max_retries=0 victims that already "
+    "started are NEVER preempted (at-most-once).",
+)
+define(
+    "sched_preempt_running",
+    True,
+    "Allow preemption to force-kill a RUNNING task when its lease is "
+    "retryable (attempt < max_retries); the kill requeues through the "
+    "lineage machinery WITHOUT consuming a retry attempt. Off: only "
+    "not-yet-running work and worker leases are preemptable.",
+)
+define(
+    "sched_preempt_max_per_round",
+    8,
+    "Cap on victim leases preempted per scheduling round (a starvation "
+    "storm must drain gradually, not mass-kill the cluster).",
+)
+define(
+    "sched_preempt_cooldown_s",
+    2.0,
+    "Per-shape cooldown between preemption actions: the freed capacity "
+    "needs agent report round-trips to become placeable, so re-preempting"
+    " for the same starving shape every round would overshoot.",
+)
+# --- autoscaler on-device residual solve ---
+define(
+    "autoscaler_solve",
+    True,
+    "Solve the autoscaler's residual bin-pack as a fixed-iteration "
+    "projected-gradient allocation over DeltaBinPacker's resident "
+    "arrays (CvxCluster-style batched iterative solve, arxiv "
+    "2605.01614) instead of the O(demands) first-fit scan. The host "
+    "greedy remains the oracle and the automatic fallback.",
+)
+define(
+    "autoscaler_solve_iters",
+    24,
+    "Fixed projected-gradient iteration count of the autoscaler solve "
+    "(jit-prewarmed; more iterations sharpen the allocation but the "
+    "exact extraction pass keeps any count correct).",
+)
+define(
+    "autoscaler_solve_min_demands",
+    64,
+    "Demand batches smaller than this pack with the exact first-fit "
+    "kernel (per-demand scan beats the solve's fixed overhead there).",
+)
 define(
     "spill_storage_uri",
     "",

@@ -23,15 +23,18 @@ from .resources import (  # noqa: F401
 )
 from .hybrid import (  # noqa: F401
     HybridConfig,
+    ScoreWeights,
     hybrid_schedule_batch,
     hybrid_schedule_reference,
     hybrid_schedule_rounds,
+    hybrid_schedule_shapes_multi,
 )
 from .bundles import schedule_bundles, sort_bundles  # noqa: F401
 from .binpack import (  # noqa: F401
     DeltaBinPacker,
     bin_pack_residual,
     pick_best_node_type,
+    solve_pack_counts,
     sort_demands,
     utilization_scores,
 )

@@ -156,7 +156,7 @@ def _jitted_fns():
             import jax
 
             from .hybrid import (
-                hybrid_schedule_shapes_impl,
+                hybrid_schedule_shapes_multi_impl,
                 ring_schedule_impl,
                 shape_slots_impl,
             )
@@ -170,19 +170,35 @@ def _jitted_fns():
             # the buffer in place is one f32[C,R] allocation per round
             # (~1 MB at 10k nodes) — noise next to the overlap it buys.
             kernel = jax.jit(
-                hybrid_schedule_shapes_impl,
-                static_argnames=("spread_threshold",),
+                hybrid_schedule_shapes_multi_impl,
+                static_argnames=("spread_threshold", "weights", "preempt"),
             )
             push = jax.jit(
                 lambda avail, rows, vals: avail.at[rows].set(vals),
             )
             ring = jax.jit(
                 ring_schedule_impl,
-                static_argnames=("spread_threshold",),
+                static_argnames=("spread_threshold", "weights", "preempt"),
             )
             slots = jax.jit(shape_slots_impl)
             _jitted = (kernel, push, ring, slots)
         return _jitted
+
+
+def score_weights_from_cfg():
+    """The round kernels' multi-objective weights (hybrid.ScoreWeights)
+    from config — static under jit, so a weight edit is a one-time
+    recompile, not a per-round upload."""
+    from ray_tpu.config import cfg
+
+    from .hybrid import ScoreWeights
+
+    return ScoreWeights(
+        util=float(cfg.sched_w_util),
+        het=float(cfg.sched_w_het),
+        frag=float(cfg.sched_w_frag),
+        starve=float(cfg.sched_w_starve),
+    )
 
 
 def _configure_compile_cache() -> None:
@@ -274,11 +290,13 @@ class PendingRound:
     already dispatched keep executing behind it (avail chain).
     """
 
-    __slots__ = ("_node", "_b", "dispatched_at", "ctx")
+    __slots__ = ("_node", "_b", "_preempt", "_u", "dispatched_at", "ctx")
 
-    def __init__(self, node, b: int, ctx=None):
+    def __init__(self, node, b: int, ctx=None, preempt=None, u: int = 0):
         self._node = node
         self._b = b
+        self._preempt = preempt  # int32[U_pad] device, or None
+        self._u = u              # real (unpadded) shape count
         self.dispatched_at = time.perf_counter()
         self.ctx = ctx  # opaque caller payload (e.g. the round's specs)
 
@@ -299,6 +317,17 @@ class PendingRound:
         SCHED_READBACK_MS.observe((time.perf_counter() - t0) * 1e3)
         self._node = None  # drop the device buffer eagerly
         return rows
+
+    def preempt_rows(self) -> Optional[np.ndarray]:
+        """int32[U] per-shape nominated victim node (-1 = none), or None
+        when the round dispatched without preemption. Call after
+        ``result()`` — the kernel has finished, so this materializes
+        without a wait (it rode the same async host copy)."""
+        p = self._preempt
+        if p is None:
+            return None
+        self._preempt = None
+        return np.asarray(p)[: self._u]
 
 
 class DeviceSchedulerState:
@@ -350,6 +379,8 @@ class DeviceSchedulerState:
         self._totals = None  # f32[C,R] device
         self._avail = None   # f32[C,R] device, donated through every round
         self._alive = None   # bool[C] device
+        self._ntypes = None  # int32[C] device node-type ids
+        self._thr = None     # f32[T,R] device per-type throughput factors
         self._synced_topo = -1
         self._seed = 0
         self._lock = threading.Lock()
@@ -361,6 +392,7 @@ class DeviceSchedulerState:
             "full_syncs": 0,
             "delta_pushes": 0,
             "delta_rows": 0,
+            "delta_rows_hwm": 0,
             "rounds": 0,
             "ring_rounds": 0,
             "prewarmed": 0,
@@ -395,6 +427,29 @@ class DeviceSchedulerState:
         self._totals = put(np.ascontiguousarray(view.totals), self.device)
         self._avail = put(np.ascontiguousarray(view.avail), self.device)
         self._alive = put(np.ascontiguousarray(view.alive), self.device)
+        # heterogeneity inputs ride the same full-sync (type registration
+        # bumps topo_version): node-type ids at node capacity, throughput
+        # factors bucket-padded on the type axis with all-ones rows (no
+        # node references a pad type, and the pad keeps the jit cache
+        # keyed on bucket sizes)
+        ntypes = getattr(view, "node_types", None)
+        if ntypes is None:
+            self._ntypes = put(
+                np.zeros(view.totals.shape[0], dtype=np.int32), self.device
+            )
+            self._thr = put(
+                np.ones((1, view.totals.shape[1]), dtype=np.float32),
+                self.device,
+            )
+        else:
+            self._ntypes = put(np.ascontiguousarray(ntypes), self.device)
+            t = len(view.type_names)
+            t_pad = _bucket(t, 1)
+            thr = np.ones(
+                (t_pad, view.totals.shape[1]), dtype=np.float32
+            )
+            thr[:t] = view.type_throughput[:t, : view.totals.shape[1]]
+            self._thr = put(thr, self.device)
         self._synced_topo = view.topo_version
         view.dirty_rows.clear()
         self.stats["full_syncs"] += 1
@@ -423,6 +478,12 @@ class DeviceSchedulerState:
         vals = view.avail[rows].copy()
         self.stats["delta_pushes"] += 1
         self.stats["delta_rows"] += int(rows.shape[0])
+        # high-water mark: the largest single delta push — a growing HWM
+        # (→ node count) means the delta protocol has degraded to
+        # full-matrix traffic and autoscaler/report churn needs a look
+        # (surfaced via head QueryState("sched"))
+        if int(rows.shape[0]) > self.stats["delta_rows_hwm"]:
+            self.stats["delta_rows_hwm"] = int(rows.shape[0])
         self._avail = self._scatter_push(self._avail, rows, vals)
 
     def invalidate(self) -> None:
@@ -455,6 +516,8 @@ class DeviceSchedulerState:
         spread_threshold: float = 0.5,
         ctx=None,
         shapes=None,
+        ages: Optional[np.ndarray] = None,
+        weights=None,
     ) -> PendingRound:
         """Dispatch a placement round without blocking on its readback.
 
@@ -470,7 +533,15 @@ class DeviceSchedulerState:
         caches dense rows per resource shape, so steady rounds skip the
         O(B·R) ``np.unique`` pass here entirely. ``demands`` may then be
         None.
+
+        ``ages``: optional f32[U] normalized wait-age per shape (rounds
+        parked / sched_starve_rounds). Uploading ages arms preemption
+        nomination (cfg.sched_preempt): ``PendingRound.preempt_rows()``
+        then yields the per-shape victim-node nominations. ``weights``:
+        hybrid.ScoreWeights override (default: the cfg knobs).
         """
+        from ray_tpu.config import cfg
+
         r = self._totals.shape[1]
         if shapes is not None:
             shape_demands, shape_ids = shapes
@@ -480,19 +551,27 @@ class DeviceSchedulerState:
             assert demands.shape[1] == r, (demands.shape, r)
             shape_demands, shape_ids = dedupe_shapes(demands)
         b = shape_ids.shape[0]
+        u = shape_demands.shape[0]
         assert shape_demands.shape[1] == r, (shape_demands.shape, r)
+        if weights is None:
+            weights = score_weights_from_cfg()
+        preempt = bool(cfg.sched_preempt) and ages is not None
 
-        u_pad = _bucket(shape_demands.shape[0] + 1, 2)
+        u_pad = _bucket(u + 1, 2)
         b_pad = _bucket(b)
         sd = np.full((u_pad, r), _BIG, dtype=np.float32)
-        sd[: shape_demands.shape[0]] = shape_demands
+        sd[:u] = shape_demands
         sids = np.full(b_pad, u_pad - 1, dtype=np.int32)  # padding → BIG shape
         sids[:b] = shape_ids
+        age_vec = np.zeros(u_pad, dtype=np.float32)
+        if ages is not None:
+            age_vec[:u] = ages
 
         put = self._jax.device_put
         t_up = time.perf_counter()
         sd_dev = put(sd, self.device)
         sids_dev = put(sids, self.device)
+        ages_dev = put(age_vec, self.device)
         SCHED_UPLOAD_MS.observe((time.perf_counter() - t_up) * 1e3)
         with self._lock:
             self._seed += 1
@@ -501,18 +580,31 @@ class DeviceSchedulerState:
                 self._totals,
                 self._avail,
                 self._alive,
+                self._ntypes,
+                self._thr,
                 sd_dev,
                 sids_dev,
+                ages_dev,
                 np.uint32(self._seed & 0xFFFFFFFF),
                 spread_threshold=spread_threshold,
+                weights=weights,
+                preempt=preempt,
             )
             self._avail = res.avail_out
         node = res.node
         try:
             node.copy_to_host_async()
+            if preempt:
+                res.preempt_node.copy_to_host_async()
         except AttributeError:  # pragma: no cover - older jax arrays
             pass
-        return PendingRound(node, b, ctx=ctx)
+        return PendingRound(
+            node,
+            b,
+            ctx=ctx,
+            preempt=res.preempt_node if preempt else None,
+            u=u,
+        )
 
     def schedule(self, demands: np.ndarray, spread_threshold: float = 0.5):
         """Synchronous round: dispatch + immediate readback (the
@@ -587,24 +679,41 @@ class DeviceSchedulerState:
         self._ring_dev = self._scatter_push(self._ring_dev, rows, vals)
 
     def ring_schedule(
-        self, counts_by_slot: Dict[int, int], spread_threshold: float = 0.5
+        self,
+        counts_by_slot: Dict[int, int],
+        spread_threshold: float = 0.5,
+        ages_by_slot: Optional[Dict[int, float]] = None,
+        weights=None,
     ):
         """Place parked demand straight from the resident ring.
 
         ``counts_by_slot``: pending request count per ring slot. Returns
-        (placed int64[S], per_node int32[S,N]) — the caller assigns its
-        FIFO-parked specs rank-by-rank across ``per_node`` and leaves the
-        remainder parked. Only the count vector (S int32) crosses the
-        host→device boundary; the shapes are already resident.
+        (placed int64[S], per_node int32[S,N], preempt int32[S]) — the
+        caller assigns its FIFO-parked specs rank-by-rank across
+        ``per_node`` and leaves the remainder parked; ``preempt`` carries
+        per-slot victim-node nominations (-1 = none) when
+        ``ages_by_slot`` was supplied and preemption is on. Only the
+        count (and age) vectors (S values) cross the host→device
+        boundary; the shapes are already resident.
         """
+        from ray_tpu.config import cfg
+
         t_up = time.perf_counter()
         counts = np.zeros(self.ring_slots, dtype=np.int32)
         for slot, c in counts_by_slot.items():
             counts[slot] = min(int(c), np.iinfo(np.int32).max)
+        ages = np.zeros(self.ring_slots, dtype=np.float32)
+        if ages_by_slot:
+            for slot, a in ages_by_slot.items():
+                ages[slot] = float(a)
+        if weights is None:
+            weights = score_weights_from_cfg()
+        preempt = bool(cfg.sched_preempt) and ages_by_slot is not None
         put = self._jax.device_put
         with self._lock:
             self._ring_flush_locked()
             counts_dev = put(counts, self.device)
+            ages_dev = put(ages, self.device)
             SCHED_UPLOAD_MS.observe((time.perf_counter() - t_up) * 1e3)
             self._seed += 1
             self.stats["ring_rounds"] += 1
@@ -613,16 +722,22 @@ class DeviceSchedulerState:
                 self._totals,
                 self._avail,
                 self._alive,
+                self._ntypes,
+                self._thr,
                 self._ring_dev,
                 counts_dev,
+                ages_dev,
                 np.uint32(self._seed & 0xFFFFFFFF),
                 spread_threshold=spread_threshold,
+                weights=weights,
+                preempt=preempt,
             )
             self._avail = res.avail_out
         placed = np.asarray(res.placed)
         per_node = np.asarray(res.per_node)
+        preempt_rows = np.asarray(res.preempt_node)
         SCHED_KERNEL_MS.observe((time.perf_counter() - t_k) * 1e3)
-        return placed, per_node
+        return placed, per_node, preempt_rows
 
     # -- unpark slot estimation ----------------------------------------
 
@@ -686,10 +801,27 @@ class DeviceSchedulerState:
                 # contending with real rounds' uploads after every
                 # topology change)
                 dev_av = put(avail, self.device)
+                # warm the exact variant real rounds dispatch: current
+                # weights, preemption armed iff the head will arm it,
+                # type axis at the CURRENT resident bucket (weights and
+                # preempt are static — another variant would compile a
+                # program no round ever runs)
+                weights = score_weights_from_cfg()
+                preempt_flag = bool(cfg.sched_preempt)
+                t_pad = (
+                    self._thr.shape[0] if self._thr is not None else 1
+                )
+                dev_nt = put(np.zeros(n_cap, dtype=np.int32), self.device)
+                dev_thr = put(
+                    np.ones((t_pad, r), dtype=np.float32), self.device
+                )
                 for u_pad in (2, 4, 8, 16):
                     sd = np.full((u_pad, r), _BIG, dtype=np.float32)
                     sd[0, 0] = 1.0
                     sd_dev = put(sd, self.device)
+                    ages_dev = put(
+                        np.zeros(u_pad, dtype=np.float32), self.device
+                    )
                     for b_pad in b_sizes:
                         if _shutting_down:
                             return
@@ -698,10 +830,15 @@ class DeviceSchedulerState:
                             dev_t,
                             dev_av,
                             dev_al,
+                            dev_nt,
+                            dev_thr,
                             sd_dev,
                             put(sids, self.device),
+                            ages_dev,
                             np.uint32(1),
                             spread_threshold=spread_threshold,
+                            weights=weights,
+                            preempt=preempt_flag,
                         )
                         res.node.block_until_ready()
                         self.stats["prewarmed"] += 1

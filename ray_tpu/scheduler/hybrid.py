@@ -26,6 +26,40 @@ Scoring semantics (hybrid_scheduling_policy.cc:45-52 +
 cluster_resource_data.cc:62-77): score(node) = max over {CPU, MEM,
 OBJECT_STORE_MEM} of ``1 - available/total`` (skipping zero totals), zeroed
 when below ``spread_threshold``; lower is better.
+
+Multi-objective scoring (ISSUE 7 / ROADMAP 1): the production waterfall
+kernel (``hybrid_schedule_shapes_multi_impl``) scores each (shape, node)
+pair with a weighted sum of FOUR terms instead of the single utilization
+scalar — see ``ScoreWeights``:
+
+- **util** — the reference-compatible spread-threshold-zeroed critical
+  utilization above, quantized to 1/16 steps (``quantize_score``).
+- **het** — heterogeneity (Gavel, arxiv 2008.09213): a per-(shape,
+  node-type) effective-throughput penalty derived from the resident
+  per-type per-resource throughput factors (``ClusterView.type_throughput``)
+  — 0 on the best type for the shape, →1 on types that run it slowest.
+- **frag** — fragmentation (arxiv 2512.10980): the post-placement
+  stranded-capacity estimate — the fraction of the node's capacity that
+  placing this request would leave free but unable to host the round's
+  REFERENCE (largest) demand shape. Penalizes exactly the placement that
+  flips a large-capable node into a stranded one, so small requests pack
+  instead of spraying.
+- **starve** — fairness: per-shape wait-age (rounds parked, normalized by
+  ``sched_starve_rounds``) uploaded with the demand rows discounts the
+  soft het/frag penalties of long-waiting shapes (``1/(1+w·age)``), so a
+  starving shape takes ANY available node rather than holding out for a
+  "good" one. Ages ≥ 1.0 additionally arm preemption nomination.
+
+``weights=(1,0,0,0)`` (the default) short-circuits every extra term at
+trace time and reproduces the single-objective kernel bit-for-bit — PR
+6's sync/pipelined divergence checks keep pinning equivalence.
+
+Preemption nomination: a starving shape (age ≥ 1.0) with unmet demand
+and zero current capacity nominates, per shape, the feasible-by-totals
+node with the lowest utilization cost (``ShapesResult.preempt_node``);
+the head maps the node to concrete victim leases and kill-and-requeues
+through the PR 5 lineage/fate-sharing machinery (cluster/head.py
+``_maybe_preempt``).
 """
 from __future__ import annotations
 
@@ -41,6 +75,11 @@ from .resources import CRITICAL_COLUMNS, GPU, TPU
 # Comparison tolerance for float32 resource arithmetic. Quantities are
 # quantized at 1e-4 (FP_SCALE) host-side; this absorbs f32 rounding only.
 _EPS = 1e-5
+
+# Padding demand magnitude (device.py _BIG): pad rows carry this in every
+# column so they never place; kernels detect them to mask pads out of the
+# fragmentation reference shape.
+_BIG_PAD = 1e18
 
 ACCEL_COLUMNS = (GPU, TPU)
 
@@ -61,6 +100,38 @@ class BatchResult(NamedTuple):
     avail_out: jax.Array  # float32[N,R] availability after grants
 
 
+class ScoreWeights(NamedTuple):
+    """Multi-objective scoring weights (cfg sched_w_util/het/frag/starve).
+
+    Static under jit (a weight change recompiles, which is the rare
+    config-edit path, not the round path); ``(1, 0, 0, 0)`` recovers the
+    single-objective kernel exactly — the extra terms are skipped at
+    trace time, not multiplied by zero."""
+
+    util: float = 1.0
+    het: float = 0.0
+    frag: float = 0.0
+    starve: float = 0.0
+
+
+#: One-sided quantum of the waterfall kernels' utilization score: the ONE
+#: definition of the shapes/ring-path tie-break. Scores are floored to
+#: 1/QUANTIZE_STEPS buckets and a per-node uniform jitter in [0, 1) picks
+#: uniformly inside a bucket — near-tied nodes (score gap < 1/16) look
+#: identical, mirroring the per-task path's uniform pick among the top-k.
+#: The per-task kernel (``_pick_topk``) instead sorts EXACT scores and
+#: randomizes among the first k — an intentional divergence documented in
+#: COMPONENTS.md (scheduling plane): the waterfall has no per-request k.
+QUANTIZE_STEPS = 16.0
+
+
+def quantize_score(score: jax.Array) -> jax.Array:
+    """Bucketized utilization score shared by the shapes path, the ring
+    path, and the multi-objective cost (keeps all waterfall consumers
+    tie-breaking identically)."""
+    return jnp.floor(score * QUANTIZE_STEPS)
+
+
 def _critical_score(totals: jax.Array, avail: jax.Array, threshold: float) -> jax.Array:
     """float32[N] spread-threshold-zeroed critical resource utilization."""
     t = totals[:, CRITICAL_COLUMNS,]
@@ -76,12 +147,15 @@ def _shape_capacity(
     alive: jax.Array,      # bool[N]
     d: jax.Array,          # f32[R] one demand shape
 ) -> tuple:
-    """(cap f32[N], has_demand bool[]): how many requests of shape ``d``
-    each node can absorb right now (inf for a zero-demand shape on a
-    feasible node; 0 on dead/infeasible nodes). The ONE definition of
-    per-node shape capacity — the round kernel, the parked-ring kernel,
-    and the unpark slot estimator must deduct/estimate with identical
-    math or the host mirror's convergence accounting drifts."""
+    """(cap f32[N], has_demand bool[], feas bool[N]): how many requests of
+    shape ``d`` each node can absorb right now (inf for a zero-demand
+    shape on a feasible node; 0 on dead/infeasible nodes), plus the
+    totals-feasibility mask (preemption nomination needs nodes that COULD
+    host the shape if their current usage were reclaimed). The ONE
+    definition of per-node shape capacity — the round kernel, the
+    parked-ring kernel, and the unpark slot estimator must
+    deduct/estimate with identical math or the host mirror's convergence
+    accounting drifts."""
     feas = alive & jnp.all(totals >= d[None, :] - _EPS, axis=1)
     demanded = d > 0
     ratio = jnp.where(
@@ -93,7 +167,53 @@ def _shape_capacity(
     has_demand = jnp.any(demanded)
     cap = jnp.where(has_demand, cap, jnp.inf)  # zero-demand: no cap
     cap = jnp.where(feas, jnp.maximum(cap, 0.0), 0.0)
-    return cap, has_demand
+    return cap, has_demand, feas
+
+
+def _het_penalty(
+    d: jax.Array,       # f32[R] one demand shape
+    ntypes: jax.Array,  # int32[N] node-type id per node
+    thr: jax.Array,     # f32[T,R] per-type per-resource throughput factors
+) -> jax.Array:
+    """f32[N] heterogeneity penalty in [0, 1]: 1 - (this node type's
+    effective throughput for the shape) / (the best type's). The
+    per-(shape, node-type) throughput matrix of Gavel (arxiv 2008.09213),
+    stored in its resident factorized form: ``thr[t, c]`` = relative
+    throughput of resource column ``c`` on node type ``t``
+    (resources.py ClusterView.type_throughput). A shape's effective
+    throughput on a type is its demand-weighted mean factor."""
+    dsum = jnp.maximum(jnp.sum(d), _EPS)
+    tput = thr @ d / dsum                    # f32[T]
+    best = jnp.maximum(jnp.max(tput), _EPS)
+    pen_t = 1.0 - tput / best                # f32[T], 0 on the best type
+    return pen_t[ntypes]
+
+
+def _frag_penalty(
+    totals: jax.Array,     # f32[N,R]
+    avail_run: jax.Array,  # f32[N,R]
+    d: jax.Array,          # f32[R] the shape being placed
+    ref: jax.Array,        # f32[R] the round's reference (largest) shape
+) -> jax.Array:
+    """f32[N] post-placement stranded-capacity estimate in [0, 1]
+    (arxiv 2512.10980): the fraction of a node's capacity (over the
+    reference shape's demanded columns) that placing one ``d`` would
+    leave free but unable to host the reference shape. Nodes that
+    already cannot host ``ref`` strand only their (small) remaining free
+    fraction; a placement that FLIPS a large-capable node to stranded
+    pays its whole free fraction — so small shapes fill already-broken
+    nodes before breaking whole ones."""
+    after = avail_run - d[None, :]
+    ref_cols = ref > 0
+    fits_ref = jnp.all(
+        jnp.where(ref_cols[None, :], after >= ref[None, :] - _EPS, True),
+        axis=1,
+    )
+    free = jnp.sum(jnp.where(ref_cols[None, :], jnp.maximum(after, 0.0), 0.0), axis=1)
+    total = jnp.maximum(
+        jnp.sum(jnp.where(ref_cols[None, :], totals, 0.0), axis=1), _EPS
+    )
+    return jnp.where(fits_ref, 0.0, free / total)
 
 
 def _fits(view: jax.Array, demand: jax.Array) -> jax.Array:
@@ -317,16 +437,93 @@ def hybrid_schedule_rounds_chunked(
     return RoundsResult(nodes.reshape(-1), avail_out)
 
 
-def hybrid_schedule_shapes_impl(
+class ShapesResult(NamedTuple):
+    node: jax.Array          # int32[B], -1 = unplaced
+    avail_out: jax.Array     # f32[N,R]
+    # int32[U] per-shape preemption nomination: the feasible-by-totals
+    # node with the lowest utilization cost, for starving (age >= 1.0)
+    # shapes with unmet demand and zero current capacity; -1 = none.
+    preempt_node: jax.Array
+
+
+def _shape_cost(
+    totals: jax.Array,
+    avail_run: jax.Array,
+    d: jax.Array,
+    cap: jax.Array,
+    score: jax.Array,
+    jitter: jax.Array,
+    age: jax.Array,
+    ntypes: jax.Array,
+    thr: jax.Array,
+    ref: jax.Array,
+    weights: ScoreWeights,
+) -> jax.Array:
+    """f32[N] multi-objective placement cost for one shape (lower is
+    better; inf on nodes with no capacity). The ONE cost definition
+    shared by the shapes waterfall and the parked-ring kernel. Weight
+    terms are skipped at TRACE time when their weight is 0, so
+    weights=(1,0,0,0) emits exactly the single-objective program."""
+    cost = quantize_score(score)
+    if weights.util != 1.0:
+        cost = weights.util * cost
+    if weights.het or weights.frag:
+        # starving shapes discount the soft terms: a shape that has
+        # waited w_starve-scaled ages takes ANY available node
+        scale = 1.0 / (1.0 + weights.starve * age) if weights.starve else 1.0
+        if weights.het:
+            cost = cost + (QUANTIZE_STEPS * weights.het * scale) * _het_penalty(
+                d, ntypes, thr
+            )
+        if weights.frag:
+            cost = cost + (QUANTIZE_STEPS * weights.frag * scale) * _frag_penalty(
+                totals, avail_run, d, ref
+            )
+    cost = cost + jitter
+    return jnp.where(cap > 0, cost, jnp.inf)
+
+
+def _nominate_preemption(
+    feas: jax.Array,
+    cap: jax.Array,
+    score: jax.Array,
+    jitter: jax.Array,
+    age: jax.Array,
+    unmet: jax.Array,
+) -> jax.Array:
+    """int32 nominated victim node for one shape (-1 = none): starving
+    (age >= 1.0) + unmet demand + zero capacity anywhere → the
+    feasible-by-totals node with the lowest exact utilization score
+    (lowest-cost reclaim; jitter decorrelates ties across shapes)."""
+    cand = feas & (cap <= 0)
+    pscore = jnp.where(cand, score + jitter, jnp.inf)
+    pn = jnp.argmin(pscore).astype(jnp.int32)
+    ok = (age >= 1.0) & unmet & jnp.any(cand)
+    return jnp.where(ok, pn, jnp.int32(-1))
+
+
+def _reference_shape(shape_rows: jax.Array, real: jax.Array) -> jax.Array:
+    """f32[R] per-column envelope of the round's REAL demand shapes — the
+    'largest demand' the fragmentation term protects capacity for.
+    ``real`` masks padding rows (_BIG demands / empty ring slots)."""
+    return jnp.max(jnp.where(real[:, None], shape_rows, 0.0), axis=0)
+
+
+def hybrid_schedule_shapes_multi_impl(
     totals: jax.Array,        # f32[N,R]
     avail: jax.Array,         # f32[N,R]
     alive: jax.Array,         # bool[N]
+    ntypes: jax.Array,        # int32[N] node-type id per node
+    thr: jax.Array,           # f32[T,R] per-type resource throughput factors
     shape_demands: jax.Array,  # f32[U,R] unique demand shapes, priority order
     shape_ids: jax.Array,     # int32[B] shape index per request
+    ages: jax.Array,          # f32[U] normalized wait-age per shape
     seed: jax.Array,
     *,
     spread_threshold: float = 0.5,
-) -> RoundsResult:
+    weights: ScoreWeights = ScoreWeights(),
+    preempt: bool = False,
+) -> ShapesResult:
     """Shape-grouped waterfall placement — the fastest scheduling kernel.
 
     The reference queues leases per *scheduling class* (shape) and schedules
@@ -336,7 +533,9 @@ def hybrid_schedule_shapes_impl(
       for each shape u (sequential scan, hardest shapes first):
         capacity[n] = how many u-requests node n can still absorb (exact,
                       elementwise floor(avail/demand))
-        order nodes by (spread-threshold score, jitter)   # top-k-ish spread
+        order nodes by the multi-objective cost (``_shape_cost``:
+        quantized utilization + heterogeneity + fragmentation, starvation-
+        discounted, + jitter)                             # top-k-ish spread
         request with rank r inside the shape  →  first node whose cumulative
         capacity exceeds r (vectorized searchsorted)
         deduct per-node counts with one segment_sum
@@ -344,12 +543,20 @@ def hybrid_schedule_shapes_impl(
     O(U·(N log N + B log N)) with no [B,N] intermediate — places 100k
     requests on 1k nodes in ~1 ms on one TPU chip. Conflict-free and
     capacity-exact by construction; semantics match greedy filling of
-    best-scored nodes within each shape class.
+    best-scored nodes within each shape class. With ``preempt`` the scan
+    additionally nominates one victim node per starving unmet shape
+    (``ShapesResult.preempt_node``) — placements are unaffected.
     """
     n = totals.shape[0]
     b = shape_ids.shape[0]
     u = shape_demands.shape[0]
     base_key = jax.random.PRNGKey(seed)
+
+    if weights.frag:
+        real = jnp.all(shape_demands < _BIG_PAD * 0.5, axis=1)
+        ref = _reference_shape(shape_demands, real)
+    else:
+        ref = jnp.zeros((shape_demands.shape[1],), dtype=jnp.float32)
 
     # rank of each request within its shape class
     order = jnp.argsort(shape_ids, stable=True)
@@ -363,14 +570,16 @@ def hybrid_schedule_shapes_impl(
 
     def per_shape(avail_run, uidx):
         d = shape_demands[uidx]
-        cap, has_demand = _shape_capacity(totals, avail_run, alive, d)
+        cap, has_demand, feas = _shape_capacity(totals, avail_run, alive, d)
         score = _critical_score(totals, avail_run, spread_threshold)
         key = jax.random.fold_in(base_key, uidx)
         # quantized score + random jitter == uniform pick among near-tied
         # nodes (the reference's top-k randomization)
         jitter = jax.random.uniform(key, (n,), dtype=jnp.float32)
-        cost = jnp.floor(score * 16.0) + jitter
-        cost = jnp.where(cap > 0, cost, jnp.inf)
+        cost = _shape_cost(
+            totals, avail_run, d, cap, score, jitter,
+            ages[uidx], ntypes, thr, ref, weights,
+        )
         # top-k beats a full argsort ~3x on CPU XLA and is exact here: a
         # request at rank r within its shape needs at most r+1 nodes of
         # the cost order, ranks are < b <= k, and every cap>0 node sorts
@@ -393,64 +602,120 @@ def hybrid_schedule_shapes_impl(
         avail_run = jnp.where(
             has_demand, avail_run - counts[:, None] * d[None, :], avail_run
         )
-        return avail_run, node_u
+        if preempt:
+            unmet = jnp.sum(sel) > jnp.sum(valid)
+            pre_u = _nominate_preemption(
+                feas, cap, score, jitter, ages[uidx], unmet
+            )
+        else:
+            pre_u = jnp.int32(-1)
+        return avail_run, (node_u, pre_u)
 
-    avail_out, nodes_per_shape = jax.lax.scan(
+    avail_out, (nodes_per_shape, preempt_nodes) = jax.lax.scan(
         per_shape, avail, jnp.arange(u, dtype=jnp.int32)
     )
     nodes_sorted = jnp.max(nodes_per_shape, axis=0)  # exactly one shape wrote >=0
     nodes = jnp.full((b,), -1, dtype=jnp.int32).at[order].set(
         nodes_sorted.astype(jnp.int32)
     )
-    return RoundsResult(nodes, avail_out)
+    return ShapesResult(nodes, avail_out, preempt_nodes)
 
 
-# Public jitted entry point; DeviceSchedulerState re-jits the impl with a
-# donated avail buffer to keep scheduler state resident across rounds.
+def hybrid_schedule_shapes_impl(
+    totals: jax.Array,        # f32[N,R]
+    avail: jax.Array,         # f32[N,R]
+    alive: jax.Array,         # bool[N]
+    shape_demands: jax.Array,  # f32[U,R] unique demand shapes, priority order
+    shape_ids: jax.Array,     # int32[B] shape index per request
+    seed: jax.Array,
+    *,
+    spread_threshold: float = 0.5,
+) -> RoundsResult:
+    """Single-objective waterfall (the pre-ISSUE-7 signature): the multi
+    kernel at weights=(1,0,0,0) with homogeneous node types — emits the
+    identical XLA program (extra terms skip at trace time)."""
+    res = hybrid_schedule_shapes_multi_impl(
+        totals,
+        avail,
+        alive,
+        jnp.zeros((totals.shape[0],), dtype=jnp.int32),
+        jnp.ones((1, totals.shape[1]), dtype=jnp.float32),
+        shape_demands,
+        shape_ids,
+        jnp.zeros((shape_demands.shape[0],), dtype=jnp.float32),
+        seed,
+        spread_threshold=spread_threshold,
+    )
+    return RoundsResult(res.node, res.avail_out)
+
+
+# Public jitted entry points; DeviceSchedulerState jits the multi impl to
+# keep scheduler state (including node types + throughput factors)
+# resident across rounds.
 hybrid_schedule_shapes = functools.partial(
     jax.jit, static_argnames=("spread_threshold",)
 )(hybrid_schedule_shapes_impl)
+
+hybrid_schedule_shapes_multi = functools.partial(
+    jax.jit, static_argnames=("spread_threshold", "weights", "preempt")
+)(hybrid_schedule_shapes_multi_impl)
 
 
 class RingResult(NamedTuple):
     placed: jax.Array    # int32[S] requests placed per ring slot
     per_node: jax.Array  # int32[S,N] placements per node per slot
     avail_out: jax.Array  # f32[N,R]
+    preempt_node: jax.Array  # int32[S] nominated victim node per slot, -1=none
 
 
 def ring_schedule_impl(
     totals: jax.Array,       # f32[N,R]
     avail: jax.Array,        # f32[N,R]
     alive: jax.Array,        # bool[N]
+    ntypes: jax.Array,       # int32[N] node-type id per node
+    thr: jax.Array,          # f32[T,R] per-type resource throughput factors
     ring_shapes: jax.Array,  # f32[S,R] parked demand shapes (device-resident)
     counts: jax.Array,       # int32[S] pending requests per shape
+    ages: jax.Array,         # f32[S] normalized wait-age per ring slot
     seed: jax.Array,
     *,
     spread_threshold: float = 0.5,
+    weights: ScoreWeights = ScoreWeights(),
+    preempt: bool = False,
 ) -> RingResult:
     """Count-driven waterfall over the parked-demand ring.
 
-    Same placement math as ``hybrid_schedule_shapes_impl`` (per-shape node
-    capacity, score+jitter node ordering, cumulative-capacity fill), but
-    demand arrives as (resident shape row, count) pairs instead of
-    per-request rows — repeatedly-unplaceable shapes retry without
-    re-uploading a demand matrix or shape-id vector, and the readback is
-    per-node placement COUNTS (the caller assigns its FIFO-parked specs to
-    nodes rank-by-rank), not per-request rows.
+    Same placement math as ``hybrid_schedule_shapes_multi_impl`` (per-shape
+    node capacity, the shared multi-objective ``_shape_cost`` node
+    ordering, cumulative-capacity fill), but demand arrives as (resident
+    shape row, count) pairs instead of per-request rows —
+    repeatedly-unplaceable shapes retry without re-uploading a demand
+    matrix or shape-id vector, and the readback is per-node placement
+    COUNTS (the caller assigns its FIFO-parked specs to nodes
+    rank-by-rank), not per-request rows. Parked shapes are where
+    starvation lives, so the ring nominates preemption victims exactly
+    like the round kernel.
     """
     n = totals.shape[0]
     s = ring_shapes.shape[0]
     base_key = jax.random.PRNGKey(seed)
 
+    if weights.frag:
+        ref = _reference_shape(ring_shapes, counts > 0)
+    else:
+        ref = jnp.zeros((ring_shapes.shape[1],), dtype=jnp.float32)
+
     def per_shape(avail_run, uidx):
         d = ring_shapes[uidx]
         want = counts[uidx].astype(jnp.float32)
-        cap, has_demand = _shape_capacity(totals, avail_run, alive, d)
+        cap, has_demand, feas = _shape_capacity(totals, avail_run, alive, d)
         score = _critical_score(totals, avail_run, spread_threshold)
         key = jax.random.fold_in(base_key, uidx)
         jitter = jax.random.uniform(key, (n,), dtype=jnp.float32)
-        cost = jnp.floor(score * 16.0) + jitter
-        cost = jnp.where(cap > 0, cost, jnp.inf)
+        cost = _shape_cost(
+            totals, avail_run, d, cap, score, jitter,
+            ages[uidx], ntypes, thr, ref, weights,
+        )
         node_order = jnp.argsort(cost)
         cap_sorted = cap[node_order]
         # zero-demand shapes have infinite per-node capacity: the first
@@ -465,12 +730,20 @@ def ring_schedule_impl(
             has_demand, avail_run - per_node[:, None] * d[None, :], avail_run
         )
         placed = jnp.sum(take_sorted)
-        return avail_run, (placed.astype(jnp.int32), per_node.astype(jnp.int32))
+        if preempt:
+            pre_u = _nominate_preemption(
+                feas, cap, score, jitter, ages[uidx], placed < want
+            )
+        else:
+            pre_u = jnp.int32(-1)
+        return avail_run, (
+            placed.astype(jnp.int32), per_node.astype(jnp.int32), pre_u
+        )
 
-    avail_out, (placed, per_node) = jax.lax.scan(
+    avail_out, (placed, per_node, preempt_nodes) = jax.lax.scan(
         per_shape, avail, jnp.arange(s, dtype=jnp.int32)
     )
-    return RingResult(placed, per_node, avail_out)
+    return RingResult(placed, per_node, avail_out, preempt_nodes)
 
 
 def shape_slots_impl(
@@ -487,7 +760,7 @@ def shape_slots_impl(
     the intermediate at [N,R] per shape (no [S,N,R] blow-up at 10k nodes)."""
 
     def one(d):
-        slots, _ = _shape_capacity(totals, avail, alive, d)
+        slots, _, _ = _shape_capacity(totals, avail, alive, d)
         # zero-demand shapes report "huge", clamped to int32-safe
         return jnp.minimum(jnp.sum(slots), 2.0**31 - 1).astype(jnp.int32)
 

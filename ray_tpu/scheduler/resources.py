@@ -285,6 +285,10 @@ class ClusterView:
     device arrays; this class owns the host mirrors and the node-id interning.
     """
 
+    #: label key the head's node registration carries a node type under
+    #: (autoscaler.NODE_TYPE_LABEL) — ``add_node`` interns it automatically
+    NODE_TYPE_LABEL = "ray_tpu.io/node-type"
+
     def __init__(self, vocab: ResourceVocab, capacity_nodes: int = 8):
         self.vocab = vocab
         self.capacity_nodes = capacity_nodes
@@ -294,6 +298,19 @@ class ClusterView:
         self.avail = np.zeros((capacity_nodes, vocab.capacity), dtype=np.float32)
         self.alive = np.zeros(capacity_nodes, dtype=bool)
         self.labels: List[Dict[str, str]] = [dict() for _ in range(capacity_nodes)]
+        # --- heterogeneity (Gavel-style throughput matrix, factorized) ---
+        # node_types[row] = interned node-type id; type_throughput[t, c] =
+        # relative throughput of resource column c on type t (1.0 =
+        # baseline). The kernels derive a per-(shape, node-type) effective
+        # throughput from these (hybrid._het_penalty) — the resident
+        # encoding of Gavel's throughput matrix for an open-ended shape
+        # universe. Type 0 ("default") is the all-ones baseline.
+        self.node_types = np.zeros(capacity_nodes, dtype=np.int32)
+        self.type_names: List[str] = ["default"]
+        self._type_to_id: Dict[str, int] = {"default": 0}
+        self.type_throughput = np.ones(
+            (4, vocab.capacity), dtype=np.float32
+        )
         # Device-mirror bookkeeping (DeviceSchedulerState): topo_version bumps
         # on any change that needs a full re-upload (membership, array
         # reshapes, totals edits); dirty_rows are availability rows whose
@@ -324,14 +341,58 @@ class ClusterView:
                 setattr(self, attr, new)
             self.alive = np.resize(self.alive, new_n)
             self.alive[n_cap:] = False
+            self.node_types = np.resize(self.node_types, new_n)
+            self.node_types[n_cap:] = 0
+            if new_r != r_cap:
+                thr = np.ones(
+                    (self.type_throughput.shape[0], new_r), dtype=np.float32
+                )
+                thr[:, :r_cap] = self.type_throughput
+                self.type_throughput = thr
             self.labels.extend(dict() for _ in range(new_n - n_cap))
             self.capacity_nodes = new_n
+
+    def register_node_type(
+        self,
+        name: str,
+        throughput: Optional[Mapping[str, float]] = None,
+    ) -> int:
+        """Intern a node type and (optionally) its per-resource relative
+        throughput factors ({resource name: factor}, 1.0 = baseline,
+        unnamed columns default to 1.0). Re-registering updates the
+        factors. Any change bumps ``topo_version`` — the resident
+        throughput matrix full-syncs with the next round."""
+        tid = self._type_to_id.get(name)
+        if tid is None:
+            tid = len(self.type_names)
+            self.type_names.append(name)
+            self._type_to_id[name] = tid
+            if tid >= self.type_throughput.shape[0]:
+                thr = np.ones(
+                    (self.type_throughput.shape[0] * 2,
+                     self.type_throughput.shape[1]),
+                    dtype=np.float32,
+                )
+                thr[: self.type_throughput.shape[0]] = self.type_throughput
+                self.type_throughput = thr
+        if throughput:
+            cols = {self.vocab.intern(n): float(v) for n, v in throughput.items()}
+            if self.vocab.capacity > self.type_throughput.shape[1]:
+                self._grow(max(self.num_nodes, 1), self.vocab.capacity)
+            row = np.ones(self.type_throughput.shape[1], dtype=np.float32)
+            for col, factor in cols.items():
+                row[col] = factor
+            self.type_throughput[tid] = row
+        self.topo_version += 1
+        self.change_counter += 1
+        return tid
 
     def add_node(
         self,
         node_id: str,
         total: Mapping[str, float],
         labels: Optional[Mapping[str, str]] = None,
+        node_type: Optional[str] = None,
     ) -> int:
         for name, v in total.items():
             if float(v) > MAX_EXACT_VIEW_TOTAL:
@@ -349,6 +410,11 @@ class ClusterView:
         self.avail[row, : len(row_total)] = row_total
         self.alive[row] = True
         self.labels[row] = dict(labels or {})
+        if node_type is None and labels:
+            node_type = labels.get(self.NODE_TYPE_LABEL)
+        self.node_types[row] = (
+            self.register_node_type(node_type) if node_type else 0
+        )
         self.topo_version += 1
         self.change_counter += 1
         return row
@@ -408,3 +474,15 @@ class ClusterView:
         """(totals, avail, alive) trimmed to the populated node rows."""
         n = self.num_nodes
         return self.totals[:n], self.avail[:n], self.alive[:n]
+
+    def active_type_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(node_types int32[N], type_throughput f32[T,R]) trimmed to the
+        populated node rows / registered types — the heterogeneity inputs
+        the device mirror keeps resident (full-synced on topo_version
+        moves, which every type registration bumps)."""
+        n = self.num_nodes
+        t = len(self.type_names)
+        return (
+            self.node_types[:n],
+            self.type_throughput[:t, : self.totals.shape[1]],
+        )

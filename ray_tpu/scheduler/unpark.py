@@ -73,6 +73,7 @@ def select_unparkable(
     slack: int = UNPARK_SLACK,
     reserved: Any = None,
     slots_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    age_of: Optional[Callable[[Any], int]] = None,
 ) -> Tuple[List[Any], List[Any]]:
     """(take, keep): specs to re-queue now vs. keep parked.
 
@@ -87,7 +88,11 @@ def select_unparkable(
     ``slots_fn``: batched slot estimator f32[S,R] → int[S] (the
     device-resident path); when given, ``avail``/``alive`` are only used
     for the resource-axis width and may be the live views (no copy
-    needed — they are never scanned host-side)."""
+    needed — they are never scanned host-side).
+    ``age_of``: optional shape-key → wait-age lookup (head._shape_wait);
+    shapes unpark in age-descending order so a STARVING shape claims the
+    grantable slots before younger shapes re-consume the freed capacity
+    (the unpark half of the starvation/fairness term)."""
     if len(parked) <= slack:
         return list(parked), []
     r = avail.shape[1] if avail is not None and avail.ndim == 2 else 0
@@ -136,6 +141,9 @@ def select_unparkable(
             slots = np.where(alive, np.maximum(slots, 0.0), 0.0)
             slot_counts[k] = int(slots.sum())
 
+    if age_of is not None:
+        # starving shapes first (stable: equal ages keep arrival order)
+        order.sort(key=lambda k: -(age_of(k) if k is not None else 0))
     take: List[Any] = []
     keep: List[Any] = []
     for key in order:

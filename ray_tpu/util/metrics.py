@@ -54,6 +54,14 @@ class _Metric:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def values_by_label(self) -> Dict[str, float]:
+        """Every label set's current value, keyed by the joined label
+        values (e.g. ``{"queued": 3.0, "running": 1.0}`` for a
+        single-label counter) — the per-dimension readout debug surfaces
+        like head QueryState embed without parsing exposition text."""
+        with self._lock:
+            return {",".join(k): v for k, v in self._values.items()}
+
 
 class Counter(_Metric):
     kind = "counter"

@@ -1,0 +1,322 @@
+"""Multi-objective scheduling kernels (ISSUE 7).
+
+Pins the tentpole's kernel-level contracts:
+  - weights=(1,0,0,0) reproduces the single-objective waterfall exactly
+    (placements AND rng consumption), with or without preemption armed;
+  - the heterogeneity term steers shapes onto their best-throughput node
+    type (Gavel-style factors registered on the ClusterView);
+  - the fragmentation term steers small shapes away from breaking
+    large-capable nodes (stranded-capacity estimate);
+  - the starvation discount lets an aged shape ignore the soft terms;
+  - starving shapes with unmet demand nominate preemption victim nodes
+    (round kernel and ring kernel);
+  - the autoscaler's projected-gradient solve packs validly (never
+    over-commits), matches the first-fit oracle on uniform demand, and
+    falls back to it on solver failure.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ray_tpu.scheduler.hybrid import (
+    ScoreWeights,
+    dedupe_shapes,
+    hybrid_schedule_shapes_multi_impl,
+    ring_schedule_impl,
+)
+from ray_tpu.scheduler.resources import ClusterView, ResourceVocab
+
+
+def _mk(totals_rows):
+    totals = np.asarray(totals_rows, dtype=np.float32)
+    avail = totals.copy()
+    alive = np.ones(totals.shape[0], dtype=bool)
+    return totals, avail, alive
+
+
+def _run_multi(
+    totals, avail, alive, demands,
+    *, weights=ScoreWeights(), ntypes=None, thr=None, ages=None,
+    preempt=False, seed=0,
+):
+    shapes, sids = dedupe_shapes(np.asarray(demands, dtype=np.float32))
+    n, r = totals.shape
+    if ntypes is None:
+        ntypes = np.zeros(n, dtype=np.int32)
+    if thr is None:
+        thr = np.ones((1, r), dtype=np.float32)
+    if ages is None:
+        ages = np.zeros(shapes.shape[0], dtype=np.float32)
+    return hybrid_schedule_shapes_multi_impl(
+        jnp.asarray(totals), jnp.asarray(avail), jnp.asarray(alive),
+        jnp.asarray(ntypes), jnp.asarray(thr),
+        jnp.asarray(shapes), jnp.asarray(sids),
+        jnp.asarray(ages, dtype=jnp.float32),
+        np.uint32(seed),
+        weights=weights, preempt=preempt,
+    )
+
+
+# ---------------------------------------------------------------------------
+# single-objective equivalence at weights=(1,0,0,0)
+# ---------------------------------------------------------------------------
+
+
+def test_default_weights_match_single_objective_exactly():
+    rng = np.random.default_rng(0)
+    totals, avail, alive = _mk(rng.uniform(4, 16, (12, 6)))
+    demands = rng.uniform(0.25, 2.0, (40, 6)).astype(np.float32)
+    base = _run_multi(totals, avail, alive, demands, seed=7)
+    armed = _run_multi(
+        totals, avail, alive, demands, seed=7,
+        ages=None, preempt=True,
+    )
+    zeroed = _run_multi(
+        totals, avail, alive, demands, seed=7,
+        weights=ScoreWeights(1.0, 0.0, 0.0, 0.0),
+    )
+    np.testing.assert_array_equal(np.asarray(base.node), np.asarray(armed.node))
+    np.testing.assert_array_equal(np.asarray(base.node), np.asarray(zeroed.node))
+    np.testing.assert_allclose(
+        np.asarray(base.avail_out), np.asarray(armed.avail_out)
+    )
+    # unaged shapes never nominate
+    assert (np.asarray(armed.preempt_node) == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# heterogeneity term
+# ---------------------------------------------------------------------------
+
+
+def test_het_term_prefers_high_throughput_type():
+    # 8 nodes, alternating types; type 1 runs CPU work 2x faster
+    totals, avail, alive = _mk([[8.0, 8.0]] * 8)
+    ntypes = np.asarray([0, 1] * 4, dtype=np.int32)
+    thr = np.asarray([[1.0, 1.0], [2.0, 1.0]], dtype=np.float32)
+    demands = np.tile(np.asarray([[1.0, 0.0]], dtype=np.float32), (8, 1))
+    res = _run_multi(
+        totals, avail, alive, demands,
+        weights=ScoreWeights(1.0, 1.0, 0.0, 0.0), ntypes=ntypes, thr=thr,
+    )
+    nodes = np.asarray(res.node)
+    assert (nodes >= 0).all()
+    # type-1 nodes have capacity for all 8 requests (4 nodes x 8 CPU):
+    # every placement must land on the fast type
+    assert set(ntypes[nodes]) == {1}
+
+
+# ---------------------------------------------------------------------------
+# fragmentation term
+# ---------------------------------------------------------------------------
+
+
+def test_frag_term_protects_large_capable_node():
+    # A: filled by the round's large shape; B: whole 16-CPU node;
+    # C: small remnant. The small request must break C, not B.
+    totals = np.asarray(
+        [[16.0, 16.0], [16.0, 16.0], [4.0, 4.0]], dtype=np.float32
+    )
+    avail = np.asarray(
+        [[16.0, 16.0], [16.0, 16.0], [2.0, 2.0]], dtype=np.float32
+    )
+    alive = np.ones(3, dtype=bool)
+    demands = np.asarray(
+        [[16.0, 8.0], [1.0, 0.0]], dtype=np.float32
+    )  # one large + one small request
+    res_plain = _run_multi(totals, avail, alive, demands, seed=1)
+    res_frag = _run_multi(
+        totals, avail, alive, demands, seed=1,
+        weights=ScoreWeights(1.0, 0.0, 4.0, 0.0),
+    )
+    nodes_frag = np.asarray(res_frag.node)
+    large_node = nodes_frag[0]
+    small_node = nodes_frag[1]
+    assert large_node in (0, 1)
+    other_whole = 1 - large_node
+    # frag-aware: the small request spares the remaining whole node
+    assert small_node == 2, (nodes_frag, np.asarray(res_plain.node))
+    # single-objective control: utilization alone picks the emptier
+    # whole node for the small request (breaking it)
+    assert np.asarray(res_plain.node)[1] == (1 - np.asarray(res_plain.node)[0])
+    del other_whole
+
+
+def test_starvation_discount_overrides_soft_terms():
+    # same topology as above, but the small shape is starving: the frag
+    # penalty is discounted away and utilization wins again
+    totals = np.asarray(
+        [[16.0, 16.0], [16.0, 16.0], [4.0, 4.0]], dtype=np.float32
+    )
+    avail = np.asarray(
+        [[16.0, 16.0], [16.0, 16.0], [2.0, 2.0]], dtype=np.float32
+    )
+    alive = np.ones(3, dtype=bool)
+    demands = np.asarray([[16.0, 8.0], [1.0, 0.0]], dtype=np.float32)
+    shapes, sids = dedupe_shapes(demands)
+    # the small shape row: find it (the non-16 row)
+    small_row = int(np.flatnonzero(shapes[:, 0] < 2.0)[0])
+    ages = np.zeros(shapes.shape[0], dtype=np.float32)
+    ages[small_row] = 4.0  # way past starving
+    res = _run_multi(
+        totals, avail, alive, demands, seed=1,
+        weights=ScoreWeights(1.0, 0.0, 4.0, 8.0), ages=ages,
+    )
+    nodes = np.asarray(res.node)
+    assert nodes[1] != 2  # discount active: takes the better-scored node
+
+
+# ---------------------------------------------------------------------------
+# preemption nomination
+# ---------------------------------------------------------------------------
+
+
+def test_starving_unmet_shape_nominates_feasible_node():
+    # both nodes feasible by totals but fully busy: cap 0 everywhere
+    totals, _, alive = _mk([[4.0, 4.0], [4.0, 4.0]])
+    avail = np.zeros_like(totals)
+    demands = np.asarray([[4.0, 1.0]], dtype=np.float32)
+    res_young = _run_multi(
+        totals, avail, alive, demands, ages=np.asarray([0.0]), preempt=True
+    )
+    res_starved = _run_multi(
+        totals, avail, alive, demands, ages=np.asarray([1.5]), preempt=True
+    )
+    assert np.asarray(res_young.node)[0] == -1
+    assert np.asarray(res_young.preempt_node)[0] == -1
+    assert np.asarray(res_starved.node)[0] == -1
+    assert np.asarray(res_starved.preempt_node)[0] in (0, 1)
+
+
+def test_ring_kernel_nominates_for_starving_slot():
+    totals = np.asarray([[4.0, 4.0]], dtype=np.float32)
+    avail = np.zeros_like(totals)
+    alive = np.ones(1, dtype=bool)
+    ring_shapes = np.asarray([[2.0, 1.0]], dtype=np.float32)
+    res = ring_schedule_impl(
+        jnp.asarray(totals), jnp.asarray(avail), jnp.asarray(alive),
+        jnp.zeros(1, dtype=jnp.int32),
+        jnp.ones((1, 2), dtype=jnp.float32),
+        jnp.asarray(ring_shapes),
+        jnp.asarray([5], dtype=jnp.int32),
+        jnp.asarray([2.0], dtype=jnp.float32),
+        np.uint32(0),
+        preempt=True,
+    )
+    assert int(res.placed[0]) == 0
+    assert int(res.preempt_node[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# node-type registry (resources.py)
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_view_node_types_and_throughput():
+    vocab = ResourceVocab()
+    view = ClusterView(vocab)
+    topo0 = view.topo_version
+    tid = view.register_node_type("fast", {"CPU": 2.0})
+    assert tid == 1
+    assert view.topo_version > topo0
+    view.add_node("a", {"CPU": 8.0}, node_type="fast")
+    view.add_node("b", {"CPU": 8.0})  # default type
+    # label-based interning (the head registration path)
+    view.add_node(
+        "c", {"CPU": 8.0}, labels={ClusterView.NODE_TYPE_LABEL: "fast"}
+    )
+    ntypes, thr = view.active_type_arrays()
+    assert ntypes.tolist() == [1, 0, 1]
+    assert thr.shape[0] == 2
+    from ray_tpu.scheduler.resources import CPU
+
+    assert thr[1, CPU] == 2.0
+    assert thr[0, CPU] == 1.0
+    # re-registering updates factors in place
+    view.register_node_type("fast", {"CPU": 3.0})
+    _, thr2 = view.active_type_arrays()
+    assert thr2[1, CPU] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# autoscaler projected-gradient solve
+# ---------------------------------------------------------------------------
+
+
+def _assert_valid_packing(rows, demands, packed):
+    used = np.zeros_like(rows)
+    for b, node in enumerate(packed):
+        if node >= 0:
+            used[node] += demands[b]
+    assert (used <= rows + 1e-3).all(), "solver over-committed a node"
+
+
+def test_solve_matches_first_fit_on_uniform_demand(monkeypatch):
+    from ray_tpu.scheduler.binpack import DeltaBinPacker
+
+    monkeypatch.setenv("RAY_TPU_AUTOSCALER_SOLVE_MIN_DEMANDS", "1")
+    packer = DeltaBinPacker()
+    ids = [f"n{i}" for i in range(5)]
+    rows = np.full((5, 4), 4.0, dtype=np.float32)
+    demands = np.tile(
+        np.asarray([[1.0, 1.0, 0.0, 0.0]], dtype=np.float32), (30, 1)
+    )
+    got = packer.pack_or_solve(ids, rows, demands)
+    oracle = packer.pack(ids, rows, demands)
+    # uniform demand: placed count must match greedy exactly (20 fit)
+    assert (got >= 0).sum() == (oracle >= 0).sum() == 20
+    _assert_valid_packing(rows, demands, got)
+
+
+def test_solve_validity_and_residual_quality(monkeypatch):
+    from ray_tpu.scheduler.binpack import DeltaBinPacker, sort_demands
+
+    monkeypatch.setenv("RAY_TPU_AUTOSCALER_SOLVE_MIN_DEMANDS", "1")
+    rng = np.random.default_rng(5)
+    packer = DeltaBinPacker()
+    ids = [f"n{i}" for i in range(8)]
+    rows = rng.uniform(2.0, 8.0, (8, 4)).astype(np.float32)
+    # a few distinct shapes, many instances (the autoscaler's real load)
+    base = rng.uniform(0.5, 2.0, (4, 4)).astype(np.float32)
+    demands = base[rng.integers(0, 4, 60)]
+    demands = demands[sort_demands(demands)]
+    got = packer.pack_or_solve(ids, rows, demands)
+    oracle = packer.pack(ids, rows, demands)
+    _assert_valid_packing(rows, demands, got)
+    # the solve must not leave meaningfully more residual than first-fit
+    assert (got < 0).sum() <= (oracle < 0).sum() + 3
+
+
+def test_solve_falls_back_to_first_fit_on_failure(monkeypatch):
+    import ray_tpu.scheduler.binpack as bp
+
+    monkeypatch.setenv("RAY_TPU_AUTOSCALER_SOLVE_MIN_DEMANDS", "1")
+
+    def boom(*a, **k):
+        raise RuntimeError("solver died")
+
+    monkeypatch.setattr(bp, "solve_pack_counts", boom)
+    packer = bp.DeltaBinPacker()
+    ids = ["n0", "n1"]
+    rows = np.full((2, 4), 4.0, dtype=np.float32)
+    demands = np.tile(
+        np.asarray([[1.0, 0.0, 0.0, 0.0]], dtype=np.float32), (10, 1)
+    )
+    before = bp.SOLVER_FALLBACKS.value()
+    got = packer.pack_or_solve(ids, rows, demands)
+    assert bp.SOLVER_FALLBACKS.value() == before + 1
+    np.testing.assert_array_equal(got, packer.pack(ids, rows, demands))
+
+
+def test_small_batches_skip_the_solver(monkeypatch):
+    import ray_tpu.scheduler.binpack as bp
+
+    monkeypatch.setenv("RAY_TPU_AUTOSCALER_SOLVE_MIN_DEMANDS", "64")
+    packer = bp.DeltaBinPacker()
+    before = bp.SOLVER_RUNS.value()
+    ids = ["n0"]
+    rows = np.full((1, 4), 4.0, dtype=np.float32)
+    demands = np.ones((3, 4), dtype=np.float32)
+    packer.pack_or_solve(ids, rows, demands)
+    assert bp.SOLVER_RUNS.value() == before  # first-fit path, no solve

@@ -1,0 +1,242 @@
+"""Preemption/migration as first-class scheduler actions (ISSUE 7).
+
+A starving shape (parked past sched_starve_rounds retry rounds with
+zero capacity anywhere) makes the round/ring kernel nominate its
+lowest-cost feasible node; the head maps the nomination to concrete
+victims and kills-and-requeues through the lineage machinery:
+
+  - queued-on-agent leases cancel and requeue with no attempt burned;
+  - active worker leases revoke (owner spills — PR 4 contract);
+  - RUNNING retryable tasks are force-killed and requeued attempt-free;
+  - running max_retries=0 work is NEVER preempted (at-most-once), and a
+    preemption storm with a concurrent node kill loses no acked object.
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import set_runtime
+
+
+def _wait_for(pred, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _sleeper(path, seconds):
+    # one line per EXECUTION: the at-most-once assertions count these
+    with open(path, "a") as f:
+        f.write(f"{os.getpid()} {time.time()}\n")
+        f.flush()
+    time.sleep(seconds)
+    return "slept"
+
+
+def _noop():
+    return "ok"
+
+
+def _runs(path):
+    try:
+        with open(path) as f:
+            return len(f.readlines())
+    except FileNotFoundError:
+        return 0
+
+
+@pytest.fixture()
+def preempt_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SCHED_STARVE_ROUNDS", "2")
+    monkeypatch.setenv("RAY_TPU_SCHED_PREEMPT_COOLDOWN_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_SCHED_PREEMPT", "1")
+    yield
+
+
+@pytest.mark.slow
+def test_starving_shape_preempts_running_retryable(preempt_env, tmp_path):
+    """Two retryable sleepers pin the only node; a 2-CPU shape starves,
+    the kernel nominates, the head force-kills the sleepers, the big
+    task runs, and the victims re-run afterwards WITHOUT consuming a
+    retry attempt (they complete even though the kill was no fault of
+    theirs). (slow tier: real-time sleeps; the tier-1 unit tests pin
+    nomination + victim selection, the storm test re-proves e2e.)"""
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()
+    c.add_node({"CPU": 2.0}, num_workers=3)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        sleep_fn = ray_tpu.remote(_sleeper).options(
+            num_cpus=1.0, max_retries=3
+        )
+        paths = [str(tmp_path / f"victim{i}") for i in range(2)]
+        victims = [sleep_fn.remote(p, 10.0) for p in paths]
+        _wait_for(
+            lambda: all(_runs(p) >= 1 for p in paths),
+            msg="sleepers running",
+        )
+        big = ray_tpu.remote(_noop).options(num_cpus=2.0, max_retries=0)
+        t0 = time.monotonic()
+        ref = big.remote()
+        assert ray_tpu.get(ref, timeout=60) == "ok"
+        # it ran by PREEMPTION, not by outliving the sleepers
+        assert time.monotonic() - t0 < 9.0
+        assert c.head.metrics["preemptions"] >= 1
+        assert c.head.metrics["preempt_nominations"] >= 1
+        # the victims re-run (attempt-free requeue) and still complete
+        assert ray_tpu.get(victims, timeout=60) == ["slept", "slept"]
+        assert all(_runs(p) >= 2 for p in paths)
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_running_at_most_once_tasks_never_preempted(preempt_env, tmp_path):
+    """max_retries=0 sleepers hold the node: the starving shape must NOT
+    kill them — it waits until they finish naturally, and each executes
+    exactly once. (slow tier: the fast victim-selection unit test pins
+    the same at-most-once exclusion; the chaos storm re-proves it under
+    node kills.)"""
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()
+    c.add_node({"CPU": 2.0}, num_workers=3)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        once_fn = ray_tpu.remote(_sleeper).options(
+            num_cpus=1.0, max_retries=0
+        )
+        paths = [str(tmp_path / f"amo{i}") for i in range(2)]
+        victims = [once_fn.remote(p, 8.0) for p in paths]
+        _wait_for(
+            lambda: all(_runs(p) >= 1 for p in paths),
+            msg="sleepers running",
+        )
+        big = ray_tpu.remote(_noop).options(num_cpus=2.0, max_retries=0)
+        ref = big.remote()
+        # the big task completes only AFTER the sleepers release
+        # naturally — and every max_retries=0 victim ran exactly once
+        assert ray_tpu.get(ref, timeout=60) == "ok"
+        assert ray_tpu.get(victims, timeout=30) == ["slept", "slept"]
+        assert [_runs(p) for p in paths] == [1, 1]
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_victims_must_be_strictly_cheaper(preempt_env):
+    """Anti-livelock rule: a starving shape never preempts peers of its
+    own (or larger) footprint — same-size kill-and-requeue just swaps
+    who waits while losing work (observed as an infinite preempt loop).
+    Also pins least-work-lost ordering and the at-most-once force
+    exclusion."""
+    import numpy as np
+
+    from ray_tpu.cluster.common import LeaseRequest
+    from ray_tpu.cluster.head import HeadServer
+
+    head = HeadServer(dashboard_port=None)
+    try:
+        def spec_of(tid, cpu, max_retries):
+            return LeaseRequest(
+                task_id=tid, name=tid, payload=b"", return_ids=[],
+                resources={"CPU": cpu}, max_retries=max_retries,
+            )
+
+        with head._cond:
+            head._in_flight["small_retry"] = (
+                spec_of("small_retry", 1.0, 3), "n0"
+            )
+            head._in_flight["small_once"] = (
+                spec_of("small_once", 1.0, 0), "n0"
+            )
+            head._in_flight["peer"] = (spec_of("peer", 2.0, 3), "n0")
+            head._in_flight["elsewhere"] = (
+                spec_of("elsewhere", 0.5, 3), "n1"
+            )
+        need = np.zeros(16, dtype=np.float32)
+        need[0] = 2.0  # the starving shape wants 2 CPU
+        leases, tasks = head._pick_preemption_victims("n0", need)
+        assert leases == []
+        ids = [s.task_id for s, _ in tasks]
+        # the 2-CPU peer and the other-node spec are never victims
+        assert "peer" not in ids and "elsewhere" not in ids
+        assert set(ids) == {"small_retry", "small_once"}
+        force = {s.task_id: f for s, f in tasks}
+        assert force["small_retry"] is True   # retryable: may kill running
+        assert force["small_once"] is False   # at-most-once: cancel-only
+    finally:
+        head.shutdown(stop_agents=False)
+
+
+@pytest.mark.slow
+def test_preemption_storm_with_node_kill_chaos(preempt_env, tmp_path):
+    """Preemption storm (forced starvation threshold) + a concurrent
+    node kill: every submitted task either returns its value or fails
+    with a typed error (zero acked loss), retryable victims complete,
+    and no max_retries=0 task that STARTED executes twice."""
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()
+    n0 = c.add_node({"CPU": 2.0}, num_workers=3)
+    n1 = c.add_node({"CPU": 2.0}, num_workers=3)
+    rt = c.client()
+    set_runtime(rt)
+    try:
+        retry_fn = ray_tpu.remote(_sleeper).options(
+            num_cpus=1.0, max_retries=5
+        )
+        once_fn = ray_tpu.remote(_sleeper).options(
+            num_cpus=1.0, max_retries=0
+        )
+        retry_paths = [str(tmp_path / f"r{i}") for i in range(3)]
+        once_paths = [str(tmp_path / f"o{i}") for i in range(3)]
+        retry_refs = [retry_fn.remote(p, 6.0) for p in retry_paths]
+        once_refs = [once_fn.remote(p, 6.0) for p in once_paths]
+        # two starving shapes keep nomination pressure on both nodes
+        big = ray_tpu.remote(_noop).options(num_cpus=2.0, max_retries=1)
+        big_refs = [big.remote() for _ in range(2)]
+        time.sleep(2.0)  # let the storm arm (starve_rounds=2, ~1 Hz)
+        c.kill_node(n1)
+
+        results = {}
+        for name, refs in (
+            ("big", big_refs),
+            ("once", once_refs),
+            ("retry", retry_refs),
+        ):
+            for i, r in enumerate(refs):
+                try:
+                    results[f"{name}{i}"] = ray_tpu.get(r, timeout=240)
+                except Exception as exc:  # noqa: BLE001 - typed loss is OK
+                    results[f"{name}{i}"] = exc
+        # retryable work and the starving shapes always complete
+        for i in range(3):
+            assert results[f"retry{i}"] == "slept", results
+        for i in range(2):
+            assert results[f"big{i}"] == "ok", results
+        # at-most-once: started max_retries=0 work ran EXACTLY once —
+        # whether it returned a value or died with the node/preemption
+        for p in once_paths:
+            assert _runs(p) <= 1, (p, _runs(p))
+        # no silent hangs: every once-task resolved to a value or error
+        for i in range(3):
+            assert results[f"once{i}"] == "slept" or isinstance(
+                results[f"once{i}"], Exception
+            )
+        assert c.head.metrics["preempt_nominations"] >= 1
+    finally:
+        set_runtime(None)
+        rt.shutdown()
+        c.shutdown()

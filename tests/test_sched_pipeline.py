@@ -259,7 +259,7 @@ def test_ring_park_schedule_drop():
     assert st.ring_park(key, d)  # idempotent
     assert st.ring_occupancy() == 1
     slot = st.ring_slot_of(key)
-    placed, per_node = st.ring_schedule({slot: 10})
+    placed, per_node, _pre = st.ring_schedule({slot: 10})
     # 2 nodes x 2 CPU = 4 slots for a 1-CPU shape
     assert int(placed[slot]) == 4
     assert int(per_node[slot].sum()) == 4
@@ -291,6 +291,177 @@ def test_ring_full_falls_back():
         assert not st.ring_park((("CPU", 2.0),), d2)  # full → caller fallback
     finally:
         os.environ.pop("RAY_TPU_SCHED_RING_SLOTS", None)
+
+
+# ---------------------------------------------------------------------------
+# node death while rounds are in flight (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeAgentClient:
+    """Stands in for an agent RpcClient: grants every lease batch and
+    records what landed where. The head's REAL _send_grants / dispatch
+    path runs (alive checks included) — only the network is faked."""
+
+    def __init__(self, node_id, granted):
+        self.node_id = node_id
+        self._granted = granted
+
+    def call(self, method, payload=None, timeout=None, **kw):
+        if method == "ExecuteLeaseBatch":
+            self._granted.setdefault(self.node_id, []).extend(
+                s.task_id for s in payload
+            )
+            return {"statuses": ["granted"] * len(payload)}
+        return {}
+
+    def close(self):
+        pass
+
+
+def _head_with_fake_nodes(node_specs):
+    from ray_tpu.cluster.common import NodeInfo
+    from ray_tpu.cluster.head import HeadServer
+
+    head = HeadServer(dashboard_port=None)
+    granted = {}
+    with head._cond:
+        for nid, res in node_specs:
+            head.nodes[nid] = NodeInfo(node_id=nid, address="", resources=res)
+            head.view.add_node(nid, res)
+            head._clients[nid] = _FakeAgentClient(nid, granted)
+    return head, granted
+
+
+def test_node_death_between_dispatch_and_completion():
+    """A node killed while a dispatched round's readback is still in
+    flight must not receive that round's grants: the delta-synced row
+    removal marks it dead, and the completion-side dispatch path
+    (_send_grants alive check) respills its placements to live capacity
+    instead. Extends the mirror-equivalence contract across the kill."""
+    from ray_tpu.cluster.common import LeaseRequest
+    from ray_tpu.scheduler.pipeline import SchedulerPipeline
+
+    head, granted = _head_with_fake_nodes(
+        [("n0", {"CPU": 4.0}), ("n1", {"CPU": 4.0})]
+    )
+    try:
+        # gate the completion side so the kill lands INSIDE the
+        # dispatch→completion window deterministically
+        gate = threading.Event()
+        dispatched = threading.Event()
+        orig_finish = head._finish_round
+
+        def gated_finish(sched, rows, ms):
+            dispatched.set()
+            assert gate.wait(timeout=30.0)
+            orig_finish(sched, rows, ms)
+
+        head._pipeline = SchedulerPipeline(
+            on_complete=gated_finish, on_error=head._round_failed
+        )
+        specs = [
+            LeaseRequest(
+                task_id=f"t{i}", name="t", payload=b"", return_ids=[],
+                resources={"CPU": 1.0}, max_retries=0,
+            )
+            for i in range(8)
+        ]
+        with head._cond:
+            head._pending.extend(specs)
+            head._cond.notify_all()
+        assert dispatched.wait(timeout=60.0)  # round in flight, gated
+        head._on_node_death("n1")
+        gate.set()
+
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            with head._cond:
+                settled = (
+                    len(granted.get("n0", [])) + len(head._infeasible) >= 8
+                    and not head._pending
+                    and not head._deferred_rounds
+                )
+            if settled:
+                break
+            time.sleep(0.05)
+        # the dead node must have received NOTHING; its half of the round
+        # respilled — n0 absorbs what fits (4 CPU), the rest parks
+        assert granted.get("n1", []) == []
+        assert len(granted.get("n0", [])) == 4
+        with head._cond:
+            assert len(head._infeasible) == 4
+        # and the device mirror still converges with the host view
+        ds = head._lazy_device._result
+        if ds is not None:
+            with head._lock:
+                ds.sync(head.view)
+                np.testing.assert_allclose(
+                    np.asarray(ds._avail), head.view.avail, atol=1e-4
+                )
+    finally:
+        head.shutdown(stop_agents=False)
+
+
+def test_ring_churn_past_slot_capacity_no_leak():
+    """>sched_ring_slots distinct parked shapes churning through the
+    ring: every shape must eventually unpark once capacity appears, and
+    every ring slot must come back (no slot leak disabling the ring)."""
+    from ray_tpu.cluster.common import LeaseRequest, NodeInfo
+
+    n_shapes = 80  # > the default 64-slot ring
+    head, granted = _head_with_fake_nodes([("n0", {"CPU": 0.25})])
+    try:
+        specs = [
+            LeaseRequest(
+                task_id=f"t{i}", name="t", payload=b"", return_ids=[],
+                resources={"CPU": 0.5 + 0.005 * i}, max_retries=0,
+            )
+            for i in range(n_shapes)
+        ]
+        with head._cond:
+            head._pending.extend(specs)
+            head._cond.notify_all()
+        # everything parks (0.25 CPU total); the ring fills to capacity
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            with head._cond:
+                if len(head._infeasible) == n_shapes:
+                    break
+            time.sleep(0.05)
+        with head._cond:
+            assert len(head._infeasible) == n_shapes
+        # capacity arrives: a big node joins (through the same view the
+        # real registration path uses) — every shape must drain
+        with head._cond:
+            head.nodes["big"] = NodeInfo(
+                node_id="big", address="", resources={"CPU": 100.0}
+            )
+            head.view.add_node("big", {"CPU": 100.0})
+            head._clients["big"] = _FakeAgentClient("big", granted)
+            head._pending.extend(head._infeasible)
+            head._infeasible = []
+            head._cond.notify_all()
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            if len(granted.get("big", [])) >= n_shapes:
+                break
+            time.sleep(0.05)
+        assert len(granted.get("big", [])) == n_shapes
+        # no slot leak: the reconcile sweep (which runs with every unpark
+        # pass) must return every stale slot to the free list once the
+        # shapes drained
+        ds = head._lazy_device._result
+        if ds is not None:
+            deadline = time.time() + 10.0
+            while time.time() < deadline and ds.ring_occupancy():
+                with head._cond:
+                    head._unpark_grantable()
+                time.sleep(0.1)
+            assert ds.ring_occupancy() == 0
+            assert len(ds._ring_free) == ds.ring_slots
+    finally:
+        head.shutdown(stop_agents=False)
 
 
 # ---------------------------------------------------------------------------
