@@ -1179,6 +1179,203 @@ def chaos_bench(num_faults: int = 20, seed: int = None) -> dict:
         cluster.shutdown()
 
 
+def serve_bench() -> dict:
+    """Tier: serving plane under open-loop load. Poisson-ish arrivals at
+    a fixed QPS stream tokens from a 2-replica continuous-batching LLM
+    deployment through the lease-routed router (push/shm transports,
+    admission on, shared prefix cache on). Exports sustained QPS, TTFT
+    p50, e2e p99, shed rate, prefix-cache hit rate, and verifies the
+    steady state made zero per-request head RPCs via the head's handler
+    counters. Gates: RAY_TPU_BENCH_SERVE_QPS_FLOOR (sustained QPS) and
+    RAY_TPU_BENCH_SERVE_P99_CEILING_MS (e2e p99)."""
+    import random as _random
+    import threading
+
+    import jax.numpy as jnp
+
+    import ray_tpu.serve as serve
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.cluster.rpc import HANDLER_STATS
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.llm.serving import build_llm_deployment
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.serve.admission import Overloaded
+    from ray_tpu.serve.router import SERVE_E2E_MS, SERVE_TTFT_MS
+
+    qps = float(os.environ.get("RAY_TPU_BENCH_SERVE_QPS", "6"))
+    duration_s = float(os.environ.get("RAY_TPU_BENCH_SERVE_SECONDS", "20"))
+    max_new = int(os.environ.get("RAY_TPU_BENCH_SERVE_TOKENS", "12"))
+    mcfg = tfm.ModelConfig(
+        vocab_size=64, d_model=48, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=96, max_seq_len=128, dtype=jnp.float32,
+    )
+    # zipf-ish prompt mix: a few hot prefixes dominate, so the shared
+    # prefix cache sees realistic reuse across replicas
+    hot = [
+        "the quick brown fox jumps over it " * 2,
+        "in the beginning there was a tape " * 2,
+        "once upon a time in a cluster far " * 2,
+    ]
+    cluster = Cluster(use_device_scheduler=False)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    rt = cluster.client()
+    set_runtime(rt)
+    t_start = time.perf_counter()
+    try:
+        serve.run(
+            build_llm_deployment(
+                mcfg,
+                name="bench-llm",
+                num_replicas=2,
+                engine="continuous",
+                max_batch=4,
+                page_size=8,
+                n_pages=128,
+            )
+        )
+        router = serve.get_router("bench-llm")
+        rng = _random.Random(7)
+
+        def one_request(results, idx):
+            prompt = (
+                rng.choice(hot)
+                if rng.random() < 0.8
+                else f"cold prompt number {idx} with some extra words"
+            )
+            stream = None
+            try:
+                stream = router.stream(
+                    {"prompt": prompt, "max_new_tokens": max_new}
+                )
+                n = sum(1 for _ in stream)
+                results.append(n)
+            except Overloaded:
+                pass  # counted via serve_shed_total
+            except Exception:  # noqa: BLE001
+                results.append(-1)
+            finally:
+                if stream is not None:
+                    stream.close()
+
+        # warm both replicas (compile prefill/decode) before the clock
+        warm_results: list = []
+        warm = [
+            threading.Thread(target=one_request, args=(warm_results, i))
+            for i in range(4)
+        ]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=300)
+        _lbl = {"deployment": "bench-llm"}
+        ttft_base = SERVE_TTFT_MS.buckets_snapshot(_lbl)
+        e2e_base = SERVE_E2E_MS.buckets_snapshot(_lbl)
+        head_names = (
+            "SubmitLease", "WaitObjectBatch", "WaitObject", "PutObject",
+            "GrantTaskLease", "CreateActor", "WaitActor", "LocateObjects",
+        )
+        snap0 = HANDLER_STATS.snapshot()
+        head_rpcs0 = sum(
+            (snap0.get(n) or {}).get("count", 0) for n in head_names
+        )
+        from ray_tpu.serve.admission import SERVE_SHED
+
+        shed0 = sum(SERVE_SHED.values_by_label().values())
+        results: list = []
+        threads: list = []
+        t0 = time.perf_counter()
+        launched = 0
+        # open loop: arrivals keep coming at the configured rate whether
+        # or not earlier requests finished (the load model that actually
+        # finds capacity cliffs)
+        while time.perf_counter() - t0 < duration_s:
+            threads.append(
+                threading.Thread(
+                    target=one_request, args=(results, launched)
+                )
+            )
+            threads[-1].start()
+            launched += 1
+            next_at = t0 + launched / qps
+            delay = next_at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        for t in threads:
+            t.join(timeout=300)
+        wall = time.perf_counter() - t0
+        completed = sum(1 for r in results if r == max_new)
+        errored = sum(1 for r in results if r == -1)
+        shed = sum(SERVE_SHED.values_by_label().values()) - shed0
+        snap1 = HANDLER_STATS.snapshot()
+        head_rpcs = (
+            sum((snap1.get(n) or {}).get("count", 0) for n in head_names)
+            - head_rpcs0
+        )
+
+        def _pct(hist, base, q):
+            from ray_tpu.util.metrics import percentile_from_buckets
+
+            cur = hist.buckets_snapshot(_lbl)
+            window = [max(0, a - b) for a, b in zip(cur, base)]
+            return percentile_from_buckets(hist.boundaries, window, q)
+
+        # prefix-cache hit rate straight from a replica engine
+        prefix = {}
+        try:
+            handle = serve.get_deployment_handle("bench-llm")
+            import ray_tpu as _rt
+
+            stats = _rt.get(handle.serve_stats.remote(), timeout=30)
+            prefix = stats.get("prefix_cache") or {}
+        except Exception:  # noqa: BLE001
+            pass
+        out = {
+            "serve_qps_offered": round(qps, 2),
+            "serve_qps_sustained": round(completed / wall, 2),
+            "serve_requests_launched": launched,
+            "serve_requests_completed": completed,
+            "serve_requests_errored": errored,
+            "serve_shed_rate": round(shed / max(1, launched), 4),
+            "serve_ttft_p50_ms": round(_pct(SERVE_TTFT_MS, ttft_base, 0.5), 1),
+            "serve_p99_ms": round(_pct(SERVE_E2E_MS, e2e_base, 0.99), 1),
+            "prefix_cache_hit_rate": prefix.get("hit_rate"),
+            # per-request head-RPC budget: steady state must not scale
+            # with request count (the lease-routed zero-head-RPC claim)
+            "serve_head_rpcs_steady": head_rpcs,
+            "serve_head_rpcs_per_request": round(
+                head_rpcs / max(1, completed), 4
+            ),
+            "serve_wall_s": round(time.perf_counter() - t_start, 1),
+        }
+        p99_budget = float(
+            os.environ.get("RAY_TPU_BENCH_SERVE_P99_CEILING_MS", "0") or 0.0
+        )
+        if p99_budget > 0:
+            out["serve_p99_budget_ms"] = p99_budget
+            out["serve_p99_ok"] = bool(out["serve_p99_ms"] <= p99_budget)
+        qps_floor = float(
+            os.environ.get("RAY_TPU_BENCH_SERVE_QPS_FLOOR", "0") or 0.0
+        )
+        if qps_floor > 0:
+            out["serve_qps_floor"] = qps_floor
+            out["serve_qps_ok"] = bool(
+                out["serve_qps_sustained"] >= qps_floor
+            )
+        return out
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        set_runtime(None)
+        try:
+            rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
 def sim_sched_bench() -> dict:
     """Tier 2b: simulated-scale scheduler. A 10k-node synthetic topology
     with a six-figure pending-demand backlog driven through the REAL head
@@ -1374,6 +1571,11 @@ def main():
             )
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["chaos_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_SERVE", "1") != "0":
+        try:
+            cluster.update(serve_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["serve_error"] = repr(exc)
     if tiers is not None:
         # TPU attempt 2: ~10 minutes of e2e tiers later the tunnel may
         # have recovered; attempt 3 at the very end with a raised
@@ -1430,13 +1632,17 @@ def main():
         or out.get("sched_floor_ok") is False
         or out.get("frag_ceiling_ok") is False
         or out.get("wait_p99_ok") is False
+        or out.get("serve_p99_ok") is False
+        or out.get("serve_qps_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
         # RAY_TPU_BENCH_TASKS_FLOOR_PER_S / RAY_TPU_BENCH_RECOVERY_P95_S /
         # RAY_TPU_BENCH_SCHED_FLOOR_PLACEMENTS_PER_S /
         # RAY_TPU_BENCH_FRAG_CEILING_PCT /
-        # RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS):
+        # RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS /
+        # RAY_TPU_BENCH_SERVE_P99_CEILING_MS /
+        # RAY_TPU_BENCH_SERVE_QPS_FLOOR):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

@@ -14,7 +14,15 @@ from .orchestrator import (  # noqa: F401
     ChaosRunResult,
     FaultResult,
 )
-from .plan import DEFAULT_MIX, KINDS, ChaosPlan, FaultSpec, make_plan  # noqa: F401
+from .plan import (  # noqa: F401
+    DEFAULT_MIX,
+    KINDS,
+    SERVE_MIX,
+    ChaosPlan,
+    FaultSpec,
+    make_plan,
+)
+from .serve import ServeStreamWorkload  # noqa: F401
 from .workload import ChaosCounter, ChaosWorkload  # noqa: F401
 
 

@@ -193,6 +193,46 @@ class InvariantChecker:
             out.append(f"owner {cid[:8]} session never declared dead")
         return out
 
+    def wait_streams_resume(self, adapter, timeout: float) -> List[str]:
+        """After a replica_kill: in-flight streams must fail over (or
+        restart) and KEEP COMPLETING with byte-exact token sequences —
+        any recorded verification failure means an acked token was
+        duplicated or dropped, an immediate invariant breach."""
+        if adapter is None:
+            return ["replica_kill injected with no serve adapter"]
+        deadline = time.monotonic() + timeout
+        base = adapter.completed
+        while time.monotonic() < deadline:
+            if adapter.verify_failures:
+                return list(adapter.verify_failures)
+            if adapter.completed > base:
+                return []
+            time.sleep(0.2)
+        if adapter.verify_failures:
+            return list(adapter.verify_failures)
+        return [
+            f"no stream completed within {timeout:.0f}s after the "
+            "replica kill (streams wedged instead of failing over)"
+        ]
+
+    def wait_replica_backfilled(self, adapter, timeout: float) -> List[str]:
+        """After a replica_kill the replica set must restore its desired
+        count with replicas that actually answer calls."""
+        if adapter is None:
+            return []
+        deadline = time.monotonic() + timeout
+        live = 0
+        while time.monotonic() < deadline:
+            live = adapter.live_replicas()
+            if live >= adapter.target_replicas():
+                return []
+            time.sleep(0.3)
+        return [
+            f"replica set not backfilled: {live}/"
+            f"{adapter.target_replicas()} live replicas after "
+            f"{timeout:.0f}s"
+        ]
+
     def arena_zombies(self) -> int:
         """Sum of deleted-with-outstanding-pins entries across every live
         node's arena (agent DebugState ``object_plane.arena_zombies``)."""

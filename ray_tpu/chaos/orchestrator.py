@@ -28,6 +28,11 @@ injection. Fault kinds:
 - ``zygote_kill``     — SIGKILL one node's fork-server (taking its
                        forked workers with it); worker spawns must keep
                        succeeding (zygote restart or cold spawn).
+- ``replica_kill``    — SIGKILL a serving replica's worker mid-stream
+                       (requires a registered ``serve_adapter``);
+                       in-flight streams must fail over with no
+                       duplicated/dropped acked tokens and the replica
+                       set must backfill to its desired count.
 
 Every fault records recovery latency = time from injection until all
 invariants are green again; the run result carries p50/p95 plus objects
@@ -140,6 +145,7 @@ class ChaosOrchestrator:
         partition_hold_s: float = 1.0,
         straggler_peak_s: float = 0.3,
         convergence_budget_s: float = 60.0,
+        serve_adapter=None,
     ):
         self.cluster = cluster
         self.workload = workload
@@ -164,6 +170,10 @@ class ChaosOrchestrator:
         self._owner_proc: Optional[subprocess.Popen] = None
         self._owner_info_path: Optional[str] = None
         self._killed_owner: Optional[dict] = None
+        # serving-plane adapter (chaos/serve.py ServeStreamWorkload):
+        # victim selection + stream/replica invariants for replica_kill
+        self.serve_adapter = serve_adapter
+        self._killed_replica: Optional[int] = None
 
     # -- sacrificial owner ----------------------------------------------
     def _spawn_owner_proc(self) -> None:
@@ -288,6 +298,20 @@ class ChaosOrchestrator:
                 f"SIGKILLed owner {info['client_id'][:8]} "
                 f"(pid {info['pid']}, {len(info['actor_ids'])} actors)"
             )
+        if kind == "replica_kill":
+            if self.serve_adapter is None:
+                return "skipped: no serve workload registered"
+            pid = self.serve_adapter.pick_replica_pid(self._rng)
+            if pid is None:
+                return "skipped: no live replica to kill"
+            import signal as _signal
+
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                return f"skipped: replica pid {pid} already gone"
+            self._killed_replica = pid
+            return f"SIGKILLed serve replica worker pid {pid}"
         if kind == "zygote_kill":
             nid = self._pick_node(spec)
             if nid is None:
@@ -322,6 +346,7 @@ class ChaosOrchestrator:
                 t0 = time.monotonic()
                 self._dropped_hex: Optional[str] = None
                 self._killed_owner = None
+                self._killed_replica = None
                 detail = self._inject(spec)
                 logger.info(
                     "chaos #%d %s: %s", spec.index, spec.kind, detail
@@ -350,6 +375,21 @@ class ChaosOrchestrator:
                         check.failures.extend(owner_fail)
                     # pre-warm the next sacrificial owner off the clock
                     self._spawn_owner_proc()
+                if self._killed_replica is not None:
+                    # serving invariants: in-flight streams fail over or
+                    # restart with no duplicated/dropped acked tokens,
+                    # and the replica set backfills to its target
+                    serve_fail = self.checker.wait_streams_resume(
+                        self.serve_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    serve_fail += self.checker.wait_replica_backfilled(
+                        self.serve_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if serve_fail:
+                        check.ok = False
+                        check.failures.extend(serve_fail)
                 recovery = time.monotonic() - t0
                 CHAOS_RECOVERY.observe(recovery)
                 if not check.ok:

@@ -29,7 +29,16 @@ DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
     ("head_restart", 1.0),
 )
 
-KINDS = tuple(k for k, _ in DEFAULT_MIX)
+# serving-plane mix: adds replica_kill (SIGKILL a serve replica's worker
+# mid-stream). Not in DEFAULT_MIX — the generic soak runs no serve
+# workload, and keeping the default mix stable preserves seed-for-seed
+# schedule reproducibility across versions. Plans that drive a serve
+# workload pass this mix (or an explicit allow list over it).
+SERVE_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
+    ("replica_kill", 2.0),
+)
+
+KINDS = tuple(k for k, _ in SERVE_MIX)
 
 
 @dataclass(frozen=True)

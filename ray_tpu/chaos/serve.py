@@ -1,0 +1,138 @@
+"""Serving-plane chaos workload: verified token streams under faults.
+
+Drives a *resumable* streaming deployment (the LLM continuous engine —
+per-request deterministic generation) through the serving router while
+the orchestrator injects ``replica_kill`` faults, and verifies the
+serving plane's core promise end to end: every completed stream's token
+sequence equals the expected sequence EXACTLY — a mid-stream replica
+SIGKILL that fails over may neither duplicate nor drop a single acked
+token.
+
+The workload doubles as the orchestrator's ``serve_adapter``: it knows
+how to pick a live replica worker pid to kill, how many replicas are
+supposed to exist (the replica set's desired count), and whether
+streams kept completing after the fault.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import ray_tpu
+
+
+class ServeStreamWorkload:
+    """``concurrency`` threads open stream after stream against
+    ``router`` and verify each completed stream against
+    ``expected_tokens`` (the deterministic reference sequence)."""
+
+    def __init__(
+        self,
+        router,
+        payload: dict,
+        expected_tokens: List[str],
+        concurrency: int = 2,
+    ):
+        self.router = router
+        self.payload = dict(payload)
+        self.expected = list(expected_tokens)
+        self.concurrency = concurrency
+        self.completed = 0
+        self.stream_errors = 0
+        self.verify_failures: List[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- stream loop ----------------------------------------------------
+    def _loop(self) -> None:
+        from ray_tpu.serve.router import ChannelClosed
+
+        while not self._stop.is_set():
+            got: List[str] = []
+            stream = None
+            try:
+                stream = self.router.stream(self.payload)
+                while True:
+                    try:
+                        got.append(stream.read(timeout=30.0))
+                    except ChannelClosed:
+                        break
+            except Exception:  # noqa: BLE001
+                # failover exhaustion surfaces here; only token
+                # CORRUPTION is an invariant failure — a hard error on
+                # an unlucky double-kill is counted but tolerated
+                with self._lock:
+                    self.stream_errors += 1
+                time.sleep(0.2)
+                continue
+            finally:
+                if stream is not None:
+                    stream.close()
+            if got != self.expected:
+                div = next(
+                    (
+                        i
+                        for i, (a, b) in enumerate(zip(got, self.expected))
+                        if a != b
+                    ),
+                    min(len(got), len(self.expected)),
+                )
+                with self._lock:
+                    self.verify_failures.append(
+                        f"stream returned {len(got)} tokens, expected "
+                        f"{len(self.expected)}; first divergence at "
+                        f"index {div} (duplicated/dropped acked tokens)"
+                    )
+            else:
+                with self._lock:
+                    self.completed += 1
+
+    def start(self) -> None:
+        for i in range(self.concurrency):
+            t = threading.Thread(
+                target=self._loop, name=f"serve-chaos-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    # -- orchestrator adapter surface -----------------------------------
+    def pick_replica_pid(self, rng) -> Optional[int]:
+        """A live replica worker's pid (victim selection); None when no
+        replica answers."""
+        rs = self.router._rs
+        with rs.lock:
+            replicas = [r for r in rs.replicas if not r.draining]
+        if not replicas:
+            return None
+        for r in rng.sample(replicas, len(replicas)):
+            try:
+                return int(
+                    ray_tpu.get(r.actor.pid.remote(), timeout=10.0)
+                )
+            except Exception:  # noqa: BLE001 - already dead: next
+                continue
+        return None
+
+    def live_replicas(self) -> int:
+        """Replicas that actually answer a call right now."""
+        rs = self.router._rs
+        with rs.lock:
+            replicas = [r for r in rs.replicas if not r.draining]
+        alive = 0
+        for r in replicas:
+            try:
+                ray_tpu.get(r.actor.pid.remote(), timeout=10.0)
+                alive += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return alive
+
+    def target_replicas(self) -> int:
+        return self.router._rs.target

@@ -331,9 +331,13 @@ class NodeAgent:
             ),
             "Shutdown": self._h_shutdown,
             "DebugState": self._h_debug_state,
+            "ServeStats": self._h_serve_stats,
             "ChaosKillZygote": self._h_chaos_kill_zygote,
             "Ping": lambda r: "pong",
         }
+        # serving-plane stats pushed by co-located replica workers
+        # (node-local control traffic): pid -> {deployment, stats, ts}
+        self._serve_stats: Dict[int, dict] = {}
         self._server = RpcServer(handlers, host=host, port=0)
         self.address = self._server.address
 
@@ -2464,6 +2468,43 @@ class NodeAgent:
         if not self._shutdown:
             self._spawn_worker()
 
+    def _h_serve_stats(self, req: dict) -> dict:
+        with self._lock:
+            self._serve_stats[int(req["pid"])] = {
+                "deployment": req.get("deployment", ""),
+                "stats": req.get("stats") or {},
+                "ts": time.monotonic(),
+            }
+        return {"ok": True}
+
+    def _serve_debug_block(self) -> dict:
+        """Aggregate fresh replica reports (caller holds self._lock):
+        per-replica engine stats plus the node-wide prefix-cache hit
+        rate — the DebugState ``serve`` block."""
+        now = time.monotonic()
+        replicas = []
+        hits = misses = 0
+        for pid, entry in list(self._serve_stats.items()):
+            if now - entry["ts"] > 30.0:
+                del self._serve_stats[pid]
+                continue
+            stats = entry["stats"]
+            pc = stats.get("prefix_cache") or {}
+            hits += int(pc.get("hits") or 0)
+            misses += int(pc.get("misses") or 0)
+            replicas.append(
+                {"pid": pid, "deployment": entry["deployment"], **stats}
+            )
+        total = hits + misses
+        return {
+            "replicas": replicas,
+            "prefix_cache_hits": hits,
+            "prefix_cache_misses": misses,
+            "prefix_cache_hit_rate": (
+                round(hits / total, 4) if total else None
+            ),
+        }
+
     def _h_debug_state(self, req=None) -> dict:
         """Operator/debugging introspection (node_manager DebugString
         analog, node_manager.cc HandleGetNodeStats)."""
@@ -2521,6 +2562,9 @@ class NodeAgent:
                 # in flight, and bytes moved per path (process-wide —
                 # co-located agents in tests share the counters)
                 "object_plane": self._object_plane_state(),
+                # serving plane: co-located replica engine stats + the
+                # node-wide prefix-cache hit rate
+                "serve": self._serve_debug_block(),
                 "oom_kills": self.metrics_oom_kills,
                 # instrumented_io_context analog: every handler counted+timed
                 "rpc_handlers": HANDLER_STATS.snapshot(),

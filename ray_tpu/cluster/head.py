@@ -375,6 +375,11 @@ class HeadServer:
             "preempt_nominations": 0,
             "preemptions": 0,
         }
+        # serving-plane state reported by ingress routers (1/s control
+        # traffic, never per-request): (client_id, deployment) -> blob.
+        # Ephemeral by design — a restarted head repopulates within one
+        # report period.
+        self._serve_state: Dict[tuple, dict] = {}
 
         self._dispatch_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="head-dispatch"
@@ -415,6 +420,7 @@ class HeadServer:
                 k for k in self._kv if k.startswith(r.get("prefix", ""))
             ],
             "ClusterInfo": self._h_cluster_info,
+            "ReportServeState": self._h_report_serve_state,
             "QueryState": self._h_query_state,
             "Timeline": lambda r: self.events.dump_timeline(None),
             "SubmitJob": lambda r: self.jobs.submit(
@@ -4339,6 +4345,13 @@ class HeadServer:
                 )
         return {"nodes": nodes, "metrics": dict(self.metrics)}
 
+    def _h_report_serve_state(self, req: dict) -> dict:
+        with self._lock:
+            self._serve_state[
+                (req.get("client_id", ""), req.get("deployment", ""))
+            ] = {"state": req.get("state") or {}, "ts": time.time()}
+        return {"ok": True}
+
     def _h_query_state(self, req: dict) -> Any:
         kind = req.get("kind", "summary")
         if kind == "rpc_handlers":
@@ -4439,6 +4452,21 @@ class HeadServer:
                         "iters_per_solve": SOLVER_ITERS.value(),
                     },
                 }
+            if kind == "serve":
+                # the serving plane, as last reported by each ingress
+                # router: replica tables, lease-hit and prefix-cache hit
+                # rates, admission/shed counters, latency summaries
+                now = time.time()
+                deployments = {}
+                for (cid, dep), entry in list(self._serve_state.items()):
+                    if now - entry["ts"] > 30.0:
+                        del self._serve_state[(cid, dep)]
+                        continue
+                    blob = dict(entry["state"])
+                    blob["reporter"] = cid
+                    blob["age_s"] = round(now - entry["ts"], 2)
+                    deployments[dep] = blob
+                return {"deployments": deployments}
             if kind == "dispatch":
                 # the task-lease dispatch plane (lease-cached direct
                 # dispatch): active leases + per-owner counts + lifecycle

@@ -90,8 +90,17 @@ def _flush_nested_deferred(ids) -> None:
             logger.warning("nested deferred-seal flush failed", exc_info=True)
 
 
+# the live Worker of this process (None in drivers/agents): node-local
+# services that ride the worker's open arena handle — e.g. the serving
+# plane's shared prefix cache — discover it here instead of re-mapping
+# the arena per consumer
+_CURRENT_WORKER: Optional["Worker"] = None
+
+
 class Worker:
     def __init__(self, agent_address: str, worker_id: str, store_path: str):
+        global _CURRENT_WORKER
+        _CURRENT_WORKER = self
         self.worker_id = worker_id
         self.agent = RpcClient(agent_address)
         self.node_id = os.environ.get("RAY_TPU_NODE_ID", "")

@@ -625,6 +625,113 @@ define(
 )
 
 # ---------------------------------------------------------------------------
+# serving plane (ray_tpu.serve router/admission/prefix-cache/autoscaler)
+# ---------------------------------------------------------------------------
+define(
+    "serve_push_streams",
+    True,
+    "Stream token deltas from replicas straight to the ingress process's "
+    "push sink (direct worker->ingress RPC, zero head involvement, no "
+    "polling). Off: cross-host streams fall back to the legacy polling "
+    "_StreamRelayActor bridge.",
+)
+define(
+    "serve_shm_streams",
+    True,
+    "Prefer the same-host shm ring Channel for token streams when a "
+    "same-host replica exists (zero-RPC transport). Off: every stream "
+    "rides the push sink — mainly a test lever to force the push path.",
+)
+define(
+    "serve_stream_buffer",
+    4096,
+    "Per-stream bound on buffered undelivered deltas at the ingress "
+    "push sink; writers past it are rejected (backpressure is "
+    "depth-based and writer-side, like the relay actor's contract).",
+)
+define(
+    "serve_stream_failover",
+    1,
+    "Max mid-stream replica failovers per request: on replica death a "
+    "resumable deployment is re-dispatched elsewhere with "
+    "resume_from=<delivered count> so acked deltas are neither repeated "
+    "nor lost. 0 disables failover (streams error on replica death).",
+)
+define(
+    "serve_admission_qps",
+    0.0,
+    "Token-bucket sustained admission rate for the serving router "
+    "(requests/s); 0 = unlimited (depth shedding still applies).",
+)
+define(
+    "serve_admission_burst",
+    32.0,
+    "Token-bucket burst allowance above the sustained admission rate.",
+)
+define(
+    "serve_admission_max_inflight",
+    256,
+    "Admitted-but-unfinished request bound at the router; arrivals past "
+    "it queue in the WFQ waiting room or shed with Overloaded.",
+)
+define(
+    "serve_admission_wait_cap",
+    128,
+    "Bound on the admission waiting room (all tenants); past it "
+    "arrivals shed immediately with reason=queue_full.",
+)
+define(
+    "serve_admission_timeout_s",
+    2.0,
+    "Max time one arrival waits in the WFQ room before shedding with "
+    "reason=timeout.",
+)
+define(
+    "serve_prefix_cache",
+    True,
+    "Cross-replica prefix/KV cache in the node's shm arena: page-aligned "
+    "prompt prefixes hit as read-only view pins and skip prefill "
+    "compute. Off: every prompt prefills from scratch.",
+)
+define(
+    "serve_prefix_cache_bytes",
+    64 << 20,
+    "Per-inserting-process byte budget for prefix KV entries in the "
+    "arena (oldest own entries evict first; arena-full puts evict then "
+    "retry once).",
+)
+define(
+    "serve_report_period_s",
+    1.0,
+    "Router -> head serve-state report period (powers QueryState('serve')"
+    "); control-plane cadence, never per-request.",
+)
+define(
+    "serve_autoscale_interval_s",
+    0.5,
+    "SLO autoscaler control-loop tick.",
+)
+define(
+    "serve_drain_timeout_s",
+    30.0,
+    "Graceful-drain budget for a retiring replica: in-flight streams "
+    "finish within this before the replica is killed anyway.",
+)
+define(
+    "serve_slo_ttft_ms",
+    0.0,
+    "Target p50 time-to-first-token for SLO autoscaling (ms); sustained "
+    "violation scales replicas up. 0 disables the TTFT term (queue-"
+    "depth scaling still applies).",
+)
+define(
+    "serve_slo_queue_per_replica",
+    4.0,
+    "Target admitted-in-flight requests per replica: sustained excess "
+    "scales up, sustained idleness (below half) drains one replica.",
+)
+
+# ---------------------------------------------------------------------------
 # compiled DAG
 # ---------------------------------------------------------------------------
 define(

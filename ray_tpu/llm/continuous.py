@@ -109,6 +109,7 @@ class ContinuousBatchingEngine:
         tokenizer: Optional[Any] = None,
         use_pallas_attention: bool = False,
         pallas_interpret: bool = False,
+        prefix_cache: Optional[Any] = None,
     ):
         if cfg.n_experts > 0:
             raise NotImplementedError(
@@ -131,6 +132,10 @@ class ContinuousBatchingEngine:
         # data movement (real-TPU profiling decides the default flip)
         self.use_pallas_attention = use_pallas_attention
         self.pallas_interpret = pallas_interpret
+        # optional cross-replica prefix/KV cache (serve.prefix_cache):
+        # page-aligned prompt prefixes restore from pinned shm views and
+        # only the suffix pays prefill compute
+        self.prefix_cache = prefix_cache
         self.params = (
             params
             if params is not None
@@ -374,8 +379,105 @@ class ContinuousBatchingEngine:
             logits = (h[0] @ params["head"]).astype(jnp.float32)
             return logits, pool_k, pool_v
 
+        @functools.partial(jax.jit, static_argnums=(4,))
+        def prefill_suffix(
+            params,
+            pool_k,
+            pool_v,
+            tokens,
+            t_pad,
+            hist_len,
+            table,
+            suffix_page_ids,
+        ):
+            """Prefill the SUFFIX of a sequence whose first ``hist_len``
+            tokens' KV was restored from the shared prefix cache: write
+            the suffix KV into its pages, then attend over history +
+            suffix by gathering the slot's whole page table (fixed
+            shapes — the decode formulation applied to a prompt block;
+            ``hist_len`` is traced, so one program serves every split
+            within a suffix-length bucket). tokens: int32[t_pad] padded
+            suffix; table: int32[P_max]; suffix_page_ids:
+            int32[t_pad // page]. Returns logits over suffix positions."""
+            pos = hist_len + jnp.arange(t_pad)  # absolute positions
+            h = params["embed"][tokens][None].astype(cfg.dtype)
+            angles = tfm.rope_freqs(
+                cfg.head_dim, cfg.max_seq_len, cfg.rope_theta
+            )
+            ang = angles[pos][None]
+
+            def body(carry, layer):
+                h, pk, pv, li = carry
+                p = layer
+                x = tfm.rms_norm(h, p["ln1"])
+                q = (x @ p["wq"]).reshape(
+                    1, t_pad, cfg.n_heads, cfg.head_dim
+                )
+                k = (x @ p["wk"]).reshape(
+                    1, t_pad, cfg.n_kv_heads, cfg.head_dim
+                )
+                v = (x @ p["wv"]).reshape(
+                    1, t_pad, cfg.n_kv_heads, cfg.head_dim
+                )
+                q = tfm._apply_rope_positions(q, ang)
+                k = tfm._apply_rope_positions(k, ang)
+                # scatter the suffix KV into its pages (prefill layout)
+                kp = jnp.transpose(k[0], (1, 0, 2)).reshape(
+                    cfg.n_kv_heads, -1, page, cfg.head_dim
+                )
+                vp = jnp.transpose(v[0], (1, 0, 2)).reshape(
+                    cfg.n_kv_heads, -1, page, cfg.head_dim
+                )
+                hidx = jnp.arange(cfg.n_kv_heads)[:, None]
+                pk = pk.at[li, hidx, suffix_page_ids[None, :]].set(
+                    kp.astype(pk.dtype)
+                )
+                pv = pv.at[li, hidx, suffix_page_ids[None, :]].set(
+                    vp.astype(pv.dtype)
+                )
+                # history + suffix keys via the slot's full table; key
+                # positions past hist_len + q_pos (incl. the scratch
+                # page behind unfilled table slots) are masked
+                ks = pk[li][:, table].reshape(
+                    cfg.n_kv_heads, S_max, cfg.head_dim
+                )
+                vs = pv[li][:, table].reshape(
+                    cfg.n_kv_heads, S_max, cfg.head_dim
+                )
+                groups = cfg.n_heads // cfg.n_kv_heads
+                qh = q[0].reshape(
+                    t_pad, cfg.n_kv_heads, groups, cfg.head_dim
+                )
+                scores = jnp.einsum(
+                    "tkgd,ksd->tkgs",
+                    qh.astype(jnp.float32),
+                    ks.astype(jnp.float32),
+                ) / jnp.sqrt(cfg.head_dim)
+                causal = jnp.arange(S_max)[None, :] <= pos[:, None]
+                scores = jnp.where(
+                    causal[:, None, None, :], scores, -1e30
+                )
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum(
+                    "tkgs,ksd->tkgd", probs, vs.astype(jnp.float32)
+                ).reshape(t_pad, -1)
+                h = h + (attn[None].astype(cfg.dtype) @ p["wo"])
+                x2 = tfm.rms_norm(h, p["ln2"])
+                y = tfm.swiglu(x2, p["w_gate"], p["w_up"], p["w_down"])
+                return (h + y, pk, pv, li + 1), None
+
+            (h, pool_k, pool_v, _), _ = jax.lax.scan(
+                body,
+                (h, pool_k, pool_v, jnp.int32(0)),
+                params["blocks"],
+            )
+            h = tfm.rms_norm(h, params["ln_f"])
+            logits = (h[0] @ params["head"]).astype(jnp.float32)
+            return logits, pool_k, pool_v
+
         self._decode_step = decode_step
         self._prefill = prefill
+        self._prefill_suffix = prefill_suffix
 
     # ------------------------------------------------------------------
     # scheduler
@@ -415,34 +517,44 @@ class ContinuousBatchingEngine:
             self.queue.popleft()
             prompt = req.prompt
             t = len(prompt)
-            t_pad = max(self.page, -(-t // self.page) * self.page)
-            prompt_pages = t_pad // self.page
-            tokens = np.zeros(t_pad, np.int32)
-            tokens[:t] = prompt
-            logits, self.pool.k, self.pool.v = self._prefill(
-                self.params,
-                self.pool.k,
-                self.pool.v,
-                jnp.asarray(tokens),
-                t_pad,
-                jnp.asarray(pages[:prompt_pages], dtype=jnp.int32),
-            )
-            if req.gen.temperature > 0.0:
-                # same uint32 normalization as the decode path — one key
-                # stream per request across prefill and decode
-                kk = jax.random.fold_in(
-                    jax.random.PRNGKey(
-                        np.uint32(req.gen.seed & 0xFFFFFFFF)
-                    ),
-                    t,
+            # shared prefix cache: restore the longest cached page-aligned
+            # prefix as pinned shm views, capped so the LAST real token
+            # always runs a live forward pass (its logits seed sampling)
+            hit = None
+            if self.prefix_cache is not None and t > 1:
+                hit = self.prefix_cache.lookup(
+                    prompt, max_tokens=((t - 1) // self.page) * self.page
                 )
-                first = int(
-                    jax.random.categorical(
-                        kk, logits[t - 1] / max(req.gen.temperature, 1e-6)
-                    )
-                )
+            table = np.zeros(self.max_pages_per_seq, np.int32)
+            table[: len(pages)] = pages
+            if hit is not None:
+                last_logits = self._admit_with_prefix(req, pages, table, hit)
             else:
-                first = int(np.asarray(jnp.argmax(logits[t - 1])))
+                t_pad = max(self.page, -(-t // self.page) * self.page)
+                prompt_pages = t_pad // self.page
+                tokens = np.zeros(t_pad, np.int32)
+                tokens[:t] = prompt
+                logits, self.pool.k, self.pool.v = self._prefill(
+                    self.params,
+                    self.pool.k,
+                    self.pool.v,
+                    jnp.asarray(tokens),
+                    t_pad,
+                    jnp.asarray(pages[:prompt_pages], dtype=jnp.int32),
+                )
+                last_logits = logits[t - 1]
+            if self.prefix_cache is not None:
+                # publish this prompt's full pages for other replicas
+                # (reads the pool AFTER prefill wrote it — the np gather
+                # below is also what synchronizes the device work)
+                self._prefix_insert(
+                    prompt, pages, hit.tokens if hit is not None else 0
+                )
+            first = self._sample_first(req, last_logits, t)
+            if hit is not None:
+                # np conversions above synced every consumer of the
+                # pinned views; dropping them releases the arena pin
+                hit.release()
             slot.active = True
             slot.req_id = req.req_id
             slot.pos = t
@@ -454,9 +566,8 @@ class ContinuousBatchingEngine:
             slot.pages = pages
             slot.eos = req.gen.eos_token  # parity with LLMEngine.generate_ids
             slot.out = [first]
-            # device state
-            table = np.zeros(self.max_pages_per_seq, np.int32)
-            table[: len(pages)] = pages
+            # device state (table was built before prefill — the suffix
+            # path passes the whole row to its gather)
             self.block_tables = self.block_tables.at[si].set(
                 jnp.asarray(table)
             )
@@ -468,6 +579,79 @@ class ContinuousBatchingEngine:
                 np.uint32(req.gen.seed & 0xFFFFFFFF)
             )
             self._maybe_finish(si)
+
+    def _admit_with_prefix(self, req, pages, table, hit):
+        """Cache-hit admission: copy the pinned KV views into this
+        engine's pool pages and prefill only the suffix. Returns the
+        last real token's logits."""
+        t = len(req.prompt)
+        hist_pages = hit.tokens // self.page
+        dev_pages = jnp.asarray(pages[:hist_pages], dtype=jnp.int32)
+        # jnp.asarray may alias the pinned host view on the CPU backend;
+        # safe because every consumer below is synced before release()
+        self.pool.k = self.pool.k.at[:, :, dev_pages].set(
+            jnp.asarray(np.asarray(hit.k))
+        )
+        self.pool.v = self.pool.v.at[:, :, dev_pages].set(
+            jnp.asarray(np.asarray(hit.v))
+        )
+        suffix = req.prompt[hit.tokens :]
+        ts = len(suffix)
+        t_pad = max(self.page, -(-ts // self.page) * self.page)
+        suffix_pages = t_pad // self.page
+        tokens = np.zeros(t_pad, np.int32)
+        tokens[:ts] = suffix
+        logits, self.pool.k, self.pool.v = self._prefill_suffix(
+            self.params,
+            self.pool.k,
+            self.pool.v,
+            jnp.asarray(tokens),
+            t_pad,
+            jnp.int32(hit.tokens),
+            jnp.asarray(table),
+            jnp.asarray(
+                pages[hist_pages : hist_pages + suffix_pages],
+                dtype=jnp.int32,
+            ),
+        )
+        return logits[ts - 1]
+
+    def _prefix_insert(self, prompt, pages, covered: int) -> None:
+        """Publish the prompt's FULL pages (already in the pool) to the
+        shared cache — skipped when the hit already covered them."""
+        ins = (len(prompt) // self.page) * self.page
+        if ins <= covered or ins == 0:
+            return
+        n_pages = ins // self.page
+        if n_pages > len(pages):
+            return
+        if getattr(self.prefix_cache, "contains_prefix", None) and (
+            self.prefix_cache.contains_prefix(prompt[:ins])
+        ):
+            # already published (hot prompt): skip the device→host KV
+            # gather entirely — it's a blocking sync on the admit path
+            return
+        dev = jnp.asarray(pages[:n_pages], dtype=jnp.int32)
+        k = np.asarray(self.pool.k[:, :, dev])
+        v = np.asarray(self.pool.v[:, :, dev])
+        self.prefix_cache.insert(prompt[:ins], k, v)
+
+    def _sample_first(self, req, last_logits, t: int) -> int:
+        if req.gen.temperature > 0.0:
+            # same uint32 normalization as the decode path — one key
+            # stream per request across prefill and decode
+            kk = jax.random.fold_in(
+                jax.random.PRNGKey(np.uint32(req.gen.seed & 0xFFFFFFFF)),
+                t,
+            )
+            return int(
+                jax.random.categorical(
+                    kk,
+                    jnp.asarray(last_logits)
+                    / max(req.gen.temperature, 1e-6),
+                )
+            )
+        return int(np.asarray(last_logits).argmax())
 
     def _maybe_finish(self, si: int) -> None:
         slot = self.slots[si]
@@ -590,9 +774,12 @@ class ContinuousBatchingEngine:
         return [self.tokenizer.decode(ids) for ids in out]
 
     def stats(self) -> dict:
-        return {
+        out = {
             "free_pages": self.pool.free_pages,
             "total_pages": self.pool.n_pages,
             "active_slots": sum(s.active for s in self.slots),
             "queued": len(self.queue),
         }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out

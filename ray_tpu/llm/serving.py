@@ -4,13 +4,67 @@ Analog of the reference's serve-side LLM deployments (/root/reference/
 python/ray/llm/_internal/serve/): build_llm_deployment returns a Serve
 application whose replicas each hold an engine; requests are
 {"prompt": str, "max_new_tokens"?: int, "temperature"?: float}.
+
+Serving-plane integration (PR 8):
+
+- replicas of a ``continuous`` deployment share prefilled KV through
+  the node's shm arena (:mod:`ray_tpu.serve.prefix_cache`) — a repeated
+  prompt prefix is a pinned read-only view copy-in, not a prefill;
+- streams are **resumable**: generation is per-request deterministic
+  (seeded), so ``stream_to`` honors ``resume_from=n`` by regenerating
+  and skipping the first ``n`` tokens — the router uses this to fail a
+  stream over to another replica mid-flight with no duplicated or lost
+  acked tokens. Caveat: exactness assumes the resumed replica computes
+  the same logits as the original. The cache-hit suffix-prefill kernel
+  and the full-prefill kernel differ in reduction shape, so their
+  logits can differ in the last ulps; if the original and failover
+  replicas take DIFFERENT prefill paths AND a sampled/argmaxed token
+  sits within float epsilon of a tie, the resumed trajectory can
+  diverge. Real models' logit gaps dwarf that epsilon (the chaos
+  suite's token-exact invariant has never tripped on it), but the
+  guarantee is probabilistic at the ulp level, not bitwise;
+- replicas report engine + prefix-cache stats to their node agent
+  (DebugState ``serve`` block) and expose ``serve_stats`` to the
+  router's head reporter (QueryState("serve")).
 """
 from __future__ import annotations
 
+import hashlib
+import os
+import threading
 from typing import Any, Optional
 
 import ray_tpu.serve as serve
 from .engine import GenerationConfig, LLMEngine
+
+
+def _params_sig(model_config: Any, params: Optional[Any], name: str) -> str:
+    """Cheap weight signature for the shared prefix cache: KV computed
+    under different weights must never collide. Hashes the config repr
+    plus a slice of the first parameter leaf (or the default-init
+    marker when params is None)."""
+    h = hashlib.sha256(f"{name}:{model_config}".encode())
+    if params is None:
+        h.update(b"default-init-seed0")
+    else:
+        import jax
+        import numpy as np
+
+        leaves = jax.tree_util.tree_leaves(params)
+        h.update(str(len(leaves)).encode())
+        if leaves:
+            first = np.asarray(leaves[0]).ravel()[:256]
+            h.update(first.tobytes())
+            h.update(str(np.asarray(leaves[0]).shape).encode())
+    return h.hexdigest()[:24]
+
+
+def _gen_from_request(request) -> GenerationConfig:
+    return GenerationConfig(
+        max_new_tokens=int(request.get("max_new_tokens", 32)),
+        temperature=float(request.get("temperature", 0.0)),
+        seed=int(request.get("seed", 0)),
+    )
 
 
 def build_llm_deployment(
@@ -24,35 +78,53 @@ def build_llm_deployment(
     max_batch: int = 8,
     page_size: int = 16,
     n_pages: int = 256,
+    prefix_cache: bool = True,
+    slo: Optional[Any] = None,
 ):
     if engine not in ("dense", "continuous"):
         raise ValueError(
             f"unknown engine {engine!r}; expected 'dense' or 'continuous'"
         )
+    model_sig = _params_sig(model_config, params, name)
 
-    @serve.deployment(name=name, num_replicas=num_replicas)
+    @serve.deployment(
+        name=name,
+        num_replicas=num_replicas,
+        # continuous-engine generation is per-request deterministic
+        # (seeded sampling), so streams can fail over mid-flight
+        resumable_streams=(engine == "continuous"),
+        stats_method="serve_stats",
+        slo=slo,
+    )
     class LLMServer:
         def __init__(self):
             if engine == "continuous":
                 from .continuous import ContinuousBatchingEngine
 
+                cache = None
+                if prefix_cache:
+                    from ray_tpu.serve.prefix_cache import cache_from_cfg
+
+                    cache = cache_from_cfg(
+                        page_size=page_size, model_sig=model_sig
+                    )
                 self.engine = ContinuousBatchingEngine(
                     model_config,
                     params,
                     max_batch=max_batch,
                     page_size=page_size,
                     n_pages=n_pages,
+                    prefix_cache=cache,
                 )
             else:
                 self.engine = LLMEngine(model_config, params, max_len=max_len)
+            self._tokens_out = 0
+            self._start_agent_reporter()
 
+        # -- request surface ---------------------------------------------
         def __call__(self, request):
             prompt = request["prompt"]
-            gen = GenerationConfig(
-                max_new_tokens=int(request.get("max_new_tokens", 32)),
-                temperature=float(request.get("temperature", 0.0)),
-                seed=int(request.get("seed", 0)),
-            )
+            gen = _gen_from_request(request)
             text = self.engine.generate([prompt], gen)[0]
             return {"prompt": prompt, "generated_text": text}
 
@@ -60,42 +132,97 @@ def build_llm_deployment(
             """Generator-based token streaming: call with
             ``.options(num_returns="streaming")`` and iterate the
             ObjectRefGenerator — each decoded token text seals as its own
-            object with normal object-plane semantics (the reference's
-            serve/LLM token streaming rides ObjectRefGenerator the same
-            way; the Channel path below is the lower-latency in-cluster
-            alternative)."""
+            object with normal object-plane semantics."""
             if not hasattr(self.engine, "stream_ids"):
                 raise TypeError(
                     "token streaming requires engine='continuous'"
                 )
-            gen = GenerationConfig(
-                max_new_tokens=int(request.get("max_new_tokens", 32)),
-                temperature=float(request.get("temperature", 0.0)),
-                seed=int(request.get("seed", 0)),
-            )
+            gen = _gen_from_request(request)
             prompt = self.engine.tokenizer.encode(request["prompt"])
             for tok in self.engine.stream_ids(prompt, gen):
                 yield self.engine.tokenizer.decode([int(tok)])
 
         def stream_to(self, writer, request):
-            """HTTP proxy SSE contract: POST /<name>/stream streams decoded
-            token text through a mutable-object Channel (continuous engine
-            only — the dense engine decodes whole batches)."""
+            """Router/ingress streaming contract: decoded token text
+            through a ChannelWriter-compatible handle (shm ring same-host,
+            PushWriter cross-host, relay actor legacy). ``resume_from=n``
+            regenerates deterministically and skips the first n tokens —
+            the router's mid-stream failover path."""
             if not hasattr(self.engine, "stream_ids"):
                 writer.write("streaming requires engine='continuous'")
                 writer.close_channel()
                 return 0
-            gen = GenerationConfig(
-                max_new_tokens=int(request.get("max_new_tokens", 32)),
-                temperature=float(request.get("temperature", 0.0)),
-                seed=int(request.get("seed", 0)),
-            )
+            gen = _gen_from_request(request)
+            skip = max(0, int(request.get("resume_from", 0)))
             prompt = self.engine.tokenizer.encode(request["prompt"])
             n = 0
             for tok in self.engine.stream_ids(prompt, gen):
-                writer.write(self.engine.tokenizer.decode([int(tok)]))
+                if n >= skip:
+                    writer.write(self.engine.tokenizer.decode([int(tok)]))
                 n += 1
+                self._tokens_out += 1
             writer.close_channel()
             return n
+
+        # -- observability -----------------------------------------------
+        def pid(self) -> int:
+            return os.getpid()
+
+        def serve_stats(self) -> dict:
+            stats = (
+                self.engine.stats()
+                if hasattr(self.engine, "stats")
+                else {}
+            )
+            return {
+                "pid": os.getpid(),
+                "tokens_out": self._tokens_out,
+                **stats,
+            }
+
+        def _start_agent_reporter(self) -> None:
+            """Inside a cluster worker: push engine/prefix stats to the
+            node agent so its DebugState grows a ``serve`` block (node-
+            local control-plane traffic, never the head)."""
+            from ray_tpu.cluster import worker as worker_mod
+
+            w = getattr(worker_mod, "_CURRENT_WORKER", None)
+            if w is None or not hasattr(self.engine, "stats"):
+                return
+            # weakref: the reporter must not keep a killed replica's
+            # engine alive (or the thread running) past the actor's
+            # lifetime — a strong capture leaked the whole KV pool per
+            # replica churn and blocked worker scrub/reuse
+            import weakref
+
+            ref = weakref.ref(self)
+
+            def loop():
+                import time as _time
+
+                from ray_tpu.config import cfg
+
+                while True:
+                    _time.sleep(max(0.2, float(cfg.serve_report_period_s)))
+                    inst = ref()
+                    if inst is None:
+                        return  # replica collected: thread retires
+                    try:
+                        w.agent.call(
+                            "ServeStats",
+                            {
+                                "pid": os.getpid(),
+                                "deployment": name,
+                                "stats": inst.serve_stats(),
+                            },
+                            timeout=5.0,
+                        )
+                    except Exception:  # noqa: BLE001 - agent mid-restart
+                        pass
+                    del inst
+
+            threading.Thread(
+                target=loop, name="serve-stats-report", daemon=True
+            ).start()
 
     return LLMServer.bind()

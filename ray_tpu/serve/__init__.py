@@ -7,15 +7,22 @@ a controller loop autoscales replica counts toward
 target_ongoing_requests (autoscaling_policy.py:296); an optional HTTP proxy
 maps POST /<name> onto handles (proxy.py).
 """
+from .admission import (  # noqa: F401
+    AdmissionController,
+    Overloaded,
+)
 from .deployment import (  # noqa: F401
     Application,
     Deployment,
     DeploymentHandle,
     deployment,
     get_deployment_handle,
+    get_router,
     run,
     shutdown,
     start_grpc_ingress,
     start_http_proxy,
     start_proto_grpc_ingress,
 )
+from .router import RoutedStream, ServeRouter  # noqa: F401
+from .slo_autoscaler import SLOAutoscaler, SLOConfig  # noqa: F401

@@ -29,6 +29,16 @@ class Deployment:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     max_ongoing_requests: int = 100
     autoscaling_config: Optional[AutoscalingConfig] = None
+    # serving-plane contract flags: resumable_streams declares that
+    # ``stream_to`` regenerates deterministically and honors
+    # ``resume_from`` (the router may fail a stream over mid-flight);
+    # stats_method names a replica method the router's reporter may
+    # call for engine-level stats (e.g. prefix-cache hit rate); slo
+    # attaches an SLOConfig-driven autoscaler instead of the legacy
+    # ongoing-count tick
+    resumable_streams: bool = False
+    stats_method: Optional[str] = None
+    slo: Optional[Any] = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -41,6 +51,9 @@ class Deployment:
             dict(self.ray_actor_options),
             self.max_ongoing_requests,
             self.autoscaling_config,
+            self.resumable_streams,
+            self.stats_method,
+            self.slo,
         )
         for k, v in overrides.items():
             setattr(d, k, v)
@@ -111,6 +124,10 @@ class _ReplicaSet:
             if self.dep.autoscaling_config
             else self.dep.num_replicas
         )
+        # desired active-replica count: autoscaling moves it; replica
+        # DEATH does not (the set backfills toward it)
+        self.target = n0
+        self.backfills = 0
         for _ in range(n0):
             self._add_replica()
 
@@ -132,6 +149,78 @@ class _ReplicaSet:
         )
         with self.lock:
             self.replicas.append(_Replica(actor))
+
+    def add_replica(self) -> None:
+        """Scale up by one (autoscaler-facing): raises the desired count
+        and creates the replica (the head scheduler places it)."""
+        with self.lock:
+            self.target += 1
+        self._add_replica()
+
+    def drain_one_replica(self) -> None:
+        """Scale down by one with graceful drain (autoscaler-facing)."""
+        with self.lock:
+            self.target = max(1, self.target - 1)
+        self._drain_one_replica()
+
+    def note_replica_death(self, replica: "_Replica") -> None:
+        """A replica's actor died (router dispatch/stream failure or the
+        controller's liveness probe): drop it from routing immediately
+        and backfill toward the desired count."""
+        with self.lock:
+            if replica not in self.replicas:
+                return  # already reaped by a concurrent path
+            self.replicas.remove(replica)
+            need = (
+                not replica.draining
+                and not self._closed
+                and len([r for r in self.replicas if not r.draining])
+                < self.target
+            )
+        try:
+            ray_tpu.kill(replica.actor)  # idempotent corpse cleanup
+        except Exception:  # noqa: BLE001
+            pass
+        if need:
+            self.backfills += 1
+            self._add_replica()
+
+    def reap_dead_replicas(self) -> int:
+        """Controller-driven liveness sweep: probe each replica's actor
+        state and reap the dead ones (detection without traffic, so a
+        SIGKILLed idle replica still backfills). Control-plane cadence —
+        never on the request path."""
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            rt = get_runtime()
+        except Exception:  # noqa: BLE001
+            return 0
+        with self.lock:
+            snapshot = list(self.replicas)
+        reaped = 0
+        for replica in snapshot:
+            dead = False
+            aid = getattr(replica.actor, "_actor_id", None)
+            if aid is None:
+                continue
+            if getattr(rt, "is_remote", False):
+                try:
+                    info = rt._read(
+                        "WaitActor", {"actor_id": aid, "timeout": 0.01}
+                    )
+                    dead = info.state == "DEAD"
+                except Exception:  # noqa: BLE001 - head busy: skip sweep
+                    continue
+            else:
+                state = rt._actors.get(aid)
+                dead = state is not None and getattr(
+                    state, "dead_forever", False
+                )
+            if dead:
+                self.note_replica_death(replica)
+                reaped += 1
+        return reaped
 
     def _drain_one_replica(self):
         """Downscale with drain: stop routing to one idle replica and kill
@@ -158,6 +247,14 @@ class _ReplicaSet:
         cands = [r for r in self.replicas if not r.draining]
         if not cands:
             cands = list(self.replicas)
+        if not cands:
+            # reachable since note_replica_death removes replicas: the
+            # window between removing the last corpse and its backfill
+            # registering must surface as a clear, retryable error
+            raise RuntimeError(
+                f"no live replicas for deployment {self.dep.name!r} "
+                "(death backfill in progress)"
+            )
         if prefer is not None:
             # affinity (e.g. same-host pinning for shm streaming):
             # restrict to preferred replicas when any exist. strict means
@@ -177,12 +274,27 @@ class _ReplicaSet:
 
     def submit(self, method: str, args, kwargs, prefer=None,
                strict_prefer=False):
+        ref, _ = self.submit_traced(
+            method, args, kwargs, prefer, strict_prefer
+        )
+        return ref
+
+    def submit_traced(self, method: str, args, kwargs, prefer=None,
+                      strict_prefer=False):
+        """Like ``submit`` but also returns the chosen replica — the
+        serving router needs it for failover bookkeeping and
+        lease-channel accounting."""
         with self.lock:
             replica = self._pick_replica(prefer, strict_prefer)
             replica.ongoing += 1
             self.total_requests += 1
             actor = replica.actor
-        ref = getattr(actor, method).remote(*args, **kwargs)
+        try:
+            ref = getattr(actor, method).remote(*args, **kwargs)
+        except BaseException:
+            with self.lock:
+                replica.ongoing -= 1
+            raise
         with self._watch_cv:
             self._outstanding.append((ref, replica))
             if self._watcher is None or not self._watcher.is_alive():
@@ -193,7 +305,7 @@ class _ReplicaSet:
                 )
                 self._watcher.start()
             self._watch_cv.notify()
-        return ref
+        return ref, replica
 
     class _StreamRequest:
         """Iterator over a streaming replica call that releases the
@@ -314,9 +426,9 @@ class _ReplicaSet:
             n = len(active)
             avg = sum(r.ongoing for r in active) / max(1, n)
         if avg > cfg.target_ongoing_requests and n < cfg.max_replicas:
-            self._add_replica()
+            self.add_replica()
         elif avg < cfg.target_ongoing_requests / 2 and n > cfg.min_replicas:
-            self._drain_one_replica()
+            self.drain_one_replica()
 
     def close(self):
         with self._watch_cv:
@@ -351,19 +463,30 @@ class DeploymentHandle:
 
 
 _apps: Dict[str, _ReplicaSet] = {}
+_routers: Dict[str, Any] = {}
+_autoscalers: Dict[str, Any] = {}
 _controller_thread: Optional[threading.Thread] = None
 _controller_stop = threading.Event()
 _http_server = None
 
 
 def _controller_loop():
-    """ServeController reconcile loop (controller.py:121 analog)."""
+    """ServeController reconcile loop (controller.py:121 analog):
+    legacy autoscale ticks plus a ~1s replica liveness sweep so dead
+    replicas backfill even with no traffic hitting them."""
+    ticks = 0
     while not _controller_stop.wait(0.25):
+        ticks += 1
         for rs in list(_apps.values()):
             try:
                 rs.autoscale_tick()
             except Exception:  # noqa: BLE001
                 pass
+            if ticks % 4 == 0:
+                try:
+                    rs.reap_dead_replicas()
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
@@ -373,6 +496,30 @@ def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
         return DeploymentHandle(_apps[key])
     rs = _ReplicaSet(app)
     _apps[key] = rs
+    # the serving router (lease-routed ingress path): created alongside
+    # every deployment; ingresses and handles that want admission/
+    # streaming/failover semantics go through it via get_router()
+    from .router import ServeRouter
+
+    router = ServeRouter(rs)
+    _routers[key] = router
+    # deployments that declare a stats method (e.g. the LLM servers'
+    # serve_stats: engine + prefix-cache counters) get it sampled into
+    # the head report, so QueryState("serve") carries engine state too
+    extra_stats_fn = None
+    if app.deployment.stats_method:
+        method = app.deployment.stats_method
+
+        def extra_stats_fn(_rs=rs, _method=method):
+            return ray_tpu.get(_rs.submit(_method, (), {}), timeout=5.0)
+
+    router.start_reporting(extra_stats_fn)
+    if app.deployment.slo is not None:
+        from .slo_autoscaler import SLOAutoscaler
+
+        scaler = SLOAutoscaler(router, app.deployment.slo)
+        scaler.start()
+        _autoscalers[key] = scaler
     if _controller_thread is None or not _controller_thread.is_alive():
         _controller_stop.clear()
         _controller_thread = threading.Thread(
@@ -386,9 +533,24 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(_apps[name])
 
 
+def get_router(name: str):
+    """The deployment's ServeRouter (admission + lease-routed dispatch +
+    push-plane streaming)."""
+    return _routers[name]
+
+
 def shutdown() -> None:
     global _http_server, _grpc_server, _proto_grpc_server
     _controller_stop.set()
+    for scaler in _autoscalers.values():
+        scaler.stop()
+    _autoscalers.clear()
+    for router in _routers.values():
+        router.close()
+    _routers.clear()
+    from .router import shutdown_sink
+
+    shutdown_sink()
     for rs in _apps.values():
         rs.close()
         for replica in list(rs.replicas):

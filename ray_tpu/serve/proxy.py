@@ -29,11 +29,16 @@ import ray_tpu
 
 
 class _StreamRelayActor:
-    """Cross-host bridge for SSE streaming. The shm ring Channel is
-    same-host-only; when every replica of a deployment lives on another
-    host, the replica's writer pushes token batches through this actor as
-    ordinary (cross-node) actor calls and the proxy long-polls ``pop``.
-    Sequence numbers restore order (async actor methods may interleave)."""
+    """DEPRECATED polling bridge for cross-host streaming, kept only as
+    the ``RAY_TPU_SERVE_PUSH_STREAMS=0`` fallback: the default cross-host
+    transport is the router's :class:`~ray_tpu.serve.router.StreamSink`
+    push plane (replica pushes straight to the ingress process — no
+    relay actor, no ``pop`` long-poll). Where the relay remains, its
+    buffers are hard-bounded and ``cancel`` propagates client
+    disconnects back to the writer so an abandoned stream stops
+    generating instead of running to completion."""
+
+    MAX_STASH = 1024  # out-of-order reassembly bound (seq -> batch)
 
     def __init__(self, max_buffer: int = 4096):
         from collections import deque
@@ -42,6 +47,7 @@ class _StreamRelayActor:
         self._next_seq = 0
         self._out = deque()
         self._closed = False
+        self._cancelled = False
         self._max = max_buffer
         self._event = None  # created lazily on the actor's event loop
 
@@ -53,15 +59,23 @@ class _StreamRelayActor:
         return self._event
 
     async def push(self, seq: int, items: list, closed: bool = False) -> int:
-        """Returns the current queue depth. Backpressure is writer-side
+        """Returns the current queue depth, or -1 once the consumer
+        cancelled (the writer must stop). Backpressure is writer-side
         (throttle on the returned depth) — parking here would hold the
         actor's concurrency slots and starve pop(). A writer that ignores
-        the depth contract hits the hard bound below: the push fails, the
-        stream dies, memory stays bounded."""
+        the depth contract hits the hard bounds below: the push fails,
+        the stream dies, memory stays bounded."""
+        if self._cancelled:
+            return -1
         if len(self._out) > 4 * self._max and not closed:
             raise BufferError(
                 "stream relay buffer overrun (consumer stalled and the "
                 "writer ignored backpressure)"
+            )
+        if len(self._stash) > self.MAX_STASH:
+            raise BufferError(
+                "stream relay reassembly overrun (sequence gap never "
+                "filled while the writer kept pushing)"
             )
         self._stash[seq] = (items, closed)
         while self._next_seq in self._stash:
@@ -73,8 +87,17 @@ class _StreamRelayActor:
         self._ev().set()
         return len(self._out)
 
+    async def cancel(self) -> None:
+        """Client disconnected: drop buffered items and tell the writer
+        (via the -1 push reply) to abandon generation."""
+        self._cancelled = True
+        self._closed = True
+        self._out.clear()
+        self._stash.clear()
+        self._ev().set()
+
     async def depth(self) -> int:
-        return len(self._out)
+        return -1 if self._cancelled else len(self._out)
 
     async def pop(self, max_items: int = 256, timeout: float = 5.0):
         """Returns (items, ended). ended only once the queue is drained."""
@@ -105,16 +128,22 @@ class _RelayWriter:
     def write(self, value, timeout=None) -> None:
         import time as _time
 
+        from ray_tpu.experimental import ChannelClosed
+
         ref = self._actor.push.remote(self._seq, [value])
         self._seq += 1
         self._pending.append(ref)
         if len(self._pending) > 32:
             depth = ray_tpu.get(self._pending.pop(0), timeout=30)
+            if depth < 0:  # consumer cancelled: abandon generation
+                raise ChannelClosed("consumer cancelled the stream")
             # a stalled consumer shows up as queue depth: throttle here
             # (writer-side) instead of parking inside the actor
             while depth > 4096:
                 _time.sleep(0.05)
                 depth = ray_tpu.get(self._actor.depth.remote(), timeout=30)
+                if depth < 0:
+                    raise ChannelClosed("consumer cancelled the stream")
 
     def close_channel(self) -> None:
         refs = self._pending + [self._actor.push.remote(self._seq, [], True)]
@@ -290,6 +319,9 @@ class ServeProxy:
     async def _call(self, request):
         from aiohttp import web
 
+        from .admission import Overloaded
+        from .deployment import _routers
+
         name = request.match_info["deployment"]
         rs = self._apps.get(name)
         if rs is None:
@@ -305,19 +337,38 @@ class ServeProxy:
                     {"error": "body must be JSON"}, status=400
                 )
         loop = asyncio.get_running_loop()
+        tenant = request.headers.get("X-Serve-Tenant", "default")
+        router = _routers.get(name)
         try:
-            ref = rs.submit("__call__", (payload,), {})
-            result = await loop.run_in_executor(
-                self._pool, lambda: ray_tpu.get(ref, timeout=60)
-            )
+            if router is not None:
+                # admission + p2c + direct-channel dispatch + metrics
+                req = await loop.run_in_executor(
+                    self._pool, lambda: router.submit(payload, tenant)
+                )
+                result = await loop.run_in_executor(
+                    self._pool, lambda: req.result(60)
+                )
+            else:
+                ref = rs.submit("__call__", (payload,), {})
+                result = await loop.run_in_executor(
+                    self._pool, lambda: ray_tpu.get(ref, timeout=60)
+                )
             return web.json_response({"result": result})
+        except Overloaded as exc:
+            return web.json_response(
+                {"error": str(exc), "reason": exc.reason},
+                status=503,
+                headers={"Retry-After": f"{exc.retry_after_s:.2f}"},
+            )
         except Exception as exc:  # noqa: BLE001 - errors are responses
             return web.json_response({"error": repr(exc)}, status=500)
 
     async def _stream(self, request):
         from aiohttp import web
 
-        from ray_tpu.experimental import ChannelClosed
+        from .admission import Overloaded
+        from .deployment import _routers
+        from .router import ChannelClosed as RoutedClosed
 
         name = request.match_info["deployment"]
         rs = self._apps.get(name)
@@ -333,6 +384,27 @@ class ServeProxy:
                 return web.json_response(
                     {"error": "body must be JSON"}, status=400
                 )
+        loop = asyncio.get_running_loop()
+        tenant = request.headers.get("X-Serve-Tenant", "default")
+        router = _routers.get(name)
+        if router is None:
+            return web.json_response(
+                {"error": "deployment has no router"}, status=500
+            )
+        # admission BEFORE the SSE response exists: overload is a real
+        # 503 with Retry-After, not an error event on an accepted stream
+        try:
+            stream = await loop.run_in_executor(
+                self._pool, lambda: router.stream(payload, tenant)
+            )
+        except Overloaded as exc:
+            return web.json_response(
+                {"error": str(exc), "reason": exc.reason},
+                status=503,
+                headers={"Retry-After": f"{exc.retry_after_s:.2f}"},
+            )
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response({"error": repr(exc)}, status=500)
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -340,69 +412,34 @@ class ServeProxy:
             }
         )
         await resp.prepare(request)
-        loop = asyncio.get_running_loop()
-        # transport selection + dispatch: shm ring when a same-host
-        # replica exists, relay actor otherwise — blocking work, so it
-        # runs on the pool; any failure becomes an SSE error event
-        try:
-            ch, relay_actor, reader, ref = await loop.run_in_executor(
-                self._pool, self._start_stream, rs, payload
-            )
-        except Exception as exc:  # noqa: BLE001 - errors are events
-            await resp.write(
-                f"event: error\ndata: {json.dumps(repr(exc))}\n\n".encode()
-            )
-            await resp.write_eof()
-            return resp
         q: asyncio.Queue = asyncio.Queue()
         _END, _ERR = object(), object()
-        # bounded handoff: a stalled HTTP client must throttle the relay,
-        # which stops draining the ring, which blocks the replica's
-        # writer — end-to-end backpressure instead of unbounded proxy RSS
+        # bounded handoff: a stalled HTTP client must throttle the relay
+        # thread, which stops draining the transport, which backpressures
+        # the replica's writer — end-to-end instead of unbounded RSS
         credits = threading.Semaphore(64)
         dead = threading.Event()
 
-        def relay(ref) -> None:
-            """Dedicated per-stream thread: blocking channel reads never
-            occupy the shared unary-call pool (32 long streams would
-            otherwise starve every other request)."""
-            from ray_tpu import GetTimeoutError
+        def relay() -> None:
+            """Dedicated per-stream thread: RoutedStream.read handles
+            transport waits, replica probing, and mid-stream failover;
+            blocking reads never occupy the shared unary-call pool."""
 
             def emit(kind, value=None):
                 while not credits.acquire(timeout=1.0):
                     if dead.is_set():
-                        raise ChannelClosed("consumer gone")
+                        raise RoutedClosed("consumer gone")
                 loop.call_soon_threadsafe(q.put_nowait, (kind, value))
 
             try:
                 while True:
                     try:
-                        value = reader.read(timeout=5)
-                    except ChannelClosed:
+                        value = stream.read(timeout=300.0)
+                    except RoutedClosed:
                         emit(_END)
                         return
-                    except TimeoutError:
-                        # stalled: is the replica still running?
-                        try:
-                            ray_tpu.get(ref, timeout=0.1)
-                        except GetTimeoutError:
-                            continue  # still running; keep waiting
-                        except BaseException as exc:  # noqa: BLE001
-                            emit(_ERR, repr(exc))  # replica raised
-                            return
-                        # method returned: drain the tail the replica may
-                        # have written between our timeout and the probe
-                        try:
-                            while True:
-                                emit("data", reader.read(timeout=0.5))
-                        except ChannelClosed:
-                            emit(_END)
-                        except TimeoutError:
-                            emit(
-                                _ERR,
-                                "stream_to returned without "
-                                "close_channel()",
-                            )
+                    except BaseException as exc:  # noqa: BLE001
+                        emit(_ERR, repr(exc))
                         return
                     emit("data", value)
             except BaseException as exc:  # noqa: BLE001
@@ -411,7 +448,7 @@ class ServeProxy:
 
         try:
             threading.Thread(
-                target=relay, args=(ref,), name="sse-relay", daemon=True
+                target=relay, name="sse-relay", daemon=True
             ).start()
             while True:
                 kind, value = await q.get()
@@ -432,13 +469,10 @@ class ServeProxy:
             )
         finally:
             dead.set()
-            if ch is not None:
-                ch.destroy()
-            if relay_actor is not None:
-                try:
-                    ray_tpu.kill(relay_actor)
-                except Exception:  # noqa: BLE001
-                    pass
+            # close() cancels the transport (sink discard / ring destroy
+            # / relay cancel), so a disconnected client's replica stops
+            # generating instead of running to completion
+            stream.close()
         await resp.write_eof()
         return resp
 
