@@ -265,6 +265,16 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 - hygiene, never fatal
             logger.debug("orphan store sweep failed", exc_info=True)
         try:
+            # same hygiene for DAG/pipeline ring files: a SIGKILLed
+            # producer or consumer never reaches its unlink
+            from ray_tpu.dag.channel import sweep_orphan_rings
+
+            swept = sweep_orphan_rings()
+            if swept:
+                logger.info("swept %d orphaned ring files", len(swept))
+        except Exception:  # noqa: BLE001 - hygiene, never fatal
+            logger.debug("orphan ring sweep failed", exc_info=True)
+        try:
             from ray_tpu.native import NativeObjectStore
 
             inner = NativeObjectStore(
@@ -328,6 +338,12 @@ class NodeAgent:
             ),
             "DagTeardown": lambda r: self._forward_to_actor_worker(
                 "DagTeardown", r
+            ),
+            "PipelineInstall": lambda r: self._forward_to_actor_worker(
+                "PipelineInstall", r
+            ),
+            "PipelineTeardown": lambda r: self._forward_to_actor_worker(
+                "PipelineTeardown", r
             ),
             "Shutdown": self._h_shutdown,
             "DebugState": self._h_debug_state,
@@ -2508,11 +2524,17 @@ class NodeAgent:
     def _h_debug_state(self, req=None) -> dict:
         """Operator/debugging introspection (node_manager DebugString
         analog, node_manager.cc HandleGetNodeStats)."""
+        from .event_loop import hotpath_state
+
+        hotpath = hotpath_state()
         with self._lock:
             hits = self.pool_stats["hits"]
             misses = self.pool_stats["misses"]
             total = hits + misses
             return {
+                # execution-plane hot path (this agent process's view:
+                # wire counters, ring fills of co-resident channels)
+                "hotpath": hotpath,
                 "task_buf": [s.task_id for s, _ in self._task_buf],
                 "dep_waiting": {
                     t: sorted(m) for t, (s, m) in self._dep_waiting.items()

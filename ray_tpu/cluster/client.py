@@ -58,7 +58,12 @@ LEASE_SPILLBACKS = _Counter(
     "recall, or worker rejection).",
 )
 
+from .common import DISPATCH_OVERHEAD_US, dispatch_sampled as _sampled
+
 _BY_VALUE_REGISTERED: set = set()
+
+# precomputed frame for argless submissions (see RemoteRuntime.submit)
+_EMPTY_ARGS_PAYLOAD: bytes = wire.dumps(((), {}))
 
 
 def _ship_module_by_value(obj: Any) -> None:
@@ -211,7 +216,15 @@ class _DirectActorChannel:
     directory. On any transport failure the channel drains its queue back
     through the head-scheduled lease path (which owns restart semantics);
     a batch that died mid-flight may re-execute (at-least-once, like the
-    reference's actor task retries)."""
+    reference's actor task retries).
+
+    Scheduling: this channel is a SOURCE on the runtime's fused event
+    loop — ``step`` forms whole windows and offloads the blocking RPC to
+    the shared sender pool (at most one action in flight per channel, so
+    per-actor ordering is preserved); there is no per-channel thread.
+    Worker resolution (a rare multi-RPC dance that can legitimately
+    block for a minute on a pending actor) runs on its own short-lived
+    thread so it can never starve the sender pool."""
 
     MAX_BATCH = 256
 
@@ -221,34 +234,51 @@ class _DirectActorChannel:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._dead = False
+        self._busy = False  # an action is in flight on the sender pool
         self._accepted: Dict[str, dict] = {}  # ref hex -> item (unresolved)
         self._worker: Optional[RpcClient] = None
-        self._thread = threading.Thread(
-            target=self._loop, name=f"direct-{actor_id[:6]}", daemon=True
-        )
-        self._thread.start()
+        self._last_progress = time.monotonic()
+        self._loop = runtime._hotloop
+        if not self._loop.register(self):
+            # loop stopped (shutdown race): this channel can never be
+            # scheduled — born dead, every submit falls back to the head
+            self._dead = True
+            return
+        threading.Thread(
+            target=self._run_resolve,
+            name=f"direct-resolve-{actor_id[:6]}",
+            daemon=True,
+        ).start()
 
     def submit(self, item: dict) -> None:
         with self._cv:
             if not self._dead:
                 self._q.append(item)
-                self._cv.notify()
-                return
+                accepted = True
+            else:
+                accepted = False
+        if accepted:
+            self._loop.wake(self)
+            return
         # fallback OUTSIDE self._cv: _fallback_submit takes the runtime's
-        # _direct_cv, and _h_direct_results holds _direct_cv while calling
+        # _direct_cv, and result delivery holds _direct_cv while calling
         # on_result — nesting here would be an AB-BA deadlock
         self._rt._fallback_submit(item)
 
     def submit_many(self, items: List[dict]) -> None:
-        """Window submission: one lock pass + one sender wakeup for a
-        whole batch of calls (the Data executor dispatches per-actor
-        block windows through here — per-item notify overhead was a
-        measurable slice of the 50k-block submit path)."""
+        """Window submission: one lock pass + ONE loop wake for a whole
+        batch of calls (the Data executor dispatches per-actor block
+        windows through here — per-item notify overhead was a measurable
+        slice of the 50k-block submit path)."""
         with self._cv:
             if not self._dead:
                 self._q.extend(items)
-                self._cv.notify()
-                return
+                accepted = True
+            else:
+                accepted = False
+        if accepted:
+            self._loop.wake(self)
+            return
         for item in items:
             self._rt._fallback_submit(item)
 
@@ -256,6 +286,7 @@ class _DirectActorChannel:
         # single GIL-atomic pop; deliberately lock-free (callers hold the
         # runtime's _direct_cv — see submit() ordering note)
         self._accepted.pop(ref_hex, None)
+        self._last_progress = time.monotonic()
 
     def _resolve_worker(self) -> Optional[RpcClient]:
         handle = RemoteActorHandle(self._rt, self.actor_id, object)
@@ -266,73 +297,106 @@ class _DirectActorChannel:
         )
         return RpcClient(reply["address"])
 
-    def _loop(self) -> None:
-        import logging
-
-        log = logging.getLogger("ray_tpu.cluster.client")
+    def _run_resolve(self) -> None:
         try:
-            self._worker = self._resolve_worker()
+            worker = self._resolve_worker()
         except BaseException as exc:  # noqa: BLE001
-            log.info(
+            logger.info(
                 "direct channel to %s unavailable (%r); using head path",
                 self.actor_id[:8],
                 exc,
             )
             self._fail_over()
             return
-        idle_checks = 0.0
-        while True:
-            with self._cv:
-                while not self._q and not self._dead:
-                    self._cv.wait(timeout=1.0)
-                    # watchdog: accepted-but-unresolved items + silent
-                    # worker means the worker may have died mid-call
-                    if self._accepted and not self._q:
-                        idle_checks += 1.0
-                        if idle_checks >= 2.0:
-                            break
-                if self._dead:
-                    return
-                batch = []
+        with self._cv:
+            self._worker = worker
+        self._loop.wake(self)
+
+    def step(self, now: float) -> Optional[float]:
+        """Fused-loop callback: drain the queue into one window, or probe
+        a silent worker that owes results. Non-blocking by contract."""
+        batch: List[dict] = []
+        action = None
+        with self._cv:
+            if self._dead:
+                return None
+            if self._busy or self._worker is None:
+                return None  # completion/resolve wakes us
+            if self._q:
                 while self._q and len(batch) < self.MAX_BATCH:
                     batch.append(self._q.popleft())
-                if batch:
-                    for it in batch:
-                        self._accepted[it["ref"]] = it
-            try:
-                if batch:
-                    # strip client-local fields (e.g. the live arg refs kept
-                    # to pin args until completion) from the wire items
-                    wire = [
-                        {k: v for k, v in it.items() if not k.startswith("_")}
-                        for it in batch
-                    ]
-                    accepts = self._worker.call(
-                        "DirectPushBatch",
-                        {
-                            "client_addr": self._rt._callback_address(),
-                            "items": wire,
-                        },
-                        timeout=60.0,
-                    )
-                    done = []
-                    for it, status in zip(batch, accepts):
-                        if isinstance(status, dict):
-                            # fast path: the result rode the accept reply
-                            done.append(status["done"])
-                        elif status != "accepted":
-                            with self._cv:
-                                self._accepted.pop(it["ref"], None)
-                            self._rt._fallback_submit(it)
-                    if done:
-                        self._rt._h_direct_results(done)
-                else:
-                    # idle probe of a worker that owes us results
-                    self._worker.call("Ping", timeout=5.0)
-                    idle_checks = 0.0
-            except RpcError:
-                self._fail_over(batch)
-                return
+                for it in batch:
+                    self._accepted[it["ref"]] = it
+                action = "send"
+                self._busy = True
+            elif self._accepted and now - self._last_progress >= 2.0:
+                # watchdog: accepted-but-unresolved items + silent worker
+                # means the worker may have died mid-call
+                action = "probe"
+                self._busy = True
+            owed = bool(self._accepted)
+        if action == "send":
+            self._loop.note_batch(len(batch))
+            if not self._loop.offload(self, self._run_send, batch):
+                # pool gone (shutdown): hand the window back to the
+                # queue front so nothing is stranded as accepted-but-
+                # never-sent
+                with self._cv:
+                    for it in reversed(batch):
+                        self._accepted.pop(it["ref"], None)
+                        self._q.appendleft(it)
+                    self._busy = False
+            return None
+        if action == "probe":
+            if not self._loop.offload(self, self._run_probe):
+                with self._cv:
+                    self._busy = False
+            return None
+        return now + 2.0 if owed else None
+
+    def _run_send(self, batch: List[dict]) -> None:
+        try:
+            # strip client-local fields (e.g. the live arg refs kept
+            # to pin args until completion) from the wire items
+            wire = [
+                {k: v for k, v in it.items() if not k.startswith("_")}
+                for it in batch
+            ]
+            accepts = self._worker.call(
+                "DirectPushBatch",
+                {
+                    "client_addr": self._rt._callback_address(),
+                    "items": wire,
+                },
+                timeout=60.0,
+            )
+            done = []
+            for it, status in zip(batch, accepts):
+                if isinstance(status, dict):
+                    # fast path: the result rode the accept reply
+                    done.append(status["done"])
+                elif status != "accepted":
+                    with self._cv:
+                        self._accepted.pop(it["ref"], None)
+                    self._rt._fallback_submit(it)
+            if done:
+                self._rt._process_direct_results(done)
+        except RpcError:
+            self._fail_over(batch)
+            return
+        finally:
+            with self._cv:
+                self._busy = False
+
+    def _run_probe(self) -> None:
+        try:
+            self._worker.call("Ping", timeout=5.0)
+            self._last_progress = time.monotonic()
+        except RpcError:
+            self._fail_over()
+        finally:
+            with self._cv:
+                self._busy = False
 
     def _fail_over(self, batch: Optional[list] = None) -> None:
         """Worker unreachable: everything unresolved re-routes through the
@@ -343,6 +407,7 @@ class _DirectActorChannel:
             self._accepted.clear()
             queued = list(self._q)
             self._q.clear()
+        self._loop.unregister(self)
         seen = set()
         for it in (batch or []) + items + queued:
             if it["ref"] not in seen:
@@ -353,7 +418,7 @@ class _DirectActorChannel:
     def stop(self) -> None:
         with self._cv:
             self._dead = True
-            self._cv.notify_all()
+        self._loop.unregister(self)
 
 
 class _TaskLeaseChannel:
@@ -400,6 +465,7 @@ class _TaskLeaseChannel:
         self._cv = threading.Condition()
         self.dead = False
         self._stalled = False
+        self._busy = False  # an action is in flight on the sender pool
         now = time.monotonic()
         self._last_activity = now
         self._last_send = now
@@ -408,11 +474,11 @@ class _TaskLeaseChannel:
         self._last_renew = now
         with runtime._lock:
             runtime._direct_channels[self.key] = self
-        self._thread = threading.Thread(
-            target=self._loop, name=f"lease-chan-{self.lease_id[:6]}",
-            daemon=True,
-        )
-        self._thread.start()
+        self._loop = runtime._hotloop
+        if not self._loop.register(self):
+            # loop stopped (shutdown race): born dead — submits spill to
+            # head scheduling, the manager prunes dead channels
+            self.dead = True
 
     # lock-free reads (GIL-atomic lens): the manager's pick runs per
     # submission and must not serialize on the channel lock
@@ -427,10 +493,14 @@ class _TaskLeaseChannel:
             if not self.dead:
                 self._q.append(item)
                 self._last_activity = time.monotonic()
-                self._cv.notify()
-                return
+                accepted = True
+            else:
+                accepted = False
+        if accepted:
+            self._loop.wake(self)
+            return
         # spill OUTSIDE self._cv (lock order: runtime._direct_cv may be
-        # taken inside _lease_spill; _h_direct_results holds _direct_cv
+        # taken inside _lease_spill; result delivery holds _direct_cv
         # while calling on_result, which takes self._cv)
         self._rt._lease_spill(item)
 
@@ -444,6 +514,8 @@ class _TaskLeaseChannel:
             self._last_activity = now
             self._stalled = False  # results flow again
             self._cv.notify()
+        # a freed pipeline slot may unblock the next window
+        self._loop.wake(self)
 
     def take_inflight(self, ref_hex: str) -> Optional[dict]:
         """Pop one in-flight item (worker handed it back never-started);
@@ -452,7 +524,9 @@ class _TaskLeaseChannel:
             item = self._inflight.pop(ref_hex, None)
             if item is not None:
                 self._cv.notify()
-            return item
+        if item is not None:
+            self._loop.wake(self)
+        return item
 
     def cancel(self, ref_hex: str) -> bool:
         """Best-effort cancel of a not-yet-running leased task: local
@@ -497,54 +571,46 @@ class _TaskLeaseChannel:
             return False
         return bool(reply.get("ok"))
 
-    def _loop(self) -> None:
-        rt = self._rt
-        while True:
-            action = None
-            batch: List[dict] = []
-            with self._cv:
-                while action is None:
-                    if self.dead:
-                        return
-                    now = time.monotonic()
-                    window = self.max_inflight - len(self._inflight)
-                    if self._q and window > 0 and not self._stalled:
-                        action = "send"
-                        break
-                    if not self._q and not self._inflight:
-                        if now - self._last_activity > self.ttl:
-                            self.dead = True
-                            action = "retire"
-                            break
-                    elif self._inflight:
-                        quiet = now - max(self._last_result, self._last_send)
-                        # stall budget scales with the outstanding window
-                        # (~stall_s of sequential execution per owed
-                        # task, capped): a deep pipeline draining slowly
-                        # on a loaded host is NOT a wedge — a flat
-                        # threshold spilled flowing work in cascades —
-                        # while a blocked head-of-line with a few
-                        # followers (rendezvous peers) still recalls in
-                        # a few seconds
-                        budget = min(
-                            self._stall_s * max(1, len(self._inflight)),
-                            10.0 * self._stall_s,
-                        )
-                        if quiet > budget and (
-                            len(self._inflight) > 1 or self._q
-                        ):
-                            action = "recall"
-                            break
-                        if (
-                            quiet > 5.0
-                            and now - self._last_probe > 5.0
-                        ):
-                            action = "probe"
-                            break
-                    if self._renew_due(now):
-                        action = "renew"
-                        break
-                    self._cv.wait(timeout=0.25)
+    def step(self, now: float) -> Optional[float]:
+        """Fused-loop callback: inspect state, pick ONE action (send a
+        whole window / retire / recall / probe / renew), and offload its
+        blocking RPC to the sender pool. At most one action in flight per
+        channel — the per-lease ordering the worker FIFO expects."""
+        action = None
+        batch: List[dict] = []
+        with self._cv:
+            if self.dead:
+                return None
+            if not self._busy:
+                window = self.max_inflight - len(self._inflight)
+                if self._q and window > 0 and not self._stalled:
+                    action = "send"
+                elif not self._q and not self._inflight:
+                    if now - self._last_activity > self.ttl:
+                        self.dead = True
+                        action = "retire"
+                elif self._inflight:
+                    quiet = now - max(self._last_result, self._last_send)
+                    # stall budget scales with the outstanding window
+                    # (~stall_s of sequential execution per owed
+                    # task, capped): a deep pipeline draining slowly
+                    # on a loaded host is NOT a wedge — a flat
+                    # threshold spilled flowing work in cascades —
+                    # while a blocked head-of-line with a few
+                    # followers (rendezvous peers) still recalls in
+                    # a few seconds
+                    budget = min(
+                        self._stall_s * max(1, len(self._inflight)),
+                        10.0 * self._stall_s,
+                    )
+                    if quiet > budget and (
+                        len(self._inflight) > 1 or self._q
+                    ):
+                        action = "recall"
+                    elif quiet > 5.0 and now - self._last_probe > 5.0:
+                        action = "probe"
+                if action is None and self._renew_due(now):
+                    action = "renew"
                 if action == "send":
                     n = min(
                         self.MAX_BATCH,
@@ -555,87 +621,121 @@ class _TaskLeaseChannel:
                         self._inflight[it["ref"]] = it
                         batch.append(it)
                     self._last_send = time.monotonic()
-            try:
+                if action is not None:
+                    self._busy = True
+        if action is not None:
+            if batch:
+                self._loop.note_batch(len(batch))
+            if not self._loop.offload(self, self._run_action, action, batch):
+                # sender pool gone (runtime shutdown): put the popped
+                # window back at the FRONT of the queue (a stranded
+                # in-flight set would hang its callers' gets), clear the
+                # busy flag, and for a retire finish the bookkeeping
+                # inline (no RPC involved)
+                with self._cv:
+                    for it in reversed(batch):
+                        self._inflight.pop(it["ref"], None)
+                        self._q.appendleft(it)
+                    self._busy = False
                 if action == "retire":
                     self._teardown(spill=False)
-                    return
-                if action == "send":
-                    req = {
-                        "lease_id": self.lease_id,
-                        "client_addr": rt._callback_address(),
-                        "items": [
-                            {
-                                k: v
-                                for k, v in it.items()
-                                if not k.startswith("_")
-                            }
-                            for it in batch
-                        ],
-                    }
-                    if self.accel_env:
-                        req["accel_env"] = self.accel_env
-                    accepts = self._worker.call(
-                        "LeaseTaskBatch", req, timeout=60.0
-                    )
-                    rejected = []
-                    released = False
-                    with self._cv:
-                        for it, status in zip(batch, accepts):
-                            if status != "accepted":
-                                self._inflight.pop(it["ref"], None)
-                                rejected.append(it)
-                                released = released or status == "released"
-                    for it in rejected:
-                        rt._lease_spill(it)
-                    if released:
-                        # "released" is lease-level, not per-item: the
-                        # worker-side lease is gone for good — a channel
-                        # left alive would absorb every future same-shape
-                        # task into a worker-RPC-then-spill loop
-                        self._drain_then_fail()
-                        return
-                elif action == "recall":
-                    # head-of-line wedged: pull queued work back and let
-                    # the head place it on other workers; the running
-                    # task keeps its slot until it completes
-                    reply = self._worker.call(
-                        "LeaseRecall", {"lease_id": self.lease_id},
-                        timeout=10.0,
-                    )
-                    recalled: List[dict] = []
-                    with self._cv:
-                        for ref in reply.get("removed") or ():
-                            it = self._inflight.pop(ref, None)
-                            if it is not None:
-                                recalled.append(it)
-                        recalled.extend(self._q)
-                        self._q.clear()
-                        self._stalled = True  # until a result arrives
-                    for it in recalled:
-                        rt._lease_spill(it)
-                elif action == "probe":
-                    # small retry budget: a loaded-but-alive worker must
-                    # not fail the whole lease over one slow ping (a
-                    # spurious fail_over ERRORS max_retries=0 tasks)
-                    self._worker.call("Ping", timeout=5.0, retries=2)
-                    self._last_probe = time.monotonic()
-                if action in ("send", "recall", "renew"):
-                    self._maybe_renew()
-            except RpcError:
-                if batch:
-                    # the batch whose SEND failed was (almost certainly)
-                    # never delivered: respill it as never-started —
-                    # at-least-once for mid-flight batches, the
-                    # _DirectActorChannel convention. Only items a
-                    # PREVIOUS batch delivered can be mid-execution;
-                    # _fail_over labels those may-have-run.
-                    with self._cv:
-                        for it in batch:
-                            self._inflight.pop(it["ref"], None)
-                    for it in batch:
-                        rt._lease_spill(it)
-                self._fail_over()
+                    return None
+        # the 0.25s tick the per-channel thread used to poll at — now one
+        # timer entry on the shared loop instead of a parked thread each
+        return now + 0.25
+
+    def _run_action(self, action: str, batch: List[dict]) -> None:
+        rt = self._rt
+        try:
+            if action == "retire":
+                self._teardown(spill=False)
                 return
+            if action == "send":
+                req = {
+                    "lease_id": self.lease_id,
+                    "client_addr": rt._callback_address(),
+                    "items": [
+                        {
+                            k: v
+                            for k, v in it.items()
+                            if not k.startswith("_")
+                        }
+                        for it in batch
+                    ],
+                }
+                if self.accel_env:
+                    req["accel_env"] = self.accel_env
+                t0 = time.perf_counter()
+                accepts = self._worker.call(
+                    "LeaseTaskBatch", req, timeout=60.0
+                )
+                # one observe per WINDOW: the per-item share of the send
+                DISPATCH_OVERHEAD_US.observe(
+                    (time.perf_counter() - t0) * 1e6 / max(1, len(batch)),
+                    {"stage": "wire"},
+                )
+                rejected = []
+                released = False
+                with self._cv:
+                    for it, status in zip(batch, accepts):
+                        if status != "accepted":
+                            self._inflight.pop(it["ref"], None)
+                            rejected.append(it)
+                            released = released or status == "released"
+                for it in rejected:
+                    rt._lease_spill(it)
+                if released:
+                    # "released" is lease-level, not per-item: the
+                    # worker-side lease is gone for good — a channel
+                    # left alive would absorb every future same-shape
+                    # task into a worker-RPC-then-spill loop
+                    self._drain_then_fail()
+                    return
+            elif action == "recall":
+                # head-of-line wedged: pull queued work back and let
+                # the head place it on other workers; the running
+                # task keeps its slot until it completes
+                reply = self._worker.call(
+                    "LeaseRecall", {"lease_id": self.lease_id},
+                    timeout=10.0,
+                )
+                recalled: List[dict] = []
+                with self._cv:
+                    for ref in reply.get("removed") or ():
+                        it = self._inflight.pop(ref, None)
+                        if it is not None:
+                            recalled.append(it)
+                    recalled.extend(self._q)
+                    self._q.clear()
+                    self._stalled = True  # until a result arrives
+                for it in recalled:
+                    rt._lease_spill(it)
+            elif action == "probe":
+                # small retry budget: a loaded-but-alive worker must
+                # not fail the whole lease over one slow ping (a
+                # spurious fail_over ERRORS max_retries=0 tasks)
+                self._worker.call("Ping", timeout=5.0, retries=2)
+                self._last_probe = time.monotonic()
+            if action in ("send", "recall", "renew"):
+                self._maybe_renew()
+        except RpcError:
+            if batch:
+                # the batch whose SEND failed was (almost certainly)
+                # never delivered: respill it as never-started —
+                # at-least-once for mid-flight batches, the
+                # _DirectActorChannel convention. Only items a
+                # PREVIOUS batch delivered can be mid-execution;
+                # _fail_over labels those may-have-run.
+                with self._cv:
+                    for it in batch:
+                        self._inflight.pop(it["ref"], None)
+                for it in batch:
+                    rt._lease_spill(it)
+            self._fail_over()
+            return
+        finally:
+            with self._cv:
+                self._busy = False
 
     def _renew_due(self, now: float) -> bool:
         return (
@@ -718,6 +818,7 @@ class _TaskLeaseChannel:
         if spill:
             self._fail_over()
             return
+        self._loop.unregister(self)
         self._mgr._drop_channel(self.shape_key, self)
         self._rt._drop_direct_channel(self.key, self)
         try:
@@ -741,6 +842,11 @@ class _TaskLeaseChannel:
             self.dead = True
             self._cv.notify_all()
         self._teardown(spill=False)
+
+    def __repr__(self) -> str:  # debug surfaces
+        return (
+            f"_TaskLeaseChannel({self.lease_id[:8]}, depth={self.depth()})"
+        )
 
 
 class _TaskLeaseManager:
@@ -917,6 +1023,94 @@ class _TaskLeaseManager:
     def stop(self) -> None:
         with self._lock:
             self._stopped = True
+
+
+class _ResultSink:
+    """DirectResults delivery fused onto the runtime's event loop.
+
+    RPC handler threads enqueue whole windows here and return
+    immediately; the loop's ``step`` drains EVERY queued window in one
+    pass and offloads the merged batch to the sender pool — one wake per
+    window burst instead of one lock hop per push (the third thread
+    family the fused loop absorbs, next to lease windows and direct
+    pushes). Processing is offloaded, never run on the loop thread:
+    ``_process_direct_results`` can owe a blocking head RPC (owner-held
+    upload on eviction), and the loop's contract is non-blocking steps.
+    At most one processing action is in flight, so batches stay FIFO
+    (a worker's pushes must not reorder)."""
+
+    def __init__(self, rt: "RemoteRuntime"):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._rt = rt
+        self._batches: deque = deque()
+        self._busy = False
+        self._lock = threading.Lock()
+        # DEDICATED delivery thread: result processing must never queue
+        # behind 60s-blocking sends on the shared sender pool — during a
+        # mass lease-revoke storm a starved drain would let
+        # _drain_then_fail time out and mislabel never-started
+        # max_retries=0 windows as may-have-run
+        self._exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="hotpath-results"
+        )
+        rt._hotloop.register(self)
+
+    def push(self, results: List[dict]) -> None:
+        self._batches.append(results)
+        if self._rt._hotloop.wake(self):
+            return
+        # loop stopped (shutdown): drain inline so late pushes still land
+        self._drain_inline()
+
+    def _drain_inline(self) -> None:
+        while True:
+            with self._lock:
+                if self._busy or not self._batches:
+                    return
+                batches: List[list] = []
+                while self._batches:
+                    batches.append(self._batches.popleft())
+                self._busy = True
+            self._run(batches)
+
+    def step(self, now: float) -> None:
+        with self._lock:
+            if self._busy or not self._batches:
+                return None
+            batches: List[list] = []
+            while self._batches:
+                batches.append(self._batches.popleft())
+            self._busy = True
+        try:
+            self._exec.submit(self._run_and_rewake, batches)
+        except RuntimeError:  # executor closed (shutdown): inline
+            self._run(batches)
+        return None
+
+    def _run_and_rewake(self, batches: List[list]) -> None:
+        self._run(batches)
+        # windows pushed while we were processing re-enter via the loop
+        self._rt._hotloop.wake(self)
+
+    def close(self) -> None:
+        self._exec.shutdown(wait=False)
+
+    def _run(self, batches: List[list]) -> None:
+        try:
+            merged: List[dict] = []
+            for b in batches:
+                merged.extend(b)
+            if merged:
+                self._rt._hotloop.note_batch(len(merged))
+                self._rt._process_direct_results(merged)
+        finally:
+            with self._lock:
+                self._busy = False
+        # a push that raced in while we were busy AND the loop died has
+        # nobody left to wake us: sweep it up before returning
+        if self._batches and not self._rt._hotloop.alive():
+            self._drain_inline()
 
 
 class RemoteActorHandle:
@@ -1206,6 +1400,15 @@ class RemoteRuntime:
         # so the result-cache paths check the union flag.
         self._lease_enabled = cfg.task_leases
         self._push_enabled = self._direct_enabled or self._lease_enabled
+        # the fused submit/result event loop: lease channels, direct
+        # actor channels, and result delivery are all sources on this ONE
+        # loop (its thread starts lazily on first registration)
+        from .event_loop import FusedEventLoop
+
+        self._hotloop = FusedEventLoop(
+            name="hotpath", senders=int(cfg.hotpath_senders)
+        )
+        self._result_sink = _ResultSink(self)
         self._lease_mgr = (
             _TaskLeaseManager(self) if self._lease_enabled else None
         )
@@ -1432,8 +1635,21 @@ class RemoteRuntime:
         fn_blob, fn_id, fn_arg_ids, fn_cacheable = self._serialize_fn(
             spec.func
         )
-        with collect_serialized() as arg_ids:
-            payload = wire.dumps((spec.args, spec.kwargs))
+        sample = _sampled()
+        if spec.args or spec.kwargs:
+            t0 = time.perf_counter() if sample else 0.0
+            with collect_serialized() as arg_ids:
+                payload = wire.dumps((spec.args, spec.kwargs))
+            if sample:
+                DISPATCH_OVERHEAD_US.observe(
+                    (time.perf_counter() - t0) * 1e6, {"stage": "serialize"}
+                )
+        else:
+            # hot-path constant: argless tasks (control probes, noop-style
+            # fan-out) share ONE precomputed payload — no per-call pickle,
+            # no ref-collection context
+            payload = _EMPTY_ARGS_PAYLOAD
+            arg_ids = set()
         if fn_arg_ids:
             arg_ids |= fn_arg_ids
         deps = [a.hex for a in spec.args if isinstance(a, ObjectRef)]
@@ -1482,10 +1698,19 @@ class RemoteRuntime:
                 tuple(sorted(spec.resources.items())),
                 env_sig,
             )
-            if self._lease_mgr.submit(item, shape_key):
+            t0 = time.perf_counter() if sample else 0.0
+            streamed = self._lease_mgr.submit(item, shape_key)
+            if sample:
+                DISPATCH_OVERHEAD_US.observe(
+                    (time.perf_counter() - t0) * 1e6, {"stage": "enqueue"}
+                )
+            if streamed:
                 # the head never sees this task's spec — WE are its
-                # lineage (resubmitted on loss via _maybe_resubmit_lost)
-                self._note_lineage(item)
+                # lineage (resubmitted on loss via _maybe_resubmit_lost).
+                # max_retries=0 items never resubmit, so retaining their
+                # lineage is pure per-task overhead: skip it.
+                if spec.max_retries > 0:
+                    self._note_lineage(item)
                 return spec.returns
         lease = LeaseRequest(
             task_id=spec.task_id,
@@ -1765,8 +1990,16 @@ class RemoteRuntime:
             return self._callback_server.address
 
     def _h_direct_results(self, results: List[dict]) -> None:
+        """DirectResults RPC handler: enqueue the window for the fused
+        loop's result sink and return — the push RPC never waits on
+        local processing, and bursts from many workers merge into one
+        batch-at-once delivery pass."""
+        self._result_sink.push(results)
+
+    def _process_direct_results(self, results: List[dict]) -> None:
         from ray_tpu.core.refcount import TRACKER
 
+        t_start = time.perf_counter()
         unpin: List[str] = []
         uploads: List[tuple] = []  # evicted owner-held objects → head
         register: List[str] = []  # head-sealed results: holder is on books
@@ -1871,6 +2104,11 @@ class RemoteRuntime:
         # are on the books before its result reaches us)
         for h in unpin:
             TRACKER.decref(h)
+        # one observe per merged delivery batch: the per-item share
+        DISPATCH_OVERHEAD_US.observe(
+            (time.perf_counter() - t_start) * 1e6 / max(1, len(results)),
+            {"stage": "result"},
+        )
 
     def _upload_owned(self, h: str, data: bytes, contained: List[str]) -> bool:
         """Persist an owner-held direct-call result into the head's object
@@ -2736,6 +2974,8 @@ class RemoteRuntime:
         for chan in list(self._direct_channels.values()):
             chan.stop()  # lease channels also enqueue their lease_return
         self._direct_channels.clear()
+        self._hotloop.stop()
+        self._result_sink.close()
         if self._callback_server is not None:
             self._callback_server.stop()
             self._callback_server = None

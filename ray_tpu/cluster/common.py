@@ -30,6 +30,30 @@ def new_id() -> str:
     return rand_hex(8)  # buffered urandom: no syscall per id
 
 
+from ray_tpu.util.metrics import Histogram as _Histogram
+
+# execution-plane hot-path decomposition, SAMPLED 1-in-64 per call site
+# (a locked observe per item would be per-item Python on the very path
+# this histogram exists to prove clean): serialize = payload framing,
+# enqueue = lease-manager/channel hand-off, wire = one window's RPC send
+# (per-item share), execute = worker-side run, result = owner-side
+# delivery of a merged result batch (per-item share). Shared here so the
+# owner (client.py) and worker observe the same instrument name.
+DISPATCH_OVERHEAD_US = _Histogram(
+    "dispatch_overhead_us",
+    "Per-stage dispatch overhead decomposition (sampled), microseconds.",
+    boundaries=[1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000, 25000, 100000],
+    label_names=("stage",),
+)
+_sample_tick = 0
+
+
+def dispatch_sampled() -> bool:
+    global _sample_tick
+    _sample_tick = (_sample_tick + 1) & 63
+    return _sample_tick == 0
+
+
 def stream_item_id(task_id: str, index: int) -> str:
     """Deterministic object id for item ``index`` of a streaming-generator
     task. Determinism is the recovery story: a retried generator re-seals

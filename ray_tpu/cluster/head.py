@@ -4359,6 +4359,15 @@ class HeadServer:
             from .rpc import HANDLER_STATS
 
             return HANDLER_STATS.snapshot()
+        if kind == "hotpath":
+            # execution-plane hot path: framing-path selection + native
+            # vs fallback counters, fused-event-loop occupancy, ring
+            # fill levels, live pipelines, dispatch decomposition — the
+            # head process's own view (owners/agents expose theirs via
+            # the agent DebugState "hotpath" block)
+            from .event_loop import hotpath_state
+
+            return hotpath_state()
         with self._lock:
             if kind == "actors":
                 return [dict(vars(a)) for a in self._actors.values()]

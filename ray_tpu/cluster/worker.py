@@ -31,7 +31,12 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 from . import serialization as wire
-from .common import INLINE_OBJECT_MAX, SealInfo
+from .common import (
+    DISPATCH_OVERHEAD_US,
+    INLINE_OBJECT_MAX,
+    SealInfo,
+    dispatch_sampled,
+)
 from .object_plane import OBJECT_TRANSFER_BYTES, SHM_HITS, SHM_MISSES
 from .rpc import RpcClient, RpcError, RpcServer
 
@@ -179,6 +184,9 @@ class Worker:
         # compiled-DAG programs resident in this worker:
         # dag_id -> {"stop": Event, "threads": [...], "channels": [...]}
         self._dag_programs: Dict[str, dict] = {}
+        # AOT-compiled pipeline stage programs (dag/pipeline.py):
+        # pipe_id -> {"stop": Event, "threads": [(thread, channels)]}
+        self._pipelines: Dict[str, dict] = {}
         # per-actor lock mediating DAG stage threads vs normal pushed
         # methods on the same instance (created when a DAG binds the actor)
         self._dag_actor_locks: Dict[str, threading.Lock] = {}
@@ -225,6 +233,8 @@ class Worker:
                 "ScrubActor": self._h_scrub_actor,
                 "DagInstall": self._h_dag_install,
                 "DagTeardown": self._h_dag_teardown,
+                "PipelineInstall": self._h_pipeline_install,
+                "PipelineTeardown": self._h_pipeline_teardown,
                 "DirectPushBatch": self._h_direct_push_batch,
                 "LeaseTaskBatch": self._h_lease_task_batch,
                 "LeaseRecall": self._h_lease_recall,
@@ -1485,6 +1495,8 @@ class Worker:
             self._env_enter(runtime_env)
         out = None
         failed: Optional[BaseException] = None
+        sample = dispatch_sampled()
+        t0 = time.perf_counter() if sample else 0.0
         try:
             fn = self._fn_from_blob(
                 item.get("fn_id", ""), item["fn_blob"], item.get("fn_cache")
@@ -1495,6 +1507,10 @@ class Worker:
         except BaseException as exc:  # noqa: BLE001 - errors are values
             failed = exc
         finally:
+            if sample:
+                DISPATCH_OVERHEAD_US.observe(
+                    (time.perf_counter() - t0) * 1e6, {"stage": "execute"}
+                )
             if runtime_env:
                 self._env_exit()
             self._clear_context()
@@ -1625,6 +1641,103 @@ class Worker:
                         pass
         return {"status": "ok"}
 
+    def _h_pipeline_install(self, req: dict) -> dict:
+        """Install AOT-compiled pipeline stages into this worker
+        (dag/pipeline.py): per stage, open its pre-created in/out rings
+        and start the bytes-level stage loop. Stage functions arrive as
+        cloudpickle blobs ONCE at install; method stages bind the hosted
+        actor instance under the per-actor DAG lock (compiled-DAG calls,
+        pipeline calls, and normal pushed methods stay serialized)."""
+        from ray_tpu.dag.channel import ShmChannel
+        from ray_tpu.dag.pipeline import run_pipeline_stage
+
+        actor_id = req["actor_id"]
+        pipe_id = req["pipe_id"]
+        instance = self._actors[actor_id]
+        entry = self._actor_loops.get(actor_id)
+        dag_lock = self._dag_actor_locks.setdefault(actor_id, threading.Lock())
+        state = self._pipelines.setdefault(
+            pipe_id, {"stop": threading.Event(), "threads": []}
+        )
+        for prog in req["programs"]:
+            in_ch = ShmChannel(prog["in_path"], capacity=prog["capacity"])
+            out_ch = ShmChannel(prog["out_path"], capacity=prog["capacity"])
+            if prog.get("fn_blob") is not None:
+                fn = cloudpickle.loads(prog["fn_blob"])
+
+                def target(x, _fn=fn):
+                    return _fn(x)
+
+                name = getattr(fn, "__name__", "fn")
+            else:
+                method = prog["method"]
+                bound = getattr(instance, method)
+                if entry is not None:
+                    import asyncio
+
+                    loop, _sems = entry
+
+                    async def _awrap(aw):
+                        return await aw
+
+                    def target(x, _fn=bound, _loop=loop):
+                        from ray_tpu.core.object_store import should_await
+
+                        with dag_lock:
+                            out = _fn(x)
+                        if should_await(out):
+                            return asyncio.run_coroutine_threadsafe(
+                                _awrap(out), _loop
+                            ).result()
+                        return out
+
+                else:
+
+                    def target(x, _fn=bound):
+                        with dag_lock:
+                            return _fn(x)
+
+                name = method
+            t = threading.Thread(
+                target=run_pipeline_stage,
+                args=(
+                    target,
+                    in_ch,
+                    out_ch,
+                    state["stop"],
+                    f"{actor_id[:8]}.{name}[{prog['stage']}]",
+                ),
+                name=f"pipe-{pipe_id[:8]}-s{prog['stage']}",
+                daemon=True,
+            )
+            state["threads"].append((t, [in_ch, out_ch]))
+            t.start()
+        return {"status": "ok"}
+
+    def _h_pipeline_teardown(self, req: dict) -> dict:
+        state = self._pipelines.pop(req["pipe_id"], None)
+        if state is not None:
+            state["stop"].set()
+            for t, channels in state["threads"]:
+                t.join(timeout=2.0)
+                if t.is_alive():
+                    # mid-method stage: munmapping its rings under it
+                    # would segfault the worker — leave them mapped, the
+                    # thread exits on its next stop-flag check
+                    logger.warning(
+                        "pipeline %s stage %s still running at teardown; "
+                        "leaving its channels mapped",
+                        req["pipe_id"][:8],
+                        t.name,
+                    )
+                    continue
+                for ch in channels:
+                    try:
+                        ch.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        return {"status": "ok"}
+
     def _h_kill_actor(self, req: dict) -> None:
         self._actors.pop(req["actor_id"], None)
         entry = self._actor_loops.pop(req["actor_id"], None)
@@ -1695,6 +1808,8 @@ class Worker:
         reasons = []
         if self._dag_programs:
             reasons.append("compiled-DAG programs still installed")
+        if self._pipelines:
+            reasons.append("compiled-pipeline programs still installed")
         if self._actors:
             reasons.append("other actors resident")
         # thread hygiene: the killed actor's event loop drains async

@@ -746,6 +746,44 @@ define(
 )
 
 # ---------------------------------------------------------------------------
+# execution-plane hot path (fused event loop + AOT actor pipelines)
+# ---------------------------------------------------------------------------
+define(
+    "hotpath_senders",
+    8,
+    "Sender-pool size for the owner-side fused submit/result event loop "
+    "(blocking lease-window / direct-push RPCs run here; the loop thread "
+    "itself never blocks on the wire).",
+)
+define(
+    "native_wire",
+    True,
+    "Use the C framing hot path (native/wire.cc) for the RTP5 pickle-5 "
+    "wire format. Read ONCE at serialization import; set "
+    "RAY_TPU_NATIVE_WIRE=0 before the first ray_tpu import to force the "
+    "pure-Python framing fallback.",
+)
+define(
+    "pipeline_buffer_bytes",
+    1 << 22,
+    "Per-stage shm ring capacity for AOT-compiled actor pipelines "
+    "(compile_pipeline).",
+)
+define(
+    "pipeline_max_inflight",
+    64,
+    "Max concurrently admitted executions per compiled actor pipeline "
+    "(the slot-multiplexed window; backpressure beyond it).",
+)
+define(
+    "pipeline_stall_s",
+    5.0,
+    "Per-owed-item quiet budget (capped at 10x) before a compiled "
+    "pipeline presumes a stage worker dead and spills every unresolved "
+    "execution back to the eager task path.",
+)
+
+# ---------------------------------------------------------------------------
 # data (streaming executor)
 # ---------------------------------------------------------------------------
 define(

@@ -10,3 +10,4 @@ program IS the compiled DAG — so this module covers the *actor orchestration*
 layer only.
 """
 from .dag import InputNode, MultiOutputNode  # noqa: F401
+from .pipeline import CompiledPipeline, PipelineRef, compile_pipeline  # noqa: F401

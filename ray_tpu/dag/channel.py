@@ -114,6 +114,8 @@ def _lib():
             lib.rtpu_ring_close_write.argtypes = [ctypes.c_void_p]
             lib.rtpu_ring_capacity.restype = ctypes.c_uint64
             lib.rtpu_ring_capacity.argtypes = [ctypes.c_void_p]
+            lib.rtpu_ring_used.restype = ctypes.c_uint64
+            lib.rtpu_ring_used.argtypes = [ctypes.c_void_p]
             lib.rtpu_ring_close.argtypes = [ctypes.c_void_p]
             _ring_lib = lib
         return _ring_lib
@@ -128,6 +130,90 @@ def channel_dir() -> str:
     d = os.path.join(base, "ray_tpu_dag")
     os.makedirs(d, exist_ok=True)
     return d
+
+
+def ring_path(name: str, pid: Optional[int] = None) -> str:
+    """Canonical ring-file path: the CREATOR's pid rides in the filename
+    (``<name>.p<pid>.ring``) so :func:`sweep_orphan_rings` can reap files
+    whose owner died without the unlink (SIGKILL mid-pipeline) — the same
+    hygiene the shm arena got from ``sweep_orphan_stores``."""
+    return os.path.join(
+        channel_dir(), f"{name}.p{pid if pid is not None else os.getpid()}.ring"
+    )
+
+
+def sweep_orphan_rings(directory: Optional[str] = None) -> list:
+    """Unlink ring files left behind by SIGKILLed producers/consumers.
+
+    A ``*.p<pid>.ring`` file is an orphan when its creator pid is dead;
+    legacy un-stamped ``*.ring`` files are reaped only once stale (>1h
+    mtime — they may belong to a live compiled DAG from an old build).
+    Run at agent start alongside ``sweep_orphan_stores``. Returns the
+    paths removed."""
+    import re
+    import time as _time
+
+    directory = directory or channel_dir()
+    removed = []
+    pat = re.compile(r"\.p(\d+)\.ring$")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return removed
+    now = _time.time()
+    for name in names:
+        if not name.endswith(".ring"):
+            continue
+        path = os.path.join(directory, name)
+        m = pat.search(name)
+        if m:
+            pid = int(m.group(1))
+            if pid > 0 and _pid_alive(pid):
+                continue
+        else:
+            try:
+                if now - os.path.getmtime(path) < 3600:
+                    continue
+            except OSError:
+                continue
+        try:
+            os.unlink(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed
+
+
+def _pid_alive(pid: int) -> bool:
+    from ray_tpu.native.shm_store import _pid_alive as alive
+
+    return alive(pid)
+
+
+# observability: every open ShmChannel registers here (weakly) so debug
+# surfaces can report ring fill levels without holding channels alive
+import weakref
+
+_OPEN_CHANNELS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def ring_stats() -> list:
+    """Fill levels of this process's open rings (racy snapshot)."""
+    out = []
+    for ch in list(_OPEN_CHANNELS):
+        try:
+            used = ch.used()
+        except Exception:  # noqa: BLE001 - closed under us
+            continue
+        out.append(
+            {
+                "path": ch.path,
+                "capacity": ch._cap,
+                "used": used,
+                "fill": round(used / ch._cap, 4) if ch._cap else 0.0,
+            }
+        )
+    return out
 
 
 class ShmChannel:
@@ -145,6 +231,10 @@ class ShmChannel:
             raise OSError(f"failed to open ring channel at {path}")
         self._cap = self._lib.rtpu_ring_capacity(self._h)
         self._closed = False
+        # serializes used() against close(): rtpu_ring_close munmaps the
+        # header, so an observability read racing teardown would fault
+        self._state_lock = threading.Lock()
+        _OPEN_CHANNELS.add(self)
 
     def put(self, tag: int, value: Any, timeout: Optional[float] = None) -> None:
         if tag == OK:
@@ -210,15 +300,25 @@ class ShmChannel:
             raise ChannelClosed(self.path)
         return buf.raw[:got]
 
+    def used(self) -> int:
+        """Unread bytes currently buffered (observability only)."""
+        with self._state_lock:
+            if not self._h:
+                return 0
+            return self._lib.rtpu_ring_used(self._h)
+
     def close_write(self) -> None:
         if self._h:
             self._lib.rtpu_ring_close_write(self._h)
 
     def close(self) -> None:
-        if self._h and not self._closed:
+        with self._state_lock:
+            if not self._h or self._closed:
+                return
             self._closed = True
-            self._lib.rtpu_ring_close(self._h)
-            self._h = None
+            h, self._h = self._h, None
+        _OPEN_CHANNELS.discard(self)
+        self._lib.rtpu_ring_close(h)
 
     def unlink(self) -> None:
         self.close()

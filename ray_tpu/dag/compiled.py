@@ -417,9 +417,12 @@ class CompiledDAG:
     def _setup_remote(self) -> None:
         from .dag import FunctionNode, MethodNode
 
-        base = channel_dir()
+        from .channel import ring_path
+
         for e in self._edges:
-            e.path = os.path.join(base, f"{self._dag_id}_{e.idx}.ring")
+            # pid-stamped path: a SIGKILLed driver's rings are reaped by
+            # the agent-start orphan sweep (sweep_orphan_rings)
+            e.path = ring_path(f"{self._dag_id}_{e.idx}")
             self._shm_paths.append(e.path)
             ch = ShmChannel(e.path, capacity=self._buffer, create=True)
             ch.close()  # just materialize + size the ring file
@@ -666,7 +669,11 @@ class CompiledDAG:
                     e.channel.close()
                 except Exception:  # noqa: BLE001
                     pass
-        for p in self._shm_paths:
+        # unlink exactly-once: teardown is idempotent (_torn_down) but the
+        # paths are also popped as they go so no path is ever re-unlinked
+        # (a same-named successor ring must not be clobbered)
+        while self._shm_paths:
+            p = self._shm_paths.pop()
             try:
                 os.unlink(p)
             except OSError:

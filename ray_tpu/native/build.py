@@ -14,6 +14,7 @@ SOURCES = {
     "objstore": "object_store.cc",
     "ledger": "ledger.cc",
     "ring": "ring.cc",
+    "wire": "wire.cc",
 }
 
 

@@ -240,6 +240,18 @@ uint64_t rtpu_ring_capacity(void* rp) {
   return static_cast<Ring*>(rp)->h->capacity;
 }
 
+// Bytes currently buffered (unread) in the ring — observability only
+// (fill-level gauges); racy by nature, never used for flow control.
+// Load order matters even for a racy gauge: read_pos FIRST (like the
+// reader path) so a concurrent drain between the loads can only make
+// the result small, never underflow w - r past zero.
+uint64_t rtpu_ring_used(void* rp) {
+  Header* h = static_cast<Ring*>(rp)->h;
+  uint64_t r = h->read_pos.load(std::memory_order_acquire);
+  uint64_t w = h->write_pos.load(std::memory_order_acquire);
+  return w >= r ? w - r : 0;
+}
+
 void rtpu_ring_close(void* rp) {
   Ring* r = static_cast<Ring*>(rp);
   munmap(r->h, r->map_len);

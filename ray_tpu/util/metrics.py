@@ -183,6 +183,26 @@ def percentile_from_buckets(
     return float(boundaries[-1])
 
 
+def sync_counter(name: str, value: float, description: str = "") -> None:
+    """Publish an externally-accumulated total as a registry counter.
+
+    Hot paths that cannot afford a locked ``Counter.inc`` per event (e.g.
+    the wire-framing counters) accumulate plain ints and sync the
+    absolute value here from observability surfaces."""
+    with _registry_lock:
+        m = _registry.get(name)
+    if m is None:
+        # Counter.__init__ self-registers (taking _registry_lock), so
+        # create outside the lock, then settle the race on the object
+        # the registry actually holds — a value written to a losing
+        # duplicate would vanish from every scrape
+        candidate = Counter(name, description)
+        with _registry_lock:
+            m = _registry.setdefault(name, candidate)
+    with m._lock:
+        m._values[m._key(None)] = float(value)
+
+
 def prometheus_text() -> str:
     """Render every registered metric in Prometheus exposition format."""
     lines: List[str] = []
