@@ -1245,6 +1245,135 @@ def chaos_bench(num_faults: int = 20, seed: int = None) -> dict:
         cluster.shutdown()
 
 
+def xnode_transfer_bench() -> dict:
+    """Tier: cross-node object transfer throughput (zero-copy transport).
+
+    A 2-node cluster moves a 32 MB block node-to-node twice — once over
+    the peer-leased socket plane (striped scatter-gather C path) and once
+    over the chunked-RPC fallback (RAY_TPU_NATIVE_NET=0) — by driving the
+    DESTINATION agent's GetObjectForWorker and deleting its cached copy
+    between pulls, so every iteration pays the full cross-node pull +
+    arena landing. Also measures one striped big-object transfer
+    (RAY_TPU_BENCH_XNODE_BIG_MB, default 1024 = the >1 GB striping
+    class; 0 skips) and exports it in the bench JSON.
+
+    Gate: RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S fails the run loudly when
+    the 32 MB socket-path throughput regresses below it."""
+    import numpy as _np
+
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.cluster.rpc import RpcClient
+    from ray_tpu.core.runtime import set_runtime
+
+    big_mb = int(os.environ.get("RAY_TPU_BENCH_XNODE_BIG_MB", "1024") or 0)
+    iters = int(os.environ.get("RAY_TPU_BENCH_XNODE_ITERS", "6"))
+
+    def _measure(native: bool, with_big: bool) -> dict:
+        import ray_tpu
+
+        os.environ["RAY_TPU_NATIVE_NET"] = "1" if native else "0"
+        # arena must hold the big object on both ends (+ headroom)
+        cap = max(1 << 28, (big_mb << 20) * 2 if with_big else 0)
+        cluster = Cluster(use_device_scheduler=False)
+        try:
+            cluster.add_node(
+                {"CPU": 2.0, "srcres": 1.0},
+                num_workers=1,
+                store_capacity=cap,
+            )
+            dst = cluster.add_node(
+                {"CPU": 2.0, "dstres": 1.0},
+                num_workers=1,
+                store_capacity=cap,
+            )
+            rt = cluster.client()
+            set_runtime(rt)
+            try:
+                make = ray_tpu.remote(_make_block).options(
+                    resources={"srcres": 0.1}
+                )
+                dst_agent = RpcClient(cluster.agent_address(dst))
+
+                def _pull_mb_s(nbytes: int, n_iters: int) -> float:
+                    ref = make.remote(nbytes // 8)
+                    ray_tpu.wait([ref], timeout=300)
+                    # warm the link/grant path; timed pulls are steady
+                    samples = []
+                    for _ in range(n_iters + 1):
+                        t0 = time.perf_counter()
+                        reply = dst_agent.call(
+                            "GetObjectForWorker",
+                            {"object_id": ref.hex, "purpose": "get"},
+                            timeout=600.0,
+                        )
+                        dt = time.perf_counter() - t0
+                        if reply["status"] not in ("local", "inline"):
+                            raise RuntimeError(f"pull failed: {reply}")
+                        samples.append(nbytes / dt / 2**20)
+                        # drop the cached copy so the next pull crosses
+                        # the node boundary again
+                        dst_agent.call(
+                            "DeleteObjects",
+                            {"object_ids": [ref.hex]},
+                            timeout=30.0,
+                        )
+                    del ref
+                    return float(_np.median(samples[1:]))
+
+                out = {"mb_s_32mb": round(_pull_mb_s(32 << 20, iters), 1)}
+                if with_big:
+                    out["mb_s_big"] = round(
+                        _pull_mb_s(big_mb << 20, 2), 1
+                    )
+                return out
+            finally:
+                set_runtime(None)
+                rt.shutdown()
+        finally:
+            cluster.shutdown()
+            os.environ.pop("RAY_TPU_NATIVE_NET", None)
+
+    out: dict = {}
+    try:
+        sock = _measure(native=True, with_big=big_mb > 0)
+        out["object_transfer_mb_per_s_32mb_xnode"] = {
+            "socket": sock["mb_s_32mb"]
+        }
+        if "mb_s_big" in sock:
+            out["xnode_striped_transfer"] = {
+                "size_mb": big_mb,
+                "socket_mb_per_s": sock["mb_s_big"],
+            }
+        chunked = _measure(native=False, with_big=False)
+        out["object_transfer_mb_per_s_32mb_xnode"]["chunked_rpc"] = chunked[
+            "mb_s_32mb"
+        ]
+        out["object_transfer_mb_per_s_32mb_xnode"]["socket_vs_chunked"] = (
+            round(sock["mb_s_32mb"] / max(chunked["mb_s_32mb"], 1e-9), 2)
+        )
+    except Exception as exc:  # noqa: BLE001 - other tiers still publish
+        out["xnode_transfer_error"] = repr(exc)
+        return out
+    # env-tunable regression floor, mirroring the other tiers' floors:
+    # CI sets RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S to fail the run loudly
+    # when cross-node socket throughput regresses below it
+    floor = float(
+        os.environ.get("RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S", "0") or 0.0
+    )
+    if floor > 0:
+        out["xnode_floor_mb_per_s"] = floor
+        out["xnode_floor_ok"] = bool(
+            out["object_transfer_mb_per_s_32mb_xnode"]["socket"] >= floor
+        )
+    return out
+
+
+def _make_block(n_elem: int):
+    import numpy as np
+
+    return np.arange(n_elem, dtype=np.float64)
+
+
 def serve_bench() -> dict:
     """Tier: serving plane under open-loop load. Poisson-ish arrivals at
     a fixed QPS stream tokens from a 2-replica continuous-batching LLM
@@ -1637,6 +1766,11 @@ def main():
             )
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["chaos_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_XNODE", "1") != "0":
+        try:
+            cluster.update(xnode_transfer_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["xnode_transfer_error"] = repr(exc)
     if os.environ.get("RAY_TPU_BENCH_SERVE", "1") != "0":
         try:
             cluster.update(serve_bench())
@@ -1701,6 +1835,7 @@ def main():
         or out.get("wait_p99_ok") is False
         or out.get("serve_p99_ok") is False
         or out.get("serve_qps_ok") is False
+        or out.get("xnode_floor_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
@@ -1711,7 +1846,8 @@ def main():
         # RAY_TPU_BENCH_FRAG_CEILING_PCT /
         # RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS /
         # RAY_TPU_BENCH_SERVE_P99_CEILING_MS /
-        # RAY_TPU_BENCH_SERVE_QPS_FLOOR):
+        # RAY_TPU_BENCH_SERVE_QPS_FLOOR /
+        # RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

@@ -312,6 +312,30 @@ class ChaosOrchestrator:
                 return f"skipped: replica pid {pid} already gone"
             self._killed_replica = pid
             return f"SIGKILLed serve replica worker pid {pid}"
+        if kind == "peer_conn_drop":
+            # sever every data socket one node is SERVING mid-transfer:
+            # pullers' in-flight stripes fail and must RESUME (only the
+            # lost stripes re-fetch — zero acked loss, no duplicate
+            # bytes), which the invariant checker asserts afterwards
+            nid = self._pick_node(spec)
+            if nid is None:
+                return "skipped: no live node"
+            addr = self.cluster.agent_address(nid)
+            if addr is None:
+                return "skipped: node has no address"
+            from ray_tpu.cluster.rpc import RpcClient, RpcError
+
+            client = RpcClient(addr)
+            try:
+                reply = client.call("ChaosDropPeerConn", timeout=10.0)
+            except RpcError:
+                return f"skipped: agent {nid} unreachable"
+            finally:
+                client.close()
+            return (
+                f"severed {reply.get('dropped', 0)} data socket(s) "
+                f"served by {nid}"
+            )
         if kind == "zygote_kill":
             nid = self._pick_node(spec)
             if nid is None:

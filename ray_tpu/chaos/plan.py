@@ -38,7 +38,15 @@ SERVE_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
     ("replica_kill", 2.0),
 )
 
-KINDS = tuple(k for k, _ in SERVE_MIX)
+# cross-node transport mix: adds peer_conn_drop (sever one node's data
+# sockets mid-transfer; in-flight striped pulls must resume, not
+# restart). Not in DEFAULT_MIX for the same seed-stability reason as
+# replica_kill — plans that drive cross-node transfers pass this mix.
+NET_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
+    ("peer_conn_drop", 2.0),
+)
+
+KINDS = tuple(k for k, _ in SERVE_MIX) + ("peer_conn_drop",)
 
 
 @dataclass(frozen=True)

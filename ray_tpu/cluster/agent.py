@@ -40,7 +40,11 @@ from .common import (
 from .object_plane import (
     CHUNKED_PULLS_INFLIGHT,
     OBJECT_TRANSFER_BYTES,
+    PEER_CONN_GRANTED,
+    PEER_CONN_REUSED,
+    PEER_CONN_REVOKED,
     TRANSFER_CHUNK_MS,
+    TRANSFER_STRIPE_MS,
     ChunkFetchError,
     fetch_chunked,
 )
@@ -275,6 +279,16 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 - hygiene, never fatal
             logger.debug("orphan ring sweep failed", exc_info=True)
         try:
+            # and for data-plane endpoint sidecars (transport.py): a
+            # SIGKILLed agent never unlinks its own .ep file
+            from ray_tpu.native.net import sweep_orphan_endpoints
+
+            swept = sweep_orphan_endpoints()
+            if swept:
+                logger.info("swept %d orphaned net endpoints", len(swept))
+        except Exception:  # noqa: BLE001 - hygiene, never fatal
+            logger.debug("orphan endpoint sweep failed", exc_info=True)
+        try:
             from ray_tpu.native import NativeObjectStore
 
             inner = NativeObjectStore(
@@ -348,7 +362,9 @@ class NodeAgent:
             "Shutdown": self._h_shutdown,
             "DebugState": self._h_debug_state,
             "ServeStats": self._h_serve_stats,
+            "RevokePeerLink": self._h_revoke_peer_link,
             "ChaosKillZygote": self._h_chaos_kill_zygote,
+            "ChaosDropPeerConn": self._h_chaos_drop_peer_conn,
             "Ping": lambda r: "pong",
         }
         # serving-plane stats pushed by co-located replica workers
@@ -433,6 +449,36 @@ class NodeAgent:
             cfg.max_concurrent_pushes, timeout=60.0
         )
         self._pull_waiters: Dict[str, threading.Event] = {}
+
+        # --- cross-node data plane (transport.py): stripe server beside
+        # the RPC server + the peer-link cache (head-granted connection
+        # leases). The per-incarnation auth token never leaves memory
+        # except inside grant replies; a fresh token per agent process
+        # means stale cached links die at the handshake and re-grant.
+        import secrets
+
+        from .transport import PeerLinkCache
+
+        self.net_token = secrets.token_hex(16)
+        self._data_server = None
+        if cfg.native_net:
+            try:
+                from .transport import DataPlaneServer
+
+                self._data_server = DataPlaneServer(
+                    self.store,
+                    self.node_id,
+                    self.net_token,
+                    epoch_fn=lambda: self._head_epoch,
+                    admission=self._push_adm,
+                    host=host,
+                )
+            except Exception:  # noqa: BLE001 - chunked RPC still serves
+                logger.exception(
+                    "data-plane server failed to start; peers fall back "
+                    "to chunked RPC"
+                )
+        self._links = PeerLinkCache(self._grant_peer_link)
 
         # IO-bound pool: threads mostly park on worker RPCs. Sized well past
         # the worker count so async-actor methods (which each hold a thread
@@ -1064,6 +1110,28 @@ class NodeAgent:
             try:
                 for nid, addr in locations:
                     if nid == self.node_id or self.store.contains(oid):
+                        return
+                    # socket plane first (striped, resumable, lands
+                    # straight in the arena); chunked RPC on any miss
+                    try:
+                        size = self._fetch_peer_to_store(
+                            nid, oid, "task_args"
+                        )
+                    except KeyError:
+                        continue
+                    if size is not None:
+                        self._report_to_head(
+                            {
+                                "node_id": self.node_id,
+                                "seals": [
+                                    SealInfo(
+                                        object_id=oid,
+                                        node_id=self.node_id,
+                                        size=size,
+                                    )
+                                ],
+                            }
+                        )
                         return
                     try:
                         data = fetch_chunked(
@@ -1905,19 +1973,49 @@ class NodeAgent:
                         if self.store.contains(oid):
                             return self._local_reply(oid)
                         continue
+                    deadline = (
+                        None
+                        if wait_s is None
+                        else time.monotonic() + wait_s
+                    )
+                    # socket plane first: striped scatter-gather pull
+                    # over the cached peer link, landing straight in the
+                    # arena (zero per-transfer head RPCs)
+                    try:
+                        size = self._fetch_peer_to_store(
+                            nid, oid, purpose, deadline
+                        )
+                    except KeyError:
+                        gone_nodes.append(nid)
+                        continue
+                    if size is not None:
+                        self._report_to_head(
+                            {
+                                "node_id": self.node_id,
+                                "seals": [
+                                    SealInfo(
+                                        object_id=oid,
+                                        node_id=self.node_id,
+                                        size=size,
+                                    )
+                                ],
+                            }
+                        )
+                        return self._local_reply(oid)
                     try:
                         # streamed, chunked, resumable pull: bounded
                         # in-flight windows; a dropped chunk re-requests
-                        # alone instead of restarting the object
+                        # alone instead of restarting the object. The
+                        # relocate hook re-resolves the source between
+                        # chunk retries, so a mid-transfer source death
+                        # aborts to the locate loop instead of burning
+                        # the whole retry budget against a dead peer.
                         data = fetch_chunked(
                             self._peer(nid, addr),
                             oid,
                             purpose=purpose,
-                            deadline=(
-                                None
-                                if wait_s is None
-                                else time.monotonic() + wait_s
-                            ),
+                            deadline=deadline,
+                            relocate=self._make_relocate(oid, nid, addr),
                         )
                     except KeyError:
                         # DEFINITE miss: the peer answered and does not
@@ -1968,6 +2066,36 @@ class NodeAgent:
                     }
                 )
 
+    def _make_relocate(self, oid: str, nid: str, addr: str):
+        """Relocate hook for :func:`fetch_chunked`: one head locate
+        round-trip re-resolving where ``oid`` lives NOW. Returns the
+        client for the current source (still listed), a replacement
+        replica's client (the directory moved it), or None (gone
+        everywhere — the pull aborts so the caller re-plans via its
+        locate loop / lineage reconstruction)."""
+
+        def _relocate():
+            try:
+                rep = self.head.call(
+                    "WaitObject",
+                    {"object_id": oid, "timeout": 0.2},
+                    timeout=10.0,
+                    epoch=self._head_epoch,
+                )
+            except Exception:  # noqa: BLE001 - head unreachable: no verdict
+                return self._peer(nid, addr)  # keep retrying the source
+            if rep.get("status") != "located":
+                return None  # inline/error/pending: stop pulling bytes
+            live = {n: a for n, a in rep["locations"]}
+            if nid in live:
+                return self._peer(nid, live[nid])
+            for n2, a2 in rep["locations"]:
+                if n2 != self.node_id:
+                    return self._peer(n2, a2)
+            return None
+
+        return _relocate
+
     def _local_reply(self, oid: str) -> dict:
         """Workers read 'local' objects straight from the shm arena; a
         spilled object is restored into the arena first (restore path); if
@@ -2000,6 +2128,16 @@ class NodeAgent:
             # and releases any it no longer tracks (pinned-worker leak
             # guard across unpersisted head restarts)
             held_task_leases=held_leases,
+            # cross-node data plane: advertised so the head can grant
+            # peer links to this node (endpoint + token in the grant)
+            data_endpoint=(
+                self._data_server.endpoint
+                if self._data_server is not None
+                else ""
+            ),
+            net_token=(
+                self.net_token if self._data_server is not None else ""
+            ),
         )
 
     def _peer(self, node_id: str, address: str) -> RpcClient:
@@ -2009,6 +2147,113 @@ class NodeAgent:
                 client = RpcClient(address)
                 self._peer_clients[node_id] = client
             return client
+
+    # ------------------------------------------------------------------
+    # cross-node data plane (transport.py): socket-first peer pulls over
+    # head-granted connection leases, chunked RPC as the fallback for
+    # every failure class, RAY_TPU_NATIVE_NET=0 as the kill switch
+    # ------------------------------------------------------------------
+    def _grant_peer_link(self, node_id: str):
+        """One head round-trip per (src, dst) pair — the ONLY control-
+        plane involvement in the socket path; every later transfer to
+        this peer reuses the cached grant head-free."""
+        from .transport import PeerLink
+
+        try:
+            rep = self.head.call(
+                "GrantPeerLink",
+                {"src_node": self.node_id, "dst_node": node_id},
+                timeout=10.0,
+                epoch=self._head_epoch,
+            )
+        except (RpcError, RpcStaleEpochError):
+            return None
+        if not rep.get("granted"):
+            return None
+        return PeerLink(
+            rep["link_id"],
+            node_id,
+            rep["endpoint"],
+            rep["token"],
+            rep.get("epoch"),
+            src_node=self.node_id,
+        )
+
+    def _fetch_peer_to_store(
+        self,
+        nid: str,
+        oid: str,
+        purpose: str,
+        deadline: Optional[float] = None,
+    ) -> Optional[int]:
+        """Socket pull of one object straight into the local store
+        (striped, resumable, arena scatter-landing). Returns the size,
+        or None when the socket plane cannot serve this transfer (link
+        denied, handshake rejected, transport death past the stripe
+        retry budget) — the caller falls back to chunked RPC. KeyError
+        propagates: the peer answered and does not hold the object."""
+        from .transport import LinkRejectedError, StripeFetchError
+
+        if not cfg.native_net or nid == self.node_id:
+            return None
+        link = self._links.get(nid)
+        if link is None:
+            return None
+        from .transport import fetch_to_store
+
+        try:
+            return fetch_to_store(
+                link, oid, self.store, purpose=purpose, deadline=deadline
+            )
+        except KeyError:
+            raise
+        except LinkRejectedError as exc:
+            # epoch re-fence or token rotation (peer agent restarted):
+            # the cached grant is dead — drop it; the next transfer
+            # re-grants through the head and picks up fresh credentials
+            logger.info("peer link to %s rejected (%s); dropping", nid, exc)
+            self._links.drop(nid, link.link_id)
+            return None
+        except (StripeFetchError, ConnectionError, TimeoutError, OSError):
+            return None
+
+    def _h_revoke_peer_link(self, req: dict) -> dict:
+        """Head revoked a link we hold (its destination node died)."""
+        return {
+            "dropped": self._links.drop(
+                req.get("node_id", ""), req.get("link_id")
+            )
+        }
+
+    def _h_chaos_drop_peer_conn(self, req=None) -> dict:
+        """Chaos fault: sever every live data socket this node is
+        SERVING mid-transfer. Pullers' in-flight stripes fail and must
+        resume (only the lost stripes re-fetch) — the invariant the
+        chaos tier asserts."""
+        if self._data_server is None:
+            return {"dropped": 0, "reason": "no data server"}
+        return {"dropped": self._data_server.chaos_drop()}
+
+    def _link_maintenance(self) -> None:
+        """Renew-while-hot + idle reclamation (report-loop cadence):
+        recently-used link ids piggyback on the coalesced seal report;
+        links idle past the TTL close their pooled connections and
+        return the lease to the head."""
+        hot = self._links.hot_links(cfg.peer_link_ttl_s)
+        if hot:
+            self._report_to_head(
+                {"node_id": self.node_id, "peer_links": hot}
+            )
+        for link in self._links.sweep_idle(cfg.peer_link_idle_ttl_s):
+            try:
+                self.head.call(
+                    "ReturnPeerLink",
+                    {"link_id": link.link_id},
+                    timeout=5.0,
+                    epoch=self._head_epoch,
+                )
+            except (RpcError, RpcStaleEpochError):
+                pass  # expiry sweep reclaims it server-side
 
     # ------------------------------------------------------------------
     # reporting (RaySyncer RESOURCE_VIEW analog). Reports are coalesced
@@ -2109,9 +2354,21 @@ class NodeAgent:
     def _report_loop(self) -> None:
         version = 0
         last_head_contact = time.monotonic()
+        last_link_tick = time.monotonic()
         while not self._shutdown:
             time.sleep(REPORT_PERIOD_S)
             version += 1
+            # peer-link upkeep at ~TTL/2 cadence (renewals piggyback on
+            # the coalesced seal report; idle links return their lease)
+            if (
+                time.monotonic() - last_link_tick
+                > cfg.peer_link_ttl_s / 2.0
+            ):
+                last_link_tick = time.monotonic()
+                try:
+                    self._link_maintenance()
+                except Exception:  # noqa: BLE001 - upkeep must not kill beats
+                    logger.exception("peer-link maintenance failed")
             # respawn workers that died outside a push (including ones that
             # crashed at startup before ever registering). A spawn that
             # never registers within the timeout counts as dead too — a
@@ -2604,15 +2861,38 @@ class NodeAgent:
             "chunked_pulls_inflight": int(CHUNKED_PULLS_INFLIGHT.value()),
             "transfer_bytes": {
                 path: int(OBJECT_TRANSFER_BYTES.value({"path": path}))
-                for path in ("shm", "shm_copy", "inline", "rpc")
+                for path in ("shm", "shm_copy", "inline", "rpc", "socket")
             },
             "transfer_chunk_ms": TRANSFER_CHUNK_MS.summary(),
+            "transfer_stripe_ms": TRANSFER_STRIPE_MS.summary(),
             "shm_evictions": int(SHM_EVICTIONS.value()),
             "spilled_objects": st.get("spilled_objects", 0),
             # deleted-with-outstanding-pins entries still holding arena
             # space; nonzero after every reader released (or died and had
             # its pin log replayed) is a leak — the chaos soak asserts 0
             "arena_zombies": self.store.zombie_count(),
+            # cross-node data plane: this node's stripe server + its
+            # cached peer links and the grant/reuse/revoke lifecycle
+            # (process-wide counters, like every metric here)
+            "net": {
+                "enabled": bool(cfg.native_net),
+                "endpoint": (
+                    self._data_server.endpoint
+                    if self._data_server is not None
+                    else None
+                ),
+                "server": (
+                    dict(self._data_server.stats)
+                    if self._data_server is not None
+                    else None
+                ),
+                "links": self._links.snapshot(),
+                "peer_conn": {
+                    "granted": int(PEER_CONN_GRANTED.value()),
+                    "revoked": int(PEER_CONN_REVOKED.value()),
+                    "reused": int(PEER_CONN_REUSED.value()),
+                },
+            },
         }
 
     def _h_chaos_kill_zygote(self, req=None) -> dict:
@@ -2652,6 +2932,12 @@ class NodeAgent:
         if self._zygote is not None:
             self._zygote.close()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        # data plane down before the store: a mid-teardown stripe serve
+        # must not race the arena unlink (teardown exactly-once — both
+        # closes are idempotent)
+        if self._data_server is not None:
+            self._data_server.close()
+        self._links.close()
         try:
             self.store.close(unlink=True)
         except Exception:  # noqa: BLE001

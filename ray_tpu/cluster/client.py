@@ -1382,6 +1382,9 @@ class RemoteRuntime:
         from ray_tpu.core import refcount
 
         self.client_id = refcount.get_holder_id()
+        # peer-leased data links for big-object pulls (transport.py),
+        # lazily built on the first located fetch
+        self._peer_links = None
         # direct actor calls: per-actor submission channels straight to the
         # hosting worker; results arrive on a lazily-started callback
         # server. RAY_TPU_DIRECT_ACTOR_CALLS=0 forces everything through
@@ -2429,9 +2432,13 @@ class RemoteRuntime:
             client = self._agents.get(seal.node_id)
         if client is not None:
             try:
-                data = client.call(
-                    "FetchObject", {"object_id": h, "purpose": "get"}, timeout=120.0
-                )
+                data = self._socket_fetch(seal.node_id, h)
+                if data is None:
+                    data = client.call(
+                        "FetchObject",
+                        {"object_id": h, "purpose": "get"},
+                        timeout=120.0,
+                    )
                 value = self._loads_tracking(data)
                 with self._direct_cv:
                     self._direct_results.pop(h, None)
@@ -2670,11 +2677,16 @@ class RemoteRuntime:
                 gone: List[str] = []
                 for nid, addr in reply["locations"]:
                     try:
-                        data = self._agent(nid, addr).call(
-                            "FetchObject",
-                            {"object_id": ref.hex, "purpose": "get"},
-                            timeout=120.0,
-                        )
+                        # peer-leased socket first (striped scatter-gather
+                        # pull, zero per-transfer head RPCs after the one
+                        # grant); chunked RPC on any transport miss
+                        data = self._socket_fetch(nid, ref.hex)
+                        if data is None:
+                            data = self._agent(nid, addr).call(
+                                "FetchObject",
+                                {"object_id": ref.hex, "purpose": "get"},
+                                timeout=120.0,
+                            )
                     except KeyError:
                         # definite miss: the node answered without the
                         # object (evicted / lost mid-spill / stale row)
@@ -2872,6 +2884,109 @@ class RemoteRuntime:
             return client
 
     # ------------------------------------------------------------------
+    # cross-node data plane (transport.py): drivers pull big results
+    # over the same peer-leased sockets agents use — one GrantPeerLink
+    # per (driver, node) pair, then every fetch is head-free
+    # ------------------------------------------------------------------
+    def _link_cache(self):
+        with self._lock:
+            if self._peer_links is None:
+                from .transport import PeerLinkCache
+
+                self._peer_links = PeerLinkCache(self._grant_peer_link)
+                # renew-while-hot + idle reclamation, the driver-side
+                # mirror of the agent's _link_maintenance (without it,
+                # every driver link expires 'revoked' at the head ~3xTTL
+                # in and pooled sockets linger until process exit)
+                threading.Thread(
+                    target=self._link_maintenance_loop,
+                    name="client-peer-links",
+                    daemon=True,
+                ).start()
+            return self._peer_links
+
+    def _link_maintenance_loop(self) -> None:
+        from ray_tpu.config import cfg
+
+        while not self._stop_event.wait(
+            max(1.0, cfg.peer_link_ttl_s / 2.0)
+        ):
+            cache = self._peer_links
+            if cache is None:
+                continue
+            try:
+                hot = cache.hot_links(cfg.peer_link_ttl_s)
+                if hot:
+                    self.head.call(
+                        "RenewPeerLinks",
+                        {"link_ids": hot},
+                        timeout=5.0,
+                        epoch=self._cluster_epoch,
+                    )
+                for link in cache.sweep_idle(cfg.peer_link_idle_ttl_s):
+                    try:
+                        self.head.call(
+                            "ReturnPeerLink",
+                            {"link_id": link.link_id},
+                            timeout=5.0,
+                            epoch=self._cluster_epoch,
+                        )
+                    except Exception:  # noqa: BLE001 - sweep reclaims
+                        pass
+            except Exception:  # noqa: BLE001 - upkeep never kills the loop
+                if self._stop_event.is_set():
+                    return
+
+    def _grant_peer_link(self, node_id: str):
+        from .transport import PeerLink
+
+        try:
+            rep = self.head.call(
+                "GrantPeerLink",
+                {"src_node": self.client_id, "dst_node": node_id},
+                timeout=10.0,
+                epoch=self._cluster_epoch,
+            )
+        except Exception:  # noqa: BLE001 - head busy: RPC path still works
+            return None
+        if not rep.get("granted"):
+            return None
+        return PeerLink(
+            rep["link_id"],
+            node_id,
+            rep["endpoint"],
+            rep["token"],
+            rep.get("epoch"),
+            src_node=self.client_id,
+        )
+
+    def _socket_fetch(self, nid: str, h: str) -> "Optional[memoryview]":
+        """Socket pull of one object from a node's data server. None =
+        plane unavailable for this transfer (caller uses the FetchObject
+        RPC); KeyError propagates (definite miss — the caller prunes the
+        location). Returns a READ-ONLY view: numpy payloads deserialize
+        as immutable views exactly like the RPC path's bytes reply."""
+        from ray_tpu.config import cfg
+
+        if not cfg.native_net:
+            return None
+        from .transport import LinkRejectedError, StripeFetchError
+        from .transport import fetch_bytes as _fetch_bytes
+
+        link = self._link_cache().get(nid)
+        if link is None:
+            return None
+        try:
+            return memoryview(_fetch_bytes(link, h, purpose="get")).toreadonly()
+        except KeyError:
+            raise
+        except LinkRejectedError:
+            self._peer_links.drop(nid, link.link_id)
+            return None
+        except (StripeFetchError, ConnectionError, TimeoutError, OSError):
+            return None
+
+    # ------------------------------------------------------------------
     # placement groups
     # ------------------------------------------------------------------
     def create_placement_group(
@@ -3005,6 +3120,9 @@ class RemoteRuntime:
             for client in self._agents.values():
                 client.close()
             self._agents.clear()
+            if self._peer_links is not None:
+                self._peer_links.close()
+                self._peer_links = None
 
 
 def connect(address: str, runtime_env: Optional[dict] = None) -> RemoteRuntime:

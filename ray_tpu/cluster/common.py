@@ -88,6 +88,13 @@ class NodeInfo:
     # and releases any it no longer tracks, so leased workers never stay
     # pinned to a lease the control plane forgot
     held_task_leases: List[str] = field(default_factory=list)
+    # cross-node data plane (transport.py): where this node's stripe
+    # server listens, and the per-incarnation auth token peers must
+    # present on the data-path handshake. The head hands both out in
+    # peer-link grants; an agent restart mints a fresh token, so stale
+    # cached links are rejected and re-granted automatically.
+    data_endpoint: str = ""
+    net_token: str = ""
 
 
 @dataclass

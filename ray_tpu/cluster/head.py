@@ -53,6 +53,7 @@ from .common import (
     new_id,
     stream_item_id,
 )
+from .object_plane import PEER_CONN_GRANTED, PEER_CONN_REVOKED
 from .rpc import RpcClient, RpcError, RpcServer
 
 logger = logging.getLogger("ray_tpu.cluster.head")
@@ -307,6 +308,16 @@ class HeadServer:
         # to their leased workers regardless — the head is off that path).
         self._task_leases: Dict[str, dict] = {}
         self._grant_gate = threading.BoundedSemaphore(8)
+        # peer-link lease table (cross-node data plane, transport.py):
+        # link_id -> {link_id, src, dst, endpoint, granted_at,
+        # expires_at}. The grant hands the requester the destination's
+        # data endpoint + auth token ONCE per (src, dst) pair;
+        # steady-state transfers then make zero head RPCs. Rows persist
+        # in the snapshot/WAL (granted links keep serving across a head
+        # restart), renew via piggybacked agent reports, and are revoked
+        # on either endpoint node's death.
+        self._peer_links: Dict[str, dict] = {}
+        self._peer_links_by_pair: Dict[tuple, str] = {}
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
         self._named_actors: Dict[str, str] = {}
@@ -372,6 +383,8 @@ class HeadServer:
             "task_leases_granted": 0,
             "task_leases_returned": 0,
             "task_leases_revoked": 0,
+            "peer_links_granted": 0,
+            "peer_links_revoked": 0,
             "preempt_nominations": 0,
             "preemptions": 0,
         }
@@ -401,6 +414,12 @@ class HeadServer:
             "FreeObjects": self._h_free_objects,
             "RefUpdate": lambda r: self._h_ref_update(r, src="direct"),
             "GrantTaskLease": self._h_grant_task_lease,
+            "GrantPeerLink": self._h_grant_peer_link,
+            "ReturnPeerLink": self._h_return_peer_link,
+            # drivers renew directly (agents piggyback on ReportSeals)
+            "RenewPeerLinks": lambda r: self._renew_peer_links(
+                r.get("link_ids", ())
+            ),
             "CreateActor": self._h_create_actor,
             "GetActor": self._h_get_actor,
             "WaitActor": self._h_wait_actor,
@@ -513,6 +532,12 @@ class HeadServer:
                     for e in self._task_leases.values()
                     if e["state"] == "active"
                 ],
+                # granted peer data links: revocation/expiry bookkeeping
+                # survives a restart (the links themselves keep serving
+                # head-free; tokens re-learn from re-registration)
+                "peer_links": [
+                    self._peer_link_row(e) for e in self._peer_links.values()
+                ],
             } | streams_part
 
     def _snapshot_streams(self) -> dict:
@@ -621,6 +646,8 @@ class HeadServer:
         ttl = cfg.task_lease_ttl_s
         for row in snap.get("task_leases", []):
             self._restore_task_lease(row, now_m, ttl)
+        for row in snap.get("peer_links", []):
+            self._restore_peer_link(row)
         for actor_id, fields in snap.get("actors", {}).items():
             info = ActorInfo(**fields)
             # hosting agents re-register and re-attach; until then, unknown
@@ -664,6 +691,14 @@ class HeadServer:
                 )
             elif kind == "task_lease_gone":
                 self._task_leases.pop(rec[1], None)
+            elif kind == "peer_link":
+                self._restore_peer_link(rec[1])
+            elif kind == "peer_link_gone":
+                e = self._peer_links.pop(rec[1], None)
+                if e is not None:
+                    self._peer_links_by_pair.pop(
+                        (e["src"], e["dst"]), None
+                    )
         logger.info(
             "recovered head state: %d kv keys, %d actors, %d jobs, "
             "%d WAL records",
@@ -704,6 +739,17 @@ class HeadServer:
                 name="head-actor-recover",
                 daemon=True,
             ).start()
+
+    def _restore_peer_link(self, row: dict) -> None:
+        """Rebuild one persisted peer-link row (expiry rebased; at least
+        one TTL of grace so live holders get a renewal in first)."""
+        e = dict(row)
+        remaining = float(e.pop("ttl_remaining_s", 0.0))
+        e["expires_at"] = time.monotonic() + max(
+            remaining, cfg.peer_link_ttl_s
+        )
+        self._peer_links[e["link_id"]] = e
+        self._peer_links_by_pair[(e["src"], e["dst"])] = e["link_id"]
 
     def _restore_task_lease(self, row: dict, now_m: float, ttl: float) -> None:
         """Rebuild one persisted lease row (expiry rebased onto this
@@ -985,6 +1031,7 @@ class HeadServer:
                 self._on_node_death(nid)
             self._gc_idle_streams()
             self._expire_task_leases()
+            self._expire_peer_links()
             self._check_owner_liveness()
 
     def _on_node_death(self, node_id: str) -> None:
@@ -1029,6 +1076,8 @@ class HeadServer:
                 self.metrics["task_leases_revoked"] += 1
                 TASK_LEASE_REVOKED.inc()
             self._cond.notify_all()
+        # peer data links touching the dead node: revoke + notify holders
+        self._revoke_node_peer_links(node_id)
         # in-flight leases on the dead node: retry or fail
         requeued = set()
         for lid, spec in lost_leases:
@@ -1509,6 +1558,10 @@ class HeadServer:
             self._h_object_missing(miss)
         if req.get("task_leases"):
             self._apply_task_lease_reports(req["task_leases"])
+        if req.get("peer_links"):
+            # renew-while-hot: ids of links this agent used recently,
+            # piggybacked on the coalesced report (no dedicated RPC)
+            self._renew_peer_links(req["peer_links"])
         for actor_ready in req.get("actors_alive", []):
             self._mark_actor_alive(**actor_ready)
         for actor_dead in req.get("actors_dead", []):
@@ -2365,6 +2418,153 @@ class HeadServer:
             self._wal_flush()
             if node_id:
                 self._agent_return_lease(node_id, lid)
+
+    # ------------------------------------------------------------------
+    # peer data links (cross-node transport, transport.py): the task-
+    # lease pattern applied to connections — the head grants a peer link
+    # ONCE per (src, dst) pair (endpoint + auth token + epoch in the
+    # grant), then steady-state transfers make zero head RPCs. Links
+    # renew while hot via piggybacked agent reports, are reclaimed on
+    # the requester's idle TTL (ReturnPeerLink), expire on a missed-
+    # renewal sweep, and are revoked when either endpoint node dies.
+    # ------------------------------------------------------------------
+    def _h_grant_peer_link(self, req: dict) -> dict:
+        if not cfg.native_net:
+            return {"granted": False, "reason": "native net disabled"}
+        src = req.get("src_node", "")
+        dst = req["dst_node"]
+        ttl = cfg.peer_link_ttl_s
+        with self._lock:
+            node = self.nodes.get(dst)
+            if (
+                node is None
+                or not node.alive
+                or not getattr(node, "data_endpoint", "")
+            ):
+                return {
+                    "granted": False,
+                    "reason": f"node {dst} has no live data endpoint",
+                }
+            lid = self._peer_links_by_pair.get((src, dst))
+            e = self._peer_links.get(lid) if lid else None
+            if e is None:
+                e = {
+                    "link_id": new_id(),
+                    "src": src,
+                    "dst": dst,
+                    "endpoint": node.data_endpoint,
+                    "granted_at": time.time(),
+                    "expires_at": time.monotonic() + max(3.0 * ttl, 15.0),
+                }
+                self._peer_links[e["link_id"]] = e
+                self._peer_links_by_pair[(src, dst)] = e["link_id"]
+                self.metrics["peer_links_granted"] += 1
+                PEER_CONN_GRANTED.inc()
+                self._wal(("peer_link", self._peer_link_row(e)))
+            else:
+                # same pair re-granting (requester restarted or dropped
+                # its cache): refresh the existing row, don't duplicate
+                e["endpoint"] = node.data_endpoint
+                e["expires_at"] = time.monotonic() + max(3.0 * ttl, 15.0)
+            reply = {
+                "granted": True,
+                "link_id": e["link_id"],
+                "node_id": dst,
+                "endpoint": node.data_endpoint,
+                # the token travels only in the grant reply (never the
+                # WAL/snapshot — parity with the on-disk endpoint file)
+                "token": getattr(node, "net_token", ""),
+                "epoch": self.cluster_epoch,
+                "ttl_s": float(ttl),
+            }
+        self._wal_flush()
+        return reply
+
+    @staticmethod
+    def _peer_link_row(e: dict) -> dict:
+        row = {
+            k: e[k] for k in ("link_id", "src", "dst", "endpoint", "granted_at")
+        }
+        row["ttl_remaining_s"] = max(0.0, e["expires_at"] - time.monotonic())
+        return row
+
+    def _drop_peer_link_locked(
+        self, link_id: str, revoked: bool = True
+    ) -> Optional[dict]:
+        e = self._peer_links.pop(link_id, None)
+        if e is None:
+            return None
+        pair = (e["src"], e["dst"])
+        if self._peer_links_by_pair.get(pair) == link_id:
+            del self._peer_links_by_pair[pair]
+        self._wal(("peer_link_gone", link_id))
+        if revoked:
+            self.metrics["peer_links_revoked"] += 1
+            PEER_CONN_REVOKED.inc()
+        return e
+
+    def _h_return_peer_link(self, req: dict) -> None:
+        """Requester reclaimed an idle link (idle TTL / shutdown)."""
+        with self._lock:
+            self._drop_peer_link_locked(req["link_id"], revoked=False)
+        self._wal_flush()
+
+    def _renew_peer_links(self, link_ids) -> None:
+        """Piggybacked renewals from agent reports (renew-while-hot)."""
+        horizon = time.monotonic() + max(3.0 * cfg.peer_link_ttl_s, 15.0)
+        with self._lock:
+            for lid in link_ids:
+                e = self._peer_links.get(lid)
+                if e is not None:
+                    e["expires_at"] = horizon
+
+    def _expire_peer_links(self) -> None:
+        """Dead-holder safety net: drop links not renewed within 3x TTL
+        (a crashed requester can't ReturnPeerLink). No agent callout —
+        the requester side re-grants on next use, and the serving side
+        authenticates per handshake, not per table row."""
+        now = time.monotonic()
+        with self._lock:
+            victims = [
+                lid
+                for lid, e in self._peer_links.items()
+                if now > e["expires_at"]
+            ]
+            for lid in victims:
+                self._drop_peer_link_locked(lid)
+        if victims:
+            self._wal_flush()
+
+    def _revoke_node_peer_links(self, node_id: str) -> None:
+        """Node death: revoke every link touching it, and tell surviving
+        REQUESTERS to drop their cached grants promptly (best-effort —
+        a stale cached link also dies on its next handshake, because the
+        dead node's token/endpoint are gone)."""
+        with self._lock:
+            victims = [
+                dict(e)
+                for e in self._peer_links.values()
+                if node_id in (e["src"], e["dst"])
+            ]
+            for e in victims:
+                self._drop_peer_link_locked(e["link_id"])
+        if not victims:
+            return
+        self._wal_flush()
+        for e in victims:
+            if e["dst"] != node_id:
+                continue  # only the requester side holds a cache
+            client = self._clients.get(e["src"])
+            if client is not None:
+                try:
+                    self._dispatch_pool.submit(
+                        _best_effort,
+                        client.call,
+                        "RevokePeerLink",
+                        {"link_id": e["link_id"], "node_id": e["dst"]},
+                    )
+                except RuntimeError:
+                    return  # pool closed (head shutting down mid-death)
 
     @property
     def device_state(self):
@@ -4359,6 +4559,33 @@ class HeadServer:
             from .rpc import HANDLER_STATS
 
             return HANDLER_STATS.snapshot()
+        if kind == "object_plane":
+            # cross-node transport: peer-link table occupancy + grant/
+            # revoke lifecycle counts and the head-process transfer
+            # counters (agents expose their own via DebugState "net")
+            from .object_plane import (
+                OBJECT_TRANSFER_BYTES,
+                PEER_CONN_REUSED,
+                TRANSFER_STRIPE_MS,
+            )
+
+            with self._lock:
+                links = [
+                    self._peer_link_row(e)
+                    for e in self._peer_links.values()
+                ]
+            return {
+                "peer_links": links,
+                "peer_link_count": len(links),
+                "peer_links_granted": self.metrics["peer_links_granted"],
+                "peer_links_revoked": self.metrics["peer_links_revoked"],
+                "peer_links_reused": int(PEER_CONN_REUSED.value()),
+                "transfer_bytes": {
+                    path: int(OBJECT_TRANSFER_BYTES.value({"path": path}))
+                    for path in ("shm", "inline", "rpc", "socket")
+                },
+                "transfer_stripe_ms": TRANSFER_STRIPE_MS.summary(),
+            }
         if kind == "hotpath":
             # execution-plane hot path: framing-path selection + native
             # vs fallback counters, fused-event-loop occupancy, ring

@@ -23,8 +23,30 @@ OBJECT_TRANSFER_BYTES = _Counter(
     "object_transfer_bytes_total",
     "Object payload bytes moved, by path: shm (zero-copy arena view), "
     "inline (control-message inline value), rpc (pickled fetch / chunked "
-    "peer pull).",
+    "peer pull), socket (direct peer-leased data socket, scatter-gather "
+    "C plane).",
     label_names=("path",),
+)
+PEER_CONN_GRANTED = _Counter(
+    "peer_conn_granted_total",
+    "Peer data-link leases granted by the head (one per (src, dst) pair "
+    "until revoked/returned; steady-state transfers reuse the grant).",
+)
+PEER_CONN_REVOKED = _Counter(
+    "peer_conn_revoked_total",
+    "Peer data-link leases revoked (node death, renewal expiry) or "
+    "returned on idle TTL.",
+)
+PEER_CONN_REUSED = _Counter(
+    "peer_conn_reused_total",
+    "Transfers served from an already-granted cached peer link (zero "
+    "head RPCs).",
+)
+TRANSFER_STRIPE_MS = _Histogram(
+    "transfer_stripe_ms",
+    "Per-stripe round-trip latency of socket peer transfers (request "
+    "sent to last payload byte landed).",
+    boundaries=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 5000),
 )
 SHM_HITS = _Counter(
     "shm_store_hits_total",
@@ -56,6 +78,7 @@ def fetch_chunked(
     purpose: str = "task_args",
     size: Optional[int] = None,
     deadline: Optional[float] = None,
+    relocate=None,
 ) -> "bytes | bytearray":
     """Pull one object from a peer agent, chunked and resumable.
 
@@ -64,6 +87,15 @@ def fetch_chunked(
     with at most cfg.transfer_max_inflight_chunks concurrent requests;
     each chunk retries independently (transport retries + one re-request)
     before the whole pull is abandoned with :class:`ChunkFetchError`.
+
+    ``relocate`` (optional, ``() -> client | None``) is consulted between
+    chunk retry attempts after a TRANSPORT failure: it re-resolves the
+    object's location and returns the client to continue from (the same
+    peer, or a replica the directory moved to). ``None`` means the source
+    is gone everywhere it was known — the pull aborts IMMEDIATELY with
+    :class:`ChunkFetchError` so the caller re-plans through its locate
+    loop instead of burning the whole per-chunk retry budget against a
+    dead peer.
 
     Raises ``KeyError`` when the peer no longer holds the object.
     """
@@ -104,6 +136,29 @@ def fetch_chunked(
     sem = threading.Semaphore(max_inflight)
     failed: list = []
     fail_lock = threading.Lock()
+    # current source peer, shared across chunk threads: a mid-transfer
+    # relocation swaps the client for EVERY remaining chunk at once
+    peer = [client]
+
+    def _relocate_peer() -> None:
+        """One thread re-resolves the source after a transport failure;
+        a gone-everywhere verdict aborts the pull (caller re-plans)."""
+        if relocate is None:
+            return
+        with fail_lock:
+            cur = peer[0]
+        try:
+            fresh = relocate()
+        except Exception:  # noqa: BLE001 - locate failed: keep retrying
+            return
+        if fresh is None:
+            raise ChunkFetchError(
+                f"source of {object_id} is gone (re-resolve found no "
+                "live replica); caller must re-plan"
+            )
+        if fresh is not cur:
+            with fail_lock:
+                peer[0] = fresh
 
     def _one(off: int) -> None:
         want = min(chunk_bytes, size - off)
@@ -114,8 +169,10 @@ def fetch_chunked(
             # deadline (a 2s-budget pull must not park for 3 x 60s)
             for attempt in (0, 1, 2):
                 t0 = time.perf_counter()
+                with fail_lock:
+                    cur = peer[0]
                 try:
-                    part = client.call(
+                    part = cur.call(
                         "FetchObjectChunk",
                         {
                             "object_id": object_id,
@@ -131,6 +188,9 @@ def fetch_chunked(
                 except Exception:  # noqa: BLE001 - dropped/slow chunk
                     if attempt == 2:
                         raise
+                    # re-resolve the location BEFORE the retry: a dead
+                    # source must not eat the remaining budget too
+                    _relocate_peer()
                     continue
                 TRANSFER_CHUNK_MS.observe((time.perf_counter() - t0) * 1e3)
                 if len(part) != want:

@@ -429,6 +429,50 @@ define(
     "in-flight cap analog, per transfer).",
 )
 define(
+    "native_net",
+    True,
+    "Cross-node zero-copy transport: direct worker<->worker data sockets "
+    "(native/net.cc sendmsg/recvmsg scatter-gather over RTP5 frames, "
+    "head-granted peer connection leases, striping for large objects). "
+    "Off: every cross-node transfer rides the chunked-RPC fallback "
+    "(object_plane.fetch_chunked). Read live — flip mid-process for "
+    "A/B; in-flight transfers finish on their current path.",
+)
+define(
+    "net_stripe_bytes",
+    64 << 20,
+    "Stripe size for socket peer transfers: objects larger than one "
+    "stripe split across parallel connections with per-stripe offsets; "
+    "a severed connection re-fetches only its lost stripes (resume).",
+)
+define(
+    "net_stripe_conns",
+    4,
+    "Max parallel data connections one striped transfer fans out over "
+    "(>1 GB objects ride N sockets; single-stripe objects use one).",
+)
+define(
+    "net_inflight_cap_bytes",
+    256 << 20,
+    "Cap on in-flight (requested, not yet landed) bytes per striped "
+    "transfer — backpressure into the receiving arena.",
+)
+define(
+    "peer_link_ttl_s",
+    10.0,
+    "Renewal horizon of a granted peer data link: agents piggyback "
+    "renewals for recently-used links on their seal reports, and the "
+    "head's sweep revokes links not renewed within 3x this (dead-holder "
+    "safety net; an actively-renewed link never expires).",
+)
+define(
+    "peer_link_idle_ttl_s",
+    60.0,
+    "Requester-side idle TTL: a cached peer link with no transfer for "
+    "this long closes its pooled connections and returns the lease to "
+    "the head.",
+)
+define(
     "worker_shm_reads",
     True,
     "Workers resolve same-node objects as zero-copy read-only views over "

@@ -15,6 +15,7 @@ SOURCES = {
     "ledger": "ledger.cc",
     "ring": "ring.cc",
     "wire": "wire.cc",
+    "net": "net.cc",
 }
 
 

@@ -212,6 +212,42 @@ class NativeObjectStore:
         self._lib.rtpu_store_seal(self._h, oid)
         return total
 
+    # -- staged puts (cross-node receive path) --------------------------
+    def begin_put(self, object_id: str, total: int) -> memoryview:
+        """Allocate an UNSEALED arena entry and hand back a writable view
+        over its pages — the cross-node receive path scatter-writes
+        stripes straight into shared memory (put_frames split into
+        allocate / land / seal so the landing can happen from socket
+        recv loops). Finish with :meth:`commit_put` (seal) or
+        :meth:`abort_put` (free); readers cannot observe the entry until
+        the commit."""
+        oid = self._norm_id(object_id)
+        off = self._lib.rtpu_store_create(self._h, oid, total)
+        if off == -2:
+            raise KeyError(f"object {object_id} already in store")
+        if off < 0:
+            raise MemoryError(f"native store allocation failed ({off})")
+        base = self._lib.rtpu_store_base(self._h)
+        return memoryview(
+            (ctypes.c_char * total).from_address(
+                ctypes.addressof(base.contents) + off
+            )
+        ).cast("B")
+
+    def commit_put(self, object_id: str) -> None:
+        self._lib.rtpu_store_seal(self._h, self._norm_id(object_id))
+
+    def abort_put(self, object_id: str) -> None:
+        """Free a staged entry whose transfer failed. Deletes the
+        UNSEALED entry directly (delete tombstones any entry whose only
+        share is the creator's) — the half-landed bytes are never
+        observable: get refuses unsealed entries, and no seal ever
+        happens on this path."""
+        try:
+            self._lib.rtpu_store_delete(self._h, self._norm_id(object_id))
+        except Exception:  # noqa: BLE001 - best-effort reclamation
+            pass
+
     def get_buffer(self, object_id: str) -> Tuple[int, int]:
         oid = self._norm_id(object_id)
         off = ctypes.c_uint64()

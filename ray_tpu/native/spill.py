@@ -204,6 +204,50 @@ class SpillingStore:
         )
         self.put_bytes(oid, data)
 
+    # -- staged puts (cross-node receive path) -------------------------
+    def begin_put(self, oid: str, total: int) -> Optional[memoryview]:
+        """Stage an arena entry for a cross-node transfer to scatter
+        stripes into (spilling LRU residents to make room first).
+        Returns None when the arena cannot host it even after eviction
+        (or the inner store has no staged-put support) — the receiver
+        then lands into host memory and takes the put_bytes route, which
+        owns the spill-to-disk fallback."""
+        beginner = getattr(self.inner, "begin_put", None)
+        if beginner is None:
+            return None
+        for attempt in range(2):
+            with self._lock:
+                if self.inner.contains(oid) or oid in self._spilled:
+                    raise KeyError(f"object {oid} already in store")
+                try:
+                    return beginner(oid, total)
+                except MemoryError:
+                    pass
+                except KeyError:
+                    # the entry exists but is NOT sealed (contains() was
+                    # false): a CONCURRENT transfer is staging the same
+                    # object right now. That is not a duplicate — the
+                    # other pull may still abort — so land in host
+                    # memory instead; the final put_bytes is dup-safe
+                    # whichever transfer seals first.
+                    return None
+            if attempt == 0:
+                self._make_room(total)
+        return None
+
+    def commit_put(self, oid: str) -> None:
+        with self._lock:
+            self.inner.commit_put(oid)
+            size = getattr(self.inner, "object_size", lambda _o: 0)(oid)
+            self._resident[oid] = size
+            self._resident.move_to_end(oid)
+
+    def abort_put(self, oid: str) -> None:
+        with self._lock:
+            aborter = getattr(self.inner, "abort_put", None)
+            if aborter is not None:
+                aborter(oid)
+
     def get_range(self, oid: str, offset: int, length: int) -> bytes:
         """One window of an object (chunked peer transfers): arena
         residents slice in place. A spilled object is RESTORED to the

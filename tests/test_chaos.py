@@ -56,6 +56,8 @@ def _run_chaos(
     num_nodes: int = 1,
     convergence_budget_s: float = 45.0,
     partition_hold_s: float = 0.5,
+    mix=None,
+    payload_bytes: int = 150_000,
 ):
     import ray_tpu  # noqa: F401
     from ray_tpu.chaos import ChaosOrchestrator, ChaosWorkload
@@ -72,8 +74,13 @@ def _run_chaos(
     rt = cluster.client()
     set_runtime(rt)
     try:
-        workload = ChaosWorkload(rt, payload_bytes=150_000, num_actors=1)
-        plan = make_plan(seed, num_faults, allow=allow)
+        workload = ChaosWorkload(
+            rt, payload_bytes=payload_bytes, num_actors=1
+        )
+        if mix is not None:
+            plan = make_plan(seed, num_faults, mix=mix, allow=allow)
+        else:
+            plan = make_plan(seed, num_faults, allow=allow)
         orch = ChaosOrchestrator(
             cluster,
             workload,
@@ -152,3 +159,37 @@ def test_chaos_soak_twenty_faults_zero_acked_loss(monkeypatch):
     )
     # replaying the seed reproduces the same schedule
     assert make_plan(seed, 20) == make_plan(seed, 20)
+
+
+@pytest.mark.slow
+def test_chaos_net_mix_peer_conn_drop_soak(monkeypatch):
+    """Cross-node transport under chaos: a NET_MIX plan (peer_conn_drop
+    severing served data sockets mid-transfer, plus partitions and
+    object drops) against a 2-node cluster moving multi-stripe objects.
+    Invariant: zero acked-object loss — severed stripes RESUME (and, on
+    harder faults, transfers fall back to chunked RPC / lineage), never
+    corrupt or lose an acked value."""
+    from ray_tpu.chaos import NET_MIX
+
+    # small stripes so the 1.5 MB workload payloads stripe across
+    # connections, widening the mid-transfer window the severs land in
+    monkeypatch.setenv("RAY_TPU_NET_STRIPE_BYTES", str(1 << 20))
+    monkeypatch.setenv("RAY_TPU_HEALTH_TIMEOUT_S", "4.0")
+    # default seed chosen so the 8-draw schedule includes >=1
+    # peer_conn_drop (the kind under test) alongside the other faults
+    seed = chaos_seed(default=20261104)
+    result = _run_chaos(
+        num_faults=8,
+        allow=("peer_conn_drop", "object_drop", "partition"),
+        seed=seed,
+        num_nodes=2,
+        mix=NET_MIX,
+        payload_bytes=1_500_000,
+        convergence_budget_s=60.0,
+    )
+    assert result.ok, (
+        f"invariants failed (replay with RAY_TPU_CHAOS_SEED={seed}): "
+        f"{result.summary()}"
+    )
+    assert result.summary()["fault_counts"].get("peer_conn_drop", 0) >= 1
+    assert result.objects_acked > 0
