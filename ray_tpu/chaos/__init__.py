@@ -16,6 +16,7 @@ from .orchestrator import (  # noqa: F401
 )
 from .plan import (  # noqa: F401
     DEFAULT_MIX,
+    FAILOVER_MIX,
     KINDS,
     NET_MIX,
     SERVE_MIX,

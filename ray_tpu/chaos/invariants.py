@@ -193,6 +193,69 @@ class InvariantChecker:
             out.append(f"owner {cid[:8]} session never declared dead")
         return out
 
+    def wait_standby_promoted(
+        self, pre_epoch: int, timeout: float
+    ) -> List[str]:
+        """After a head_kill_promote: within the budget the cluster must
+        have EXACTLY ONE leader — a promoted head whose epoch strictly
+        exceeds the killed leader's — and every other head incarnation
+        this cluster ever ran must be down or self-fenced (its writes
+        provably rejected)."""
+        from ray_tpu.cluster.rpc import RpcClient
+
+        deadline = time.monotonic() + timeout
+        head = self.cluster.head
+        while time.monotonic() < deadline:
+            head = self.cluster.head
+            if (
+                getattr(head, "role", "leader") == "leader"
+                and not getattr(head, "_fenced", False)
+                and not getattr(head, "_shutdown", False)
+                and head.cluster_epoch > pre_epoch
+            ):
+                # every prior incarnation provably inert: its listener
+                # is down, or what still answers identifies as fenced
+                # (self-fenced deposed leader, writes rejected)
+                live_old_leaders = []
+                for h in getattr(self.cluster, "_dead_heads", []):
+                    probe = RpcClient(h.address)
+                    try:
+                        role = probe.call("HeadRole", {}, timeout=2.0)
+                    except Exception:  # noqa: BLE001 - listener down: inert
+                        continue
+                    finally:
+                        probe.close()
+                    if (
+                        isinstance(role, dict)
+                        and role.get("role") == "leader"
+                        and role.get("epoch") == h.cluster_epoch
+                    ):
+                        live_old_leaders.append(h.address)
+                if not live_old_leaders:
+                    return []
+                return [
+                    "split-brain: prior head(s) still answering as "
+                    f"leader: {live_old_leaders}"
+                ]
+            time.sleep(0.05)
+        return [
+            "standby never promoted: head epoch "
+            f"{getattr(head, 'cluster_epoch', 0)} vs pre-kill "
+            f"{pre_epoch}, role={getattr(head, 'role', '?')}, "
+            f"fenced={getattr(head, '_fenced', '?')} after {timeout:.0f}s"
+        ]
+
+    def wait_inflight_survive(self, adapter, timeout: float) -> List[str]:
+        """After a failover: every lease wave submitted BEFORE the kill
+        completes (or fails definitively) through the new leader with
+        zero acked-object loss; active serve streams (when a serve
+        adapter drives them) keep completing token-exact."""
+        failures = self.check_leases_drained(timeout=timeout)
+        failures += self.workload.verify_acked(timeout=timeout)
+        if adapter is not None:
+            failures += self.wait_streams_resume(adapter, timeout=timeout)
+        return failures
+
     def wait_streams_resume(self, adapter, timeout: float) -> List[str]:
         """After a replica_kill: in-flight streams must fail over (or
         restart) and KEEP COMPLETING with byte-exact token sequences —

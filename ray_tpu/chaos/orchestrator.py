@@ -257,6 +257,19 @@ class ChaosOrchestrator:
                 return "skipped: no persist_path (head restart needs one)"
             self.cluster.restart_head()
             return "head restarted on the same port"
+        if kind == "head_kill_promote":
+            standby = getattr(self.cluster, "standby", None)
+            if standby is None or standby.promoted is not None:
+                return "skipped: no armed warm standby"
+            self._pre_kill_epoch = self.cluster.head.cluster_epoch
+            self._head_killed = True
+            self.cluster.kill_head()
+            if not standby.auto_promote:
+                self.cluster.promote()
+            return (
+                "SIGKILLed the leader (epoch "
+                f"{self._pre_kill_epoch}); standby promoting"
+            )
         if kind == "partition":
             nid = self._pick_node(spec)
             if nid is None:
@@ -371,11 +384,45 @@ class ChaosOrchestrator:
                 self._dropped_hex: Optional[str] = None
                 self._killed_owner = None
                 self._killed_replica = None
+                self._head_killed = False
+                self._pre_kill_epoch = 0
                 detail = self._inject(spec)
                 logger.info(
                     "chaos #%d %s: %s", spec.index, spec.kind, detail
                 )
+                promote_failures: List[str] = []
+                if self._head_killed:
+                    # the promotion must land BEFORE the generic
+                    # convergence pass (which reads cluster.head): epoch
+                    # strictly increased, exactly one unfenced leader,
+                    # then every in-flight wave started before the kill
+                    # completes with zero acked loss
+                    promote_failures = self.checker.wait_standby_promoted(
+                        self._pre_kill_epoch,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    promote_failures += self.checker.wait_inflight_survive(
+                        self.serve_adapter,
+                        timeout=self.checker.object_timeout_s,
+                    )
                 check = self.checker.check_convergence(pre)
+                if promote_failures:
+                    check.ok = False
+                    check.failures = promote_failures + check.failures
+                if self._head_killed:
+                    # re-arm a fresh standby so later faults in the soak
+                    # can fail over again (the promoted one is consumed)
+                    standby = self.cluster.standby
+                    try:
+                        self.cluster.start_standby(
+                            auto_promote=(
+                                standby.auto_promote
+                                if standby is not None
+                                else True
+                            )
+                        )
+                    except Exception:  # noqa: BLE001 - judged above
+                        logger.exception("could not re-arm a standby")
                 if self._dropped_hex is not None:
                     # the drop's specific victim must rebuild (the sampled
                     # acked sweep may not have included it)

@@ -46,7 +46,19 @@ NET_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
     ("peer_conn_drop", 2.0),
 )
 
-KINDS = tuple(k for k, _ in SERVE_MIX) + ("peer_conn_drop",)
+# replicated-control-plane mix: adds head_kill_promote (SIGKILL the
+# leader, a pre-armed warm standby must detect + promote, and in-flight
+# work must complete with zero acked loss). Not in DEFAULT_MIX — the
+# generic soak arms no standby, and the default schedule must stay
+# seed-stable; plans built by the failover soak pass this mix.
+FAILOVER_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
+    ("head_kill_promote", 1.0),
+)
+
+KINDS = tuple(k for k, _ in SERVE_MIX) + (
+    "peer_conn_drop",
+    "head_kill_promote",
+)
 
 
 @dataclass(frozen=True)

@@ -52,6 +52,7 @@ from .rpc import (
     HANDLER_STATS,
     RpcClient,
     RpcError,
+    RpcNotLeaderError,
     RpcServer,
     RpcStaleEpochError,
 )
@@ -2314,6 +2315,20 @@ class NodeAgent:
                 self._re_register()
                 with self._report_cv:
                     self._report_queue.insert(0, report)
+            except RpcNotLeaderError as exc:
+                if self._shutdown:
+                    return
+                # the head we know is fenced/standby: walk the candidate
+                # list (its hint first) to the current leader, register
+                # there, then redeliver. The rejection is one fast RTT
+                # (handler-level, no transport retries), so pace the
+                # loop while nobody is leading yet — same cadence as
+                # the unreachable path below.
+                found = self._failover_head(exc.leader_hint)
+                with self._report_cv:
+                    self._report_queue.insert(0, report)
+                if not found:
+                    time.sleep(0.5)
             except RpcError:
                 if self._shutdown:
                     return
@@ -2334,8 +2349,44 @@ class NodeAgent:
                 "RegisterNode", self._node_info(), timeout=10.0
             )
             self._head_epoch = reply.get("epoch")
+        except RpcNotLeaderError as exc:
+            # registered against a fenced/standby head: follow the
+            # leadership hint / candidate walk, then register there
+            if self._failover_head(exc.leader_hint):
+                try:
+                    reply = self.head.call(
+                        "RegisterNode", self._node_info(), timeout=10.0
+                    )
+                    self._head_epoch = reply.get("epoch")
+                except (RpcError, RpcNotLeaderError):
+                    pass  # next report tick retries the walk
         except RpcError:
             pass  # next report tick (or its stale rejection) retries
+
+    def _failover_head(self, hint: str = "") -> bool:
+        """Walk the head-candidate list (rpc.resolve_leader) and swap
+        this agent's head channel to the current leader. Returns True
+        when the channel moved (or already points at the leader)."""
+        from .rpc import resolve_leader
+
+        addr = resolve_leader(self.head_address, hint)
+        if addr is None:
+            return False
+        if addr == self.head_address:
+            return True
+        logger.warning(
+            "head leadership moved %s -> %s; re-pointing",
+            self.head_address,
+            addr,
+        )
+        old = self.head
+        self.head_address = addr
+        self.head = RpcClient(addr)
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return True
 
     # a spawned worker gets this long to come up and register before its
     # reservation is reclaimed and the process killed (cold spawns pay a
@@ -2414,6 +2465,13 @@ class NodeAgent:
                 last_head_contact = time.monotonic()  # the head is alive
                 logger.warning("stale cluster epoch; re-registering")
                 self._re_register()
+            except RpcNotLeaderError as exc:
+                # the head we report to fenced itself (a standby
+                # promoted elsewhere): walk to the leader + re-register
+                last_head_contact = time.monotonic()
+                logger.warning("head is not the leader; failing over")
+                if self._failover_head(exc.leader_hint):
+                    self._re_register()
             except RpcError:
                 if (
                     time.monotonic() - last_head_contact

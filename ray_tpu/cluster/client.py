@@ -36,6 +36,7 @@ from .rpc import (
     RpcClient,
     RpcDeadlineError,
     RpcError,
+    RpcNotLeaderError,
     RpcServer,
     RpcStaleEpochError,
 )
@@ -1244,6 +1245,14 @@ class _PipelinedSender:
             self._enqueued += len(payloads)
             self._cv.notify_all()
 
+    def rebind(self, client: RpcClient) -> None:
+        """Swap the underlying channel (head failover): the loop reads
+        ``self._client`` per attempt, so queued items redeliver to the
+        new leader in order."""
+        with self._cv:
+            self._client = client
+            self._cv.notify_all()
+
     def try_enqueue_once(self, kind: str, payload: Any, prev_ticket: int) -> int:
         """Queue one item unless the previous such item is still
         undelivered (heartbeats must not pile up behind a head outage).
@@ -1292,11 +1301,13 @@ class _PipelinedSender:
                         ),
                     )
                     delivered = True
-                except RpcStaleEpochError:
-                    # the head restarted under us: run the owner resync
-                    # (fresh ClientHello adopts the new epoch and
-                    # re-registers the session), then redeliver this same
-                    # batch — order preserved, nothing dropped
+                except (RpcStaleEpochError, RpcNotLeaderError):
+                    # the head restarted (stale epoch) or fenced itself
+                    # behind a promoted standby (not leader): run the
+                    # owner resync — a fresh ClientHello adopts the new
+                    # epoch and, on failover, rebinds this sender to the
+                    # new leader — then redeliver this same batch; order
+                    # preserved, nothing dropped
                     import sys
 
                     if sys.is_finalizing():
@@ -1368,6 +1379,18 @@ class RemoteRuntime:
     is_remote = True
 
     def __init__(self, address: str, runtime_env: Optional[dict] = None):
+        # ``address`` may be a comma list (primary + warm standbys); the
+        # candidate walk also folds in RAY_TPU_HEAD_STANDBYS. With more
+        # than one candidate, connect to whichever currently leads.
+        from .rpc import head_candidates, probe_leader
+
+        self._head_candidates = head_candidates(address)
+        if len(self._head_candidates) > 1:
+            found = probe_leader(self._head_candidates, timeout=2.0)
+            if found is not None:
+                address = found[0]
+            else:
+                address = self._head_candidates[0]
         self.address = address
         self.head = RpcClient(address)
         self.head.call("Ping", timeout=10.0, retries=20, retry_interval=0.25)
@@ -1547,7 +1570,10 @@ class RemoteRuntime:
         """ClientHello handshake: adopt the cluster epoch this runtime
         stamps its control stream with, and (driver processes) register
         the owner session lease. Re-run whenever a rebuilt head rejects
-        our stamp as stale — re-hello IS the owner resync protocol."""
+        our stamp as stale — re-hello IS the owner resync protocol. A
+        NotLeader reply (the head fenced itself after a standby
+        promoted elsewhere) walks the candidate list to the leader and
+        re-hellos there."""
         try:
             reply = self.head.call(
                 "ClientHello",
@@ -1556,6 +1582,22 @@ class RemoteRuntime:
                 retries=3,
                 retry_interval=0.2,
             )
+        except RpcNotLeaderError as exc:
+            if not self._failover_head(exc.leader_hint):
+                return
+            try:
+                reply = self.head.call(
+                    "ClientHello",
+                    {
+                        "client_id": self.client_id,
+                        "session": self._owner_session,
+                    },
+                    timeout=10.0,
+                    retries=3,
+                    retry_interval=0.2,
+                )
+            except Exception:  # noqa: BLE001 - next resync retries
+                return
         except Exception:  # noqa: BLE001 - unstamped traffic still flows
             return
         self._cluster_epoch = reply.get("epoch")
@@ -1564,6 +1606,39 @@ class RemoteRuntime:
             self._owner_ttl_s = float(ttl)
         if not reply.get("owner_liveness", True):
             self._owner_session = False
+
+    def _failover_head(self, hint: str = "") -> bool:
+        """Walk the head-candidate list (rpc.resolve_leader) to the
+        current leader; swap the control channels there. The pipelined
+        sender rebinds in place — queued control items redeliver to the
+        new leader in order, nothing dropped."""
+        from .rpc import resolve_leader
+
+        addr = resolve_leader(
+            self.address, hint, ",".join(self._head_candidates)
+        )
+        if addr is None:
+            return False
+        if addr == self.address:
+            return True
+        import logging
+
+        logging.getLogger("ray_tpu.cluster.client").warning(
+            "head leadership moved %s -> %s; re-pointing", self.address, addr
+        )
+        old_head, old_pipe = self.head, getattr(self, "_pipe_chan", None)
+        self.address = addr
+        self.head = RpcClient(addr)
+        if old_pipe is not None:
+            self._pipe_chan = RpcClient(addr)
+            self._sender.rebind(self._pipe_chan)
+        for chan in (old_head, old_pipe):
+            if chan is not None:
+                try:
+                    chan.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        return True
 
     def _owner_beat_loop(self) -> None:
         """Heartbeat the owner session at half the lease TTL, riding the

@@ -39,6 +39,10 @@ class Cluster:
         self.address = self.head.address
         self._agents: Dict[str, subprocess.Popen] = {}
         self._counter = 0
+        # warm-standby head (start_standby/promote/kill_head): failover
+        # harness for tests and chaos plans
+        self.standby = None
+        self._dead_heads: List[HeadServer] = []
 
     def restart_head(self) -> None:
         """Kill and restart only the head on the same port (GCS fault
@@ -54,6 +58,78 @@ class Cluster:
             persist_path=self._persist_path,
         )
         assert self.head.address == self.address
+
+    # ------------------------------------------------------------------
+    # replicated control plane (standby.py): warm-standby failover
+    # ------------------------------------------------------------------
+    def start_standby(self, auto_promote: bool = True):
+        """Start (or replace) a warm standby tailing this cluster's
+        leader. With ``auto_promote`` it detects leader death via the
+        strike-based watch loop and promotes itself onto the leader's
+        port; ``cluster.head`` swaps to the promoted instance."""
+        from .standby import StandbyHead
+
+        if self.standby is not None:
+            self.standby.shutdown()
+        self.standby = StandbyHead(
+            self.address,
+            persist_path=self._persist_path,
+            auto_promote=auto_promote,
+            use_device_scheduler=self._use_device_scheduler,
+        )
+        self.standby.on_promoted = self._adopt_head
+        return self.standby
+
+    def _adopt_head(self, head: HeadServer) -> None:
+        self.head = head
+
+    def kill_head(self) -> None:
+        """SIGKILL-equivalent for the in-process leader: the RPC
+        listener drops mid-flight, no final snapshot is flushed, no
+        agent is told anything — and the persistence dir stays intact
+        for the standby. (The head runs in-process so tests can reach
+        its tables; an os.kill would take the test with it.)"""
+        head = self.head
+        head._shutdown = True
+        with head._cond:
+            head._cond.notify_all()
+        head._repl.stop()
+        head._server.stop(grace=0)
+        if head._pipeline is not None:
+            try:
+                head._pipeline.stop()
+            except Exception:  # noqa: BLE001 - corpse hygiene only
+                pass
+        head._dispatch_pool.shutdown(wait=False, cancel_futures=True)
+        try:
+            head.jobs.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        # close channels so the corpse's breaker callbacks never fire
+        # into dead state (in-process analog of the kernel reaping fds)
+        with head._lock:
+            clients = list(head._clients.values())
+        for client in clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._dead_heads.append(head)
+
+    def promote(self, timeout: float = 30.0) -> HeadServer:
+        """Promote the standby (or wait out its in-flight
+        auto-promotion) and adopt the new head."""
+        if self.standby is None:
+            raise RuntimeError("no standby started (start_standby first)")
+        if self.standby.promoted is None and not self.standby.auto_promote:
+            self.standby.promote()
+        head = self.standby.wait_promoted(timeout=timeout)
+        if head is None:
+            raise TimeoutError(
+                f"standby did not promote within {timeout}s"
+            )
+        self.head = head
+        return head
 
     def add_node(
         self,
@@ -167,6 +243,11 @@ class Cluster:
         return RemoteRuntime(self.address)
 
     def shutdown(self) -> None:
+        # standby first: its watch loop must not misread the leader's
+        # clean shutdown as a death and promote into the teardown
+        if self.standby is not None:
+            self.standby.shutdown()
+            self.standby = None
         self.head.shutdown()
         for proc in self._agents.values():
             if proc.poll() is None:

@@ -182,6 +182,25 @@ class SealInfo:
 
 
 @dataclass
+class WalShipBatch:
+    """Leader -> standby replication batch (``ReplWal``): a contiguous
+    run of the leader's persistence stream. ``records`` items are
+    ``("wal", record)`` WAL records or ``("snap", snapshot)`` barriers,
+    sequence-numbered from ``start_seq``; a bootstrap/re-sync batch
+    instead carries a full ``snapshot`` at position ``snap_seq``. The
+    standby replies ``{"applied_to": seq}``, ``{"resync_from": seq}``
+    on a gap, or ``{"fenced": epoch}`` once it has promoted — the reply
+    that fences a deposed leader off its own shipping stream."""
+
+    epoch: int
+    leader: str
+    start_seq: int
+    records: List[Tuple[str, Any]] = field(default_factory=list)
+    snapshot: Optional[dict] = None
+    snap_seq: int = 0
+
+
+@dataclass
 class NodeReport:
     """Agent -> head periodic report (RaySyncer RESOURCE_VIEW analog,
     src/ray/ray_syncer/ray_syncer.h:81)."""

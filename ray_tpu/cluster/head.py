@@ -54,7 +54,9 @@ from .common import (
     stream_item_id,
 )
 from .object_plane import PEER_CONN_GRANTED, PEER_CONN_REVOKED
-from .rpc import RpcClient, RpcError, RpcServer
+from .replication import ReplicationHub, set_role
+from .rpc import RpcClient, RpcError, RpcNotLeaderError, RpcServer
+from .shards import ShardedTable
 
 logger = logging.getLogger("ray_tpu.cluster.head")
 
@@ -259,7 +261,10 @@ class HeadServer:
         self.nodes: Dict[str, NodeInfo] = {}
         self._clients: Dict[str, RpcClient] = {}
         self._last_report: Dict[str, float] = {}
-        self._objects: Dict[str, _ObjEntry] = {}
+        # owner-sharded object directory (shards.py): dict-compatible,
+        # but every lookup routes to one fixed shard and shipped-WAL
+        # replay partitions by the same routing
+        self._objects: ShardedTable = ShardedTable(cfg.head_shards)
         self._leases: Dict[str, LeaseRequest] = {}  # lineage: lease_id -> spec
         # --- distributed refcounting state ---
         from ray_tpu.core.refcount import FreedLRU
@@ -306,7 +311,8 @@ class HeadServer:
         # Active entries persist in the snapshot/WAL so TTL expiry and
         # revoke-on-death survive a head restart (owners keep streaming
         # to their leased workers regardless — the head is off that path).
-        self._task_leases: Dict[str, dict] = {}
+        # Owner-sharded like the object directory.
+        self._task_leases: ShardedTable = ShardedTable(cfg.head_shards)
         self._grant_gate = threading.BoundedSemaphore(8)
         # peer-link lease table (cross-node data plane, transport.py):
         # link_id -> {link_id, src, dst, endpoint, granted_at,
@@ -316,8 +322,13 @@ class HeadServer:
         # in the snapshot/WAL (granted links keep serving across a head
         # restart), renew via piggybacked agent reports, and are revoked
         # on either endpoint node's death.
-        self._peer_links: Dict[str, dict] = {}
+        self._peer_links: ShardedTable = ShardedTable(cfg.head_shards)
         self._peer_links_by_pair: Dict[tuple, str] = {}
+        # revocation fan-outs queued as WAL records (revoke_pending /
+        # revoke_done): a promoted standby or restarted head re-drives
+        # any the dying leader never delivered, idempotently, instead of
+        # trusting the corpse's best-effort last breaths.
+        self._pending_revokes: Dict[str, dict] = {}
         self._actors: Dict[str, ActorInfo] = {}
         self._actor_specs: Dict[str, LeaseRequest] = {}
         self._named_actors: Dict[str, str] = {}
@@ -374,6 +385,15 @@ class HeadServer:
         self.cluster_epoch = max(
             int(self._recovered_epoch) + 1, int(time.time() * 1000.0)
         )
+        # control-plane replication (replication.py): WAL records and
+        # snapshot barriers ship to registered warm standbys; this head
+        # is the leader until it observes a higher epoch and fences
+        # itself (role: leader -> fenced; a fenced head refuses writes).
+        self.role = "leader"
+        self._fenced = False
+        self._leader_hint = ""
+        self._repl = ReplicationHub(self)
+        set_role("leader")
         self.metrics: Dict[str, int] = {
             "leases_submitted": 0,
             "leases_finished": 0,
@@ -441,6 +461,8 @@ class HeadServer:
             "ClusterInfo": self._h_cluster_info,
             "ReportServeState": self._h_report_serve_state,
             "QueryState": self._h_query_state,
+            "StandbyHello": self._h_standby_hello,
+            "HeadRole": self._h_head_role,
             "Timeline": lambda r: self.events.dump_timeline(None),
             "SubmitJob": lambda r: self.jobs.submit(
                 entrypoint=r["entrypoint"],
@@ -466,8 +488,19 @@ class HeadServer:
             self._server.epoch = self.cluster_epoch
             # the resync protocol itself must pass the fence: RegisterNode
             # re-attaches an agent (and hands out the new epoch),
-            # ClientHello does the same for owners, Ping is liveness
-            self._server.fence_exempt = {"RegisterNode", "ClientHello", "Ping"}
+            # ClientHello does the same for owners, Ping is liveness,
+            # StandbyHello/HeadRole are the replication bootstrap + role
+            # probe (a standby has no epoch to stamp yet)
+            self._server.fence_exempt = {
+                "RegisterNode",
+                "ClientHello",
+                "Ping",
+                "StandbyHello",
+                "HeadRole",
+            }
+            # a request stamped with a HIGHER epoch proves a newer head
+            # incarnation exists: step down (self-fence) immediately
+            self._server.on_newer_epoch = self._observed_newer_epoch
         self.address = self._server.address
         self.jobs.head_address = self.address
         for job in getattr(self, "_recovered_jobs", []):
@@ -538,6 +571,13 @@ class HeadServer:
                 "peer_links": [
                     self._peer_link_row(e) for e in self._peer_links.values()
                 ],
+                # undelivered revocation fan-outs: a successor re-drives
+                # them (idempotent receiver-side) instead of relying on
+                # this process's best-effort sends having landed
+                "pending_revokes": {
+                    rid: dict(row)
+                    for rid, row in self._pending_revokes.items()
+                },
             } | streams_part
 
     def _snapshot_streams(self) -> dict:
@@ -587,25 +627,42 @@ class HeadServer:
 
     def _wal_flush(self) -> None:
         """Drain queued WAL records to disk (call with self._lock NOT
-        held). Records drain in queue order regardless of which handler
-        thread flushes, so replay order always matches acknowledged
-        state."""
+        held) and publish them to the replication stream. Records drain
+        in queue order regardless of which handler thread flushes, so
+        replay order always matches acknowledged state; the replication
+        seq is assigned under the same persist lock, so shipped order
+        matches disk order."""
         if self._backend is None or not self._wal_queue:
+            return
+        if self._fenced:
+            # a deposed leader writes nothing: not to disk, not to the
+            # stream — its late mutations must be provably rejected
+            self._wal_queue.clear()
             return
         lock = _PERSIST_LOCKS[self._persist_path]
         with lock:
             if _PERSIST_OWNER.get(self._persist_path) != id(self):
                 self._wal_queue.clear()
                 return
+            records = []
             while True:
                 try:
-                    record = self._wal_queue.popleft()
+                    records.append(self._wal_queue.popleft())
                 except IndexError:
-                    return
+                    break
+            for record in records:
                 try:
                     self._backend.wal_append(record)
                 except Exception:  # noqa: BLE001 - durability best-effort
                     logger.exception("WAL append failed")
+            last_seq = self._repl.publish(records)
+        # acked shipping (cfg.wal_ship_acked) waits OUTSIDE the persist
+        # lock: the shipper thread never takes it, but other handlers'
+        # flushes must not serialize behind this one's ack wait
+        if last_seq and cfg.wal_ship_acked:
+            self._repl.wait_acked(
+                last_seq, timeout=cfg.wal_ship_ack_timeout_s
+            )
 
     def _load_persisted(self) -> None:
         snap = self._backend.load() or {}
@@ -648,6 +705,8 @@ class HeadServer:
             self._restore_task_lease(row, now_m, ttl)
         for row in snap.get("peer_links", []):
             self._restore_peer_link(row)
+        for rid, row in snap.get("pending_revokes", {}).items():
+            self._pending_revokes[rid] = dict(row)
         for actor_id, fields in snap.get("actors", {}).items():
             info = ActorInfo(**fields)
             # hosting agents re-register and re-attach; until then, unknown
@@ -699,6 +758,10 @@ class HeadServer:
                     self._peer_links_by_pair.pop(
                         (e["src"], e["dst"]), None
                     )
+            elif kind == "revoke_pending":
+                self._pending_revokes[rec[1]["revoke_id"]] = dict(rec[1])
+            elif kind == "revoke_done":
+                self._pending_revokes.pop(rec[1], None)
         logger.info(
             "recovered head state: %d kv keys, %d actors, %d jobs, "
             "%d WAL records",
@@ -814,12 +877,21 @@ class HeadServer:
             self.mark_dirty()
 
     def _persist_now(self) -> None:
+        if self._fenced:
+            return  # deposed: never overwrite the successor's state
         lock = _PERSIST_LOCKS[self._persist_path]
         with lock:
             if _PERSIST_OWNER.get(self._persist_path) != id(self):
                 return  # a newer head owns this file now; never write stale
             try:
-                self._backend.save_snapshot(self._snapshot_state())
+                snap = self._snapshot_state()
+                self._backend.save_snapshot(snap)
+                # snapshot barrier into the replication stream, still
+                # under the persist lock: a record that mutated AFTER
+                # this capture cannot be sequenced before the barrier
+                # (its flush needs this same lock), so a standby
+                # applying [.., barrier, record..] never loses it
+                self._repl.publish_snapshot(snap)
             except Exception:  # noqa: BLE001
                 self._persist_dirty = True  # don't lose the write; retry
                 logger.exception("head state persistence failed")
@@ -827,12 +899,98 @@ class HeadServer:
     def _persist_loop(self) -> None:
         while True:
             time.sleep(1.0)
-            if self._shutdown:
+            if self._shutdown or self._fenced:
                 return  # shutdown() does the final flush itself
             if not self._persist_dirty:
                 continue
             self._persist_dirty = False
             self._persist_now()
+
+    # ------------------------------------------------------------------
+    # control-plane replication: WAL shipping to warm standbys + fenced
+    # leadership (replication.py, standby.py)
+    # ------------------------------------------------------------------
+    def _h_standby_hello(self, req: dict) -> dict:
+        """Standby bootstrap: register it for WAL shipping and hand back
+        a full snapshot + the stream position it covers. The seq is read
+        BEFORE the capture, so records racing the capture are both in
+        the snapshot and shipped again — double-applied (idempotent),
+        never lost."""
+        if self._fenced:
+            raise RpcNotLeaderError(
+                "this head is fenced (deposed leader)",
+                leader_hint=self._leader_hint,
+            )
+        if self._backend is None:
+            # no persistence stream to ship: a standby of this head
+            # would bootstrap once and silently never converge again
+            raise RuntimeError(
+                "WAL shipping requires head persistence "
+                "(start the head with persist_path/persist_backend)"
+            )
+        from_seq = self._repl.seq
+        # register BEFORE capturing: records flushed during the capture
+        # are retained for shipping AND already inside the snapshot —
+        # double-applied (idempotent), never lost
+        self._repl.register_standby(
+            req["standby_id"], req["address"], from_seq
+        )
+        snap = self._snapshot_state()
+        return {
+            "snapshot": snap,
+            "from_seq": from_seq,
+            "epoch": self.cluster_epoch,
+            "leader": self.address,
+        }
+
+    def _h_head_role(self, req) -> dict:
+        """Leadership probe (fence-exempt, served even while fenced):
+        agents/clients walk their head-candidate list with this when the
+        configured head stops answering as leader."""
+        return {
+            "role": self.role,
+            "epoch": self.cluster_epoch,
+            "leader_hint": self._leader_hint,
+            "address": self.address,
+        }
+
+    def _observed_newer_epoch(self, epoch: int) -> None:
+        """RPC-layer callback: a request arrived stamped with a HIGHER
+        epoch than ours — proof a newer head incarnation exists (its
+        sender registered there). Self-fence immediately."""
+        self._step_down(epoch, "request stamped with a newer epoch")
+
+    def _step_down(
+        self, new_epoch: int, why: str, leader_hint: str = ""
+    ) -> None:
+        """Deposed-leader self-fencing: refuse every write from here on.
+        Mutating RPCs are rejected at the server layer with
+        RpcNotLeaderError (callers walk to the real leader), internal
+        loops exit, and neither the snapshot file nor the WAL is ever
+        written again — the successor owns them. The process stays up
+        only to redirect stragglers."""
+        with self._lock:
+            if self._fenced or self._shutdown:
+                return
+            self._fenced = True
+            self.role = "fenced"
+            if leader_hint:
+                self._leader_hint = leader_hint
+        set_role("fenced")
+        logger.warning(
+            "head %s stepping down (epoch %d observed > ours %d): %s",
+            self.address,
+            int(new_epoch),
+            self.cluster_epoch,
+            why,
+        )
+        self._server.role_hint = "fenced"
+        self._server.not_leader_hint = self._leader_hint or None
+        self._server.refuse_non_leader = True
+        self._repl.stop()
+        # wake the scheduler loop so it observes the fence and exits
+        with self._cond:
+            self._cond.notify_all()
 
     # ------------------------------------------------------------------
     # membership + health (GcsNodeManager / GcsHealthCheckManager analog)
@@ -935,6 +1093,10 @@ class HeadServer:
                     lid[:8],
                 )
                 self._agent_return_lease(info.node_id, lid)
+        # re-drive any revocation fan-out queued for this node that a
+        # previous incarnation (or an earlier outage window) never
+        # delivered — idempotent on the agent side
+        self._redrive_revokes(info.node_id)
         logger.info("node %s registered at %s", info.node_id, info.address)
         return {
             "node_id": info.node_id,
@@ -991,7 +1153,7 @@ class HeadServer:
         rng = _random.Random(0x4EA17)
         strikes: Dict[str, int] = {}
         last_strike: Dict[str, float] = {}
-        while not self._shutdown:
+        while not self._shutdown and not self._fenced:
             threshold = max(1, int(cfg.health_miss_threshold))
             window = cfg.health_timeout_s / threshold
             time.sleep(window / 2.0 * rng.uniform(0.7, 1.3))
@@ -1033,6 +1195,7 @@ class HeadServer:
             self._expire_task_leases()
             self._expire_peer_links()
             self._check_owner_liveness()
+            self._expire_pending_revokes()
 
     def _on_node_death(self, node_id: str) -> None:
         with self._cond:
@@ -2359,14 +2522,137 @@ class HeadServer:
         return e
 
     def _agent_return_lease(self, node_id: str, lease_id: str) -> None:
-        client = self._clients.get(node_id)
-        if client is not None:
-            self._dispatch_pool.submit(
-                _best_effort,
-                client.call,
-                "ReturnWorkerLease",
-                {"lease_id": lease_id},
+        self._queue_revoke(
+            "ReturnWorkerLease", node_id, {"lease_id": lease_id}
+        )
+
+    # ------------------------------------------------------------------
+    # durable revocation fan-out: every agent-bound revoke (worker-lease
+    # returns, peer-link revokes) is WAL-recorded BEFORE the send and
+    # cleared only after delivery. A dying leader's best-effort sends
+    # used to race the standby's rebuild; now the successor re-drives
+    # whatever is still pending — the receivers are idempotent, so a
+    # duplicate delivery is a no-op.
+    # ------------------------------------------------------------------
+    def _queue_revoke(self, method: str, node_id: str, payload: dict) -> None:
+        rid = new_id()
+        row = {
+            "revoke_id": rid,
+            "method": method,
+            "node_id": node_id,
+            "payload": payload,
+            "queued_at": time.time(),
+            "attempted_at": time.monotonic(),
+        }
+        with self._lock:
+            if self._fenced:
+                return  # deposed: the new leader drives its own revokes
+            self._pending_revokes[rid] = row
+            self._wal(("revoke_pending", dict(row)))
+        self._wal_flush()
+        try:
+            self._dispatch_pool.submit(_best_effort, self._drive_revoke, rid)
+        except RuntimeError:
+            pass  # pool closed (shutdown); the record re-drives elsewhere
+
+    def _drive_revoke(self, rid: str) -> None:
+        with self._lock:
+            row = self._pending_revokes.get(rid)
+            if row is None or self._fenced:
+                return
+            client = self._clients.get(row["node_id"])
+        if client is None:
+            return  # node not (re-)registered yet: re-driven when it is
+        try:
+            # closed revoke-kind set, dispatched through literal call
+            # sites (the static rpc-table check sees them; a new kind
+            # must be added here deliberately)
+            if row["method"] == "ReturnWorkerLease":
+                client.call(
+                    "ReturnWorkerLease",
+                    dict(row["payload"]),
+                    timeout=10.0,
+                    retries=2,
+                )
+            elif row["method"] == "RevokePeerLink":
+                client.call(
+                    "RevokePeerLink",
+                    dict(row["payload"]),
+                    timeout=10.0,
+                    retries=2,
+                )
+            else:
+                raise ValueError(
+                    f"unknown revoke kind {row['method']!r}"
+                )
+        except Exception:  # noqa: BLE001 - stays pending; re-driven later
+            HEAD_DROPPED_CALLBACKS.inc(
+                labels={"callable": f"revoke:{row['method']}"}
             )
+            logger.debug(
+                "revoke %s to %s not delivered; re-driving later",
+                row["method"],
+                row["node_id"],
+                exc_info=True,
+            )
+            return
+        with self._lock:
+            if self._pending_revokes.pop(rid, None) is not None:
+                self._wal(("revoke_done", rid))
+        self._wal_flush()
+
+    def _redrive_revokes(self, node_id: Optional[str] = None) -> None:
+        """Re-send pending revokes (all, or one re-registering node's) —
+        the promotion/restart path that replaces trusting a dead
+        leader's last best-effort breaths."""
+        with self._lock:
+            rids = [
+                rid
+                for rid, row in self._pending_revokes.items()
+                if node_id is None or row["node_id"] == node_id
+            ]
+        for rid in rids:
+            try:
+                self._dispatch_pool.submit(
+                    _best_effort, self._drive_revoke, rid
+                )
+            except RuntimeError:
+                return
+
+    def _expire_pending_revokes(self) -> None:
+        """Health-loop sweep over undelivered revokes: rows whose target
+        node is LIVE re-drive periodically (a one-off send failure to a
+        healthy agent must not pin its worker forever — RegisterNode is
+        not the only re-drive trigger); rows whose node is gone past the
+        redrive TTL can never deliver and drop (the agent-side resource
+        died with the node anyway)."""
+        ttl = float(cfg.revoke_redrive_ttl_s)
+        now = time.time()
+        now_m = time.monotonic()
+        victims = []
+        retry = []
+        with self._lock:
+            for rid, row in self._pending_revokes.items():
+                node = self.nodes.get(row["node_id"])
+                alive = node is not None and node.alive
+                if alive:
+                    if now_m - row.get("attempted_at", 0.0) > 5.0:
+                        row["attempted_at"] = now_m
+                        retry.append(rid)
+                elif now - row.get("queued_at", now) > ttl:
+                    victims.append(rid)
+            for rid in victims:
+                self._pending_revokes.pop(rid, None)
+                self._wal(("revoke_done", rid))
+        if victims:
+            self._wal_flush()
+        for rid in retry:
+            try:
+                self._dispatch_pool.submit(
+                    _best_effort, self._drive_revoke, rid
+                )
+            except RuntimeError:
+                return
 
     def _h_lease_renew(self, req: dict) -> None:
         """Owner heartbeat while its queue is non-empty (ClientBatch
@@ -2554,17 +2840,14 @@ class HeadServer:
         for e in victims:
             if e["dst"] != node_id:
                 continue  # only the requester side holds a cache
-            client = self._clients.get(e["src"])
-            if client is not None:
-                try:
-                    self._dispatch_pool.submit(
-                        _best_effort,
-                        client.call,
-                        "RevokePeerLink",
-                        {"link_id": e["link_id"], "node_id": e["dst"]},
-                    )
-                except RuntimeError:
-                    return  # pool closed (head shutting down mid-death)
+            # WAL-backed fan-out: a leader dying mid-revoke leaves the
+            # record for its successor to re-drive (pool-closed races
+            # are absorbed inside _queue_revoke)
+            self._queue_revoke(
+                "RevokePeerLink",
+                e["src"],
+                {"link_id": e["link_id"], "node_id": e["dst"]},
+            )
 
     @property
     def device_state(self):
@@ -2582,13 +2865,17 @@ class HeadServer:
                     not self._pending
                     and not (self._pending_pgs and self._pgs_dirty)
                     and not self._shutdown
+                    and not self._fenced
                 ):
                     self._cond.wait(timeout=0.5)
                     # Retry parked work only when the view actually moved,
                     # so truly-infeasible specs don't spin the kernel at
                     # 2 Hz.
                     self._maybe_unpark_locked()
-                if self._shutdown:
+                if self._shutdown or self._fenced:
+                    # fenced: a deposed leader must not grant anything —
+                    # the new leader owns every queued spec's fate (its
+                    # owners re-hello and resubmit there)
                     return
                 # parked work also retries while NEW submissions keep the
                 # queue hot — without this, a steady submit stream starves
@@ -4586,6 +4873,36 @@ class HeadServer:
                 },
                 "transfer_stripe_ms": TRANSFER_STRIPE_MS.summary(),
             }
+        if kind == "replication":
+            # replicated control plane: role, shipping stream position,
+            # per-standby follower lag, owner-shard occupancy, pending
+            # revocation fan-outs
+            repl = self._repl.state()
+            with self._lock:
+                shards = {
+                    "objects": self._objects.shard_sizes(),
+                    "task_leases": self._task_leases.shard_sizes(),
+                    "peer_links": self._peer_links.shard_sizes(),
+                }
+                pending_revokes = len(self._pending_revokes)
+            from .replication import FAILOVER_MS
+
+            return {
+                "role": self.role,
+                "epoch": self.cluster_epoch,
+                "fenced": self._fenced,
+                "leader_hint": self._leader_hint,
+                "last_shipped_seq": repl["seq"],
+                "ring_records": repl["ring_records"],
+                "standbys": repl["standbys"],
+                "follower_lag_records": max(
+                    (s["lag_records"] for s in repl["standbys"]),
+                    default=0,
+                ),
+                "shards": shards,
+                "pending_revokes": pending_revokes,
+                "failover_ms": FAILOVER_MS.summary(),
+            }
         if kind == "hotpath":
             # execution-plane hot path: framing-path selection + native
             # vs fallback counters, fused-event-loop occupancy, ring
@@ -4741,6 +5058,7 @@ class HeadServer:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+        self._repl.stop()
         if self._pipeline is not None:
             # drain in-flight rounds (their grants are already paid for on
             # the device mirror) before tearing the completion thread down

@@ -108,3 +108,67 @@ class FilePersistence:
                 except OSError:
                     pass
                 self._wal_f = None
+
+
+class MemPersistence:
+    """In-memory backend (tests, standbys with no disk): same four-method
+    contract as :class:`FilePersistence`, zero I/O."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._snap: Optional[dict] = None
+        self._wal: List[Tuple[Any, ...]] = []
+
+    def load(self) -> Optional[dict]:
+        with self._lock:
+            return self._snap
+
+    def save_snapshot(self, snap: dict) -> None:
+        with self._lock:
+            self._snap = snap
+            self._wal.clear()
+
+    def wal_append(self, record: Tuple[Any, ...]) -> None:
+        with self._lock:
+            self._wal.append(record)
+
+    def wal_replay(self) -> List[Tuple[Any, ...]]:
+        with self._lock:
+            return list(self._wal)
+
+    def close(self) -> None:
+        pass
+
+
+class HandoffPersistence:
+    """Promotion handoff: a warm standby's continuously-replayed tables
+    become the FIRST load of the promoted head — no disk read, no WAL
+    scan (the whole point of WAL shipping: promotion is an epoch bump +
+    listener bind, not a replay-from-disk). Every subsequent write
+    (snapshots, WAL appends) delegates to the real backend so the
+    promoted head persists normally from its first dirty tick."""
+
+    def __init__(self, inner: Any, snapshot: dict):
+        self._inner = inner
+        self._handoff = snapshot
+
+    def load(self) -> Optional[dict]:
+        # NOT consumed on read: promotion retries its listener bind
+        # (TIME_WAIT on the dead leader's port) by constructing a fresh
+        # HeadServer against this same backend, and each attempt loads
+        # AGAIN — a one-shot load would hand the retry empty tables
+        return self._handoff
+
+    def wal_replay(self) -> List[Tuple[Any, ...]]:
+        # the standby already merged every shipped record into the
+        # handoff snapshot; the on-disk WAL (if any) predates it
+        return []
+
+    def save_snapshot(self, snap: dict) -> None:
+        self._inner.save_snapshot(snap)
+
+    def wal_append(self, record: Tuple[Any, ...]) -> None:
+        self._inner.wal_append(record)
+
+    def close(self) -> None:
+        self._inner.close()

@@ -54,6 +54,25 @@ class RpcStaleEpochError(Exception):
     (re-registration is the resync protocol) and only then resumes."""
 
 
+class RpcNotLeaderError(RpcError):
+    """The receiving head is not the cluster leader (a warm standby, or
+    a deposed leader that fenced itself after observing a higher cluster
+    epoch). Handler-level: re-raised at the caller immediately, never
+    consuming the transport retry budget. Subclasses RpcError on
+    purpose — the dozens of pre-existing ``except RpcError`` resilience
+    paths (requeue, retry-later, spill) are exactly the right degraded
+    behavior during a fenced window, while failover-aware callers catch
+    this type FIRST and walk ``leader_hint`` / their head-candidate
+    list to the real leader."""
+
+    def __init__(self, msg: str, leader_hint: str = ""):
+        super().__init__(msg)
+        self.leader_hint = leader_hint
+
+    def __reduce__(self):
+        return (RpcNotLeaderError, (self.args[0], self.leader_hint))
+
+
 class RpcUnknownMethodError(RpcError):
     """The peer has no handler registered for the requested method —
     dispatch-table drift (a caller invoking a kind the receiving side
@@ -454,10 +473,14 @@ class _GenericHandler(grpc.GenericRpcHandler):
 
     def _unfence(self, name: str, req: Any) -> Any:
         """Enforce epoch fencing on a stamped request. The epoch check is
-        strictly-less-than: a sender from THIS incarnation (or a future
-        one racing a restart) passes; only provably-stale traffic — a
-        peer that registered with a PREVIOUS head — is rejected, before
-        its handler can touch any table."""
+        strictly-less-than: a sender from THIS incarnation passes; only
+        provably-stale traffic — a peer that registered with a PREVIOUS
+        head — is rejected, before its handler can touch any table. A
+        stamp HIGHER than this server's epoch proves a newer head
+        incarnation exists (the sender registered with it): an
+        epoch-checking server self-fences via ``on_newer_epoch`` and
+        redirects the sender — the deposed-leader half of split-brain
+        prevention."""
         if not isinstance(req, FencedPayload):
             return req
         srv = self._rpc_server
@@ -465,13 +488,37 @@ class _GenericHandler(grpc.GenericRpcHandler):
             srv is not None
             and srv.epoch is not None
             and name not in srv.fence_exempt
-            and req.epoch < srv.epoch
         ):
-            raise RpcStaleEpochError(
-                f"rpc {name} stamped with epoch {req.epoch} but the "
-                f"cluster epoch is {srv.epoch}; re-register to resync"
-            )
+            if req.epoch < srv.epoch:
+                raise RpcStaleEpochError(
+                    f"rpc {name} stamped with epoch {req.epoch} but the "
+                    f"cluster epoch is {srv.epoch}; re-register to resync"
+                )
+            if req.epoch > srv.epoch and srv.on_newer_epoch is not None:
+                try:
+                    srv.on_newer_epoch(int(req.epoch))
+                except Exception:  # noqa: BLE001 - fencing is best-effort here
+                    pass
+                raise RpcNotLeaderError(
+                    f"rpc {name} stamped with epoch {req.epoch} > this "
+                    f"head's {srv.epoch}: a newer head incarnation "
+                    "exists; this one has fenced itself",
+                    leader_hint=srv.not_leader_hint or "",
+                )
         return req.payload
+
+    def _refuse_if_not_leader(self, name: str) -> None:
+        srv = self._rpc_server
+        if (
+            srv is not None
+            and srv.refuse_non_leader
+            and name not in srv.always_serve
+        ):
+            raise RpcNotLeaderError(
+                f"rpc {name}: this head is not the cluster leader "
+                f"(role={srv.role_hint})",
+                leader_hint=srv.not_leader_hint or "",
+            )
 
     def service(self, handler_call_details):
         name = handler_call_details.method.rsplit("/", 1)[-1]
@@ -499,6 +546,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
         def unary(request_bytes, context):
             t0 = time.perf_counter()
             try:
+                self._refuse_if_not_leader(name)
                 req = self._unfence(name, wire.loads(request_bytes))
                 return wire.dumps((True, fn(req)))
             except BaseException as exc:  # noqa: BLE001 - shipped to caller
@@ -534,6 +582,18 @@ class RpcServer:
         # fence_exempt (the resync protocol itself) always pass
         self.epoch: Optional[int] = None
         self.fence_exempt: set = set()
+        # leadership fencing (replicated control plane): a fenced or
+        # standby head sets refuse_non_leader and every method outside
+        # always_serve (role probe + observability) raises
+        # RpcNotLeaderError with the leader hint BEFORE its handler runs.
+        # on_newer_epoch fires when a request stamped with a HIGHER epoch
+        # arrives — proof a newer incarnation exists; the head routes it
+        # into its step-down path.
+        self.refuse_non_leader = False
+        self.always_serve: set = {"Ping", "HeadRole", "QueryState"}
+        self.not_leader_hint: Optional[str] = None
+        self.role_hint = "leader"
+        self.on_newer_epoch: Optional[Callable[[int], None]] = None
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=_OPTIONS,
@@ -669,7 +729,21 @@ class RpcClient:
                             if timeout is None
                             else min(timeout, remaining)
                         )
-                    raw = self._method(method)(data, timeout=att_timeout)
+                    try:
+                        raw = self._method(method)(
+                            data, timeout=att_timeout
+                        )
+                    except ValueError as exc:
+                        # grpc raises bare ValueError ("Cannot invoke RPC
+                        # on closed channel") when close() raced this
+                        # call — a transport failure, not a caller bug:
+                        # surface it as RpcError so retry loops that
+                        # rebind their channel (head failover) recover
+                        # instead of dying on an uncaught ValueError
+                        raise RpcError(
+                            f"rpc {method} to {self.address}: channel "
+                            "closed under the call"
+                        ) from exc
                     ok, value = wire.loads(raw)
                     br.on_success()
                     if not ok:
@@ -709,3 +783,74 @@ class RpcClient:
         self._breaker.remove_callback(self)
         self._channel.close()
         release_breaker(self.address)
+
+
+def head_candidates(primary: str, extra: str = "") -> List[str]:
+    """The ordered head-address candidate list a peer walks when its
+    head stops answering as leader: the configured primary, then every
+    ``RAY_TPU_HEAD_STANDBYS`` entry (comma-separated). ``primary`` may
+    itself be a comma list (clients accept one)."""
+    from ray_tpu.config import cfg
+
+    out: List[str] = []
+    for part in (primary or "").split(","):
+        part = part.strip()
+        if part and part not in out:
+            out.append(part)
+    for part in (extra or cfg.head_standbys or "").split(","):
+        part = part.strip()
+        if part and part not in out:
+            out.append(part)
+    return out
+
+
+def resolve_leader(
+    current_address: str, hint: str = "", extra: str = ""
+) -> Optional[str]:
+    """The ONE candidate-walk both agents and clients use on a
+    NotLeader/unreachable head: leadership hint first, then the
+    configured address(es) + RAY_TPU_HEAD_STANDBYS. Returns the
+    leader's address (possibly ``current_address`` itself), or None
+    while nobody leads (mid-failover — callers retry on their own
+    cadence)."""
+    cands = ([hint] if hint else []) + head_candidates(
+        current_address, extra
+    )
+    found = probe_leader(cands, timeout=2.0)
+    return found[0] if found is not None else None
+
+
+def probe_leader(
+    addresses, timeout: float = 2.0
+) -> Optional[tuple]:
+    """Walk head candidates asking ``HeadRole`` (fence-exempt on every
+    head role) and return ``(address, info)`` of the first one answering
+    as leader; standby/fenced replies contribute their ``leader_hint``
+    as one extra hop. None when nobody is leading yet (mid-failover —
+    callers retry on their own cadence)."""
+    hints: List[str] = []
+    seen: set = set()
+    queue = list(addresses)
+    while queue:
+        addr = queue.pop(0)
+        if not addr or addr in seen:
+            continue
+        seen.add(addr)
+        client = RpcClient(addr)
+        try:
+            info = client.call("HeadRole", {}, timeout=timeout)
+        except Exception:  # noqa: BLE001 - dead candidate, keep walking
+            continue
+        finally:
+            client.close()
+        if not isinstance(info, dict):
+            continue
+        if info.get("role") == "leader":
+            return addr, info
+        hint = info.get("leader_hint")
+        if hint and hint not in seen:
+            hints.append(hint)
+        if not queue and hints:
+            queue.extend(hints)
+            hints = []
+    return None

@@ -336,6 +336,75 @@ define(
 )
 
 # ---------------------------------------------------------------------------
+# replicated control plane (warm-standby heads, WAL shipping, failover)
+# ---------------------------------------------------------------------------
+define(
+    "head_shards",
+    8,
+    "Shard count of the head's owner-sharded directory/lease tables "
+    "(object directory, task-lease table, peer-link table). Keys route "
+    "by a stable hash, so lookups touch one shard and shipped-WAL "
+    "replay applies shard groups conflict-free.",
+)
+define(
+    "head_standbys",
+    "",
+    "Comma-separated warm-standby head addresses agents/clients walk "
+    "(after the primary and any leader hint) when the head stops "
+    "answering as leader.",
+)
+define(
+    "head_health_timeout_s",
+    2.0,
+    "Standby-side leader death detection window: a standby declares the "
+    "leader dead after head_miss_threshold consecutive missed probe "
+    "windows of head_health_timeout_s / head_miss_threshold each, then "
+    "promotes (epoch bump + listener bind).",
+)
+define(
+    "head_miss_threshold",
+    3,
+    "Consecutive missed leader-probe windows before a warm standby "
+    "declares the leader dead and promotes itself (same strike shape as "
+    "the head's node health loop).",
+)
+define(
+    "wal_ship_acked",
+    False,
+    "Acked WAL shipping: the leader's WAL flush waits (bounded by "
+    "wal_ship_ack_timeout_s) until every live standby applied the "
+    "flushed records. Off (default): shipping is asynchronous — a "
+    "leader crash can lose the last in-flight batch, same window as "
+    "unreplicated durability today.",
+)
+define(
+    "wal_ship_ack_timeout_s",
+    2.0,
+    "Bound on one acked-shipping wait; a standby that cannot ack within "
+    "it accrues strikes and is dropped from the ack quorum (it re-syncs "
+    "when it returns).",
+)
+define(
+    "wal_ship_ring",
+    8192,
+    "Replication ring capacity (records) on the leader: standbys whose "
+    "ack fell further behind than the ring re-sync from a fresh "
+    "snapshot instead of replaying records that no longer exist.",
+)
+define(
+    "wal_ship_batch",
+    512,
+    "Max WAL records per shipped ReplWal batch.",
+)
+define(
+    "revoke_redrive_ttl_s",
+    120.0,
+    "Pending-revoke WAL rows (lease returns / peer-link revokes queued "
+    "but not yet delivered to their agent) older than this whose target "
+    "node is gone are dropped by the sweep instead of re-driven forever.",
+)
+
+# ---------------------------------------------------------------------------
 # rpc retry + circuit breaking (RetryableGrpcClient analog)
 # ---------------------------------------------------------------------------
 define(
