@@ -1460,6 +1460,196 @@ def _make_block(n_elem: int):
     return np.arange(n_elem, dtype=np.float64)
 
 
+def shuffle_bench() -> dict:
+    """Tier: streaming shuffle on the zero-copy plane (ISSUE 13).
+
+    A 2-node cluster runs a P-partition random_shuffle + hash groupby
+    over ndarray blocks twice — once on the vectorized arena-direct
+    path (RAY_TPU_DATA_VECTOR_SHUFFLE=1, the default) and once on the
+    pre-PR row-wise path (=0) — exporting ``shuffle_gb_per_s``, the
+    row-wise speedup, the locality hit-rate (bytes served same-node /
+    total, from the agents' per-path transfer counters), and the arena
+    spill count. Then measures streaming-ingest overlap: total
+    iter_batches stall time (time blocked in next()) at prefetch depth
+    2 vs depth 0 under a simulated train step.
+
+    Gate: RAY_TPU_BENCH_SHUFFLE_FLOOR_MB_PER_S fails the run when the
+    vectorized shuffle throughput regresses below it."""
+    import numpy as _np
+
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.cluster.rpc import RpcClient
+    from ray_tpu.core.runtime import set_runtime
+
+    rows = int(os.environ.get("RAY_TPU_BENCH_SHUFFLE_ROWS", 4_000_000))
+    parts = int(os.environ.get("RAY_TPU_BENCH_SHUFFLE_PARTS", 16))
+    loc_parts = int(
+        os.environ.get("RAY_TPU_BENCH_SHUFFLE_LOC_PARTS", 32)
+    )
+    groupby_rows = int(
+        os.environ.get("RAY_TPU_BENCH_SHUFFLE_GROUPBY_ROWS", 100_000)
+    )
+
+    nbytes = rows * 8
+
+    def _agent_spills(cluster, nodes) -> int:
+        spills = 0
+        for nid in nodes:
+            addr = cluster.agent_address(nid)
+            if not addr:
+                continue
+            try:
+                st = RpcClient(addr).call("DebugState", {}, timeout=15.0)
+                spills += (
+                    st.get("object_plane", {}).get("spilled_objects", 0) or 0
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return spills
+
+    def _pass(vector: bool, with_locality: bool) -> dict:
+        """One fresh 2-node cluster per mode: the partitioning path is
+        chosen in the WORKERS, so RAY_TPU_DATA_VECTOR_SHUFFLE must be in
+        the environment when the agents (and their zygotes) spawn."""
+        import ray_tpu
+        import ray_tpu.data as rd
+
+        os.environ["RAY_TPU_DATA_VECTOR_SHUFFLE"] = "1" if vector else "0"
+        os.environ["RAY_TPU_SCHED_W_LOCALITY"] = "0"
+        res: dict = {}
+        cluster = Cluster(use_device_scheduler=True)
+        try:
+            nodes = [
+                cluster.add_node(
+                    {"CPU": 4.0}, num_workers=2, store_capacity=1 << 29
+                )
+                for _ in range(2)
+            ]
+            rt = cluster.client()
+            set_runtime(rt)
+            try:
+                t0 = time.perf_counter()
+                arr = _np.arange(rows, dtype=_np.float64)
+                ds = rd.from_numpy_blocks(arr, override_num_blocks=parts)
+                shuffled = ds.random_shuffle(seed=7).materialize()
+                refs = shuffled._input_blocks
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=600)
+                # size via the directory: pulling the dataset to the
+                # driver would swamp both modes with the same floor
+                assert sum(rt.object_sizes(refs).values()) >= nbytes
+                res["mb_s"] = nbytes / (time.perf_counter() - t0) / 2**20
+                g0 = time.perf_counter()
+                counts = (
+                    rd.range(groupby_rows, override_num_blocks=16)
+                    .map(lambda x: {"k": x % 64, "v": x})
+                    .groupby("k")
+                    .count()
+                    .take_all()
+                )
+                assert sum(r["count"] for r in counts) == groupby_rows
+                res["groupby_s"] = time.perf_counter() - g0
+
+                if with_locality:
+                    # locality-scored streaming exchange: the weight is
+                    # read live by the in-process head and the driver's
+                    # shuffle_blocks (streaming form auto-selects), so
+                    # no cluster respawn is needed for this knob
+                    os.environ["RAY_TPU_SCHED_W_LOCALITY"] = "2.0"
+                    loc0 = rt.query_state("sched").get("locality", {})
+                    lds = rd.from_numpy_blocks(
+                        _np.arange(rows // 4, dtype=_np.float64),
+                        override_num_blocks=loc_parts,
+                    ).random_shuffle(seed=11).materialize()
+                    lrefs = lds._input_blocks
+                    ray_tpu.wait(
+                        lrefs, num_returns=len(lrefs), timeout=600
+                    )
+                    loc1 = rt.query_state("sched").get("locality", {})
+                    scored = (loc1.get("scored") or 0) - (
+                        loc0.get("scored") or 0
+                    )
+                    hits = (loc1.get("hit_frac_sum") or 0.0) - (
+                        loc0.get("hit_frac_sum") or 0.0
+                    )
+                    res["locality_hit_rate"] = (
+                        round(hits / scored, 3) if scored else None
+                    )
+                    res["locality_scored_leases"] = int(scored)
+                    res["arena_spills"] = _agent_spills(cluster, nodes)
+
+                    # streaming-ingest overlap: stall time (blocked in
+                    # next()) under a simulated train step, depth 0 vs 2
+                    def _stall(prefetch: int) -> float:
+                        it = shuffled.iter_batches(
+                            batch_size=max(1, rows // parts // 2),
+                            prefetch_batches=prefetch,
+                        )
+                        stall = 0.0
+                        while True:
+                            t = time.perf_counter()
+                            try:
+                                next(it)
+                            except StopIteration:
+                                break
+                            stall += time.perf_counter() - t
+                            time.sleep(0.004)  # the "train step"
+                        return stall
+
+                    stall0 = _stall(0)
+                    stall2 = _stall(2)
+                    res["ingest_stall_s"] = {
+                        "prefetch_0": round(stall0, 3),
+                        "prefetch_2": round(stall2, 3),
+                        "ratio": round(stall2 / max(stall0, 1e-9), 3),
+                    }
+            finally:
+                set_runtime(None)
+                rt.shutdown()
+        finally:
+            cluster.shutdown()
+            os.environ.pop("RAY_TPU_DATA_VECTOR_SHUFFLE", None)
+            os.environ.pop("RAY_TPU_SCHED_W_LOCALITY", None)
+        return res
+
+    out: dict = {}
+    try:
+        slow = _pass(vector=False, with_locality=False)
+        fast = _pass(vector=True, with_locality=True)
+        out["shuffle_gb_per_s"] = round(fast["mb_s"] / 1024, 3)
+        out["shuffle_mb_per_s"] = round(fast["mb_s"], 1)
+        out["shuffle_rowwise_mb_per_s"] = round(slow["mb_s"], 1)
+        out["shuffle_vector_speedup"] = round(
+            fast["mb_s"] / max(slow["mb_s"], 1e-9), 2
+        )
+        out["shuffle_groupby_s"] = {
+            "vectorized": round(fast["groupby_s"], 2),
+            "rowwise": round(slow["groupby_s"], 2),
+        }
+        # head-side locality accounting: fraction of each scored lease's
+        # input bytes resident on its chosen node (worker-local shm
+        # reads are invisible to agent transfer counters, so the head is
+        # the honest observer)
+        out["shuffle_locality_hit_rate"] = fast.get("locality_hit_rate")
+        out["shuffle_locality_scored_leases"] = fast.get(
+            "locality_scored_leases", 0
+        )
+        out["shuffle_arena_spills"] = fast.get("arena_spills", 0)
+        out["shuffle_rows"] = rows
+        out["shuffle_partitions"] = parts
+        out["ingest_stall_s"] = fast.get("ingest_stall_s")
+    except Exception as exc:  # noqa: BLE001 - other tiers still publish
+        out["shuffle_error"] = repr(exc)
+        return out
+    # env-tunable regression floor, mirroring the other tiers' floors
+    floor = float(
+        os.environ.get("RAY_TPU_BENCH_SHUFFLE_FLOOR_MB_PER_S", "0") or 0.0
+    )
+    if floor > 0:
+        out["shuffle_floor_mb_per_s"] = floor
+        out["shuffle_floor_ok"] = bool(out["shuffle_mb_per_s"] >= floor)
+    return out
+
+
 def serve_bench() -> dict:
     """Tier: serving plane under open-loop load. Poisson-ish arrivals at
     a fixed QPS stream tokens from a 2-replica continuous-batching LLM
@@ -1866,6 +2056,11 @@ def main():
             cluster.update(xnode_transfer_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["xnode_transfer_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_SHUFFLE", "1") != "0":
+        try:
+            cluster.update(shuffle_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["shuffle_error"] = repr(exc)
     if os.environ.get("RAY_TPU_BENCH_SERVE", "1") != "0":
         try:
             cluster.update(serve_bench())
@@ -1931,6 +2126,7 @@ def main():
         or out.get("serve_p99_ok") is False
         or out.get("serve_qps_ok") is False
         or out.get("xnode_floor_ok") is False
+        or out.get("shuffle_floor_ok") is False
         or out.get("failover_p95_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
@@ -1944,6 +2140,7 @@ def main():
         # RAY_TPU_BENCH_SERVE_P99_CEILING_MS /
         # RAY_TPU_BENCH_SERVE_QPS_FLOOR /
         # RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S /
+        # RAY_TPU_BENCH_SHUFFLE_FLOOR_MB_PER_S /
         # RAY_TPU_BENCH_FAILOVER_P95_S):
         # the JSON above still published; exit nonzero so CI notices
         import sys

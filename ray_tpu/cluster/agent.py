@@ -2907,6 +2907,12 @@ class NodeAgent:
                 "rpc_handlers": HANDLER_STATS.snapshot(),
             }
 
+    @staticmethod
+    def _fetch_gate_state() -> dict:
+        from .transport import FETCH_GATE
+
+        return FETCH_GATE.snapshot()
+
     def _object_plane_state(self) -> dict:
         from ray_tpu.native.spill import SHM_EVICTIONS
 
@@ -2950,6 +2956,10 @@ class NodeAgent:
                     "revoked": int(PEER_CONN_REVOKED.value()),
                     "reused": int(PEER_CONN_REUSED.value()),
                 },
+                # cross-fetch in-flight byte gate (shuffle reduce-side
+                # arena backpressure): waits > 0 means concurrent pulls
+                # actually queued behind the budget
+                "fetch_gate": self._fetch_gate_state(),
             },
         }
 

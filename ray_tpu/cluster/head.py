@@ -128,6 +128,21 @@ OWNERS_REAPED = _MetricCounter(
     label_names=("mode",),
 )
 
+# locality-scored placement (ISSUE 13): specs placed WITH residency data
+# and the summed fraction of their input bytes already resident on the
+# chosen node. hit_frac_total / scored_total == the plane's locality
+# hit-rate (bytes served same-node / total input bytes, in expectation).
+SCHED_LOCALITY_SCORED = _MetricCounter(
+    "sched_locality_scored_total",
+    "Leases placed while carrying a per-node input-residency vector "
+    "(sched_w_locality > 0 and located, sized deps).",
+)
+SCHED_LOCALITY_HIT_FRAC = _MetricCounter(
+    "sched_locality_hit_frac_total",
+    "Sum over locality-scored placements of the fraction of the "
+    "lease's input bytes resident on its chosen node.",
+)
+
 # preemption / migration (ISSUE 7): the kernel nominates a victim node
 # per starving shape; the head kills-and-requeues concrete victims there
 SCHED_PREEMPT_NOMINATED = _MetricCounter(
@@ -3256,9 +3271,15 @@ class HeadServer:
             with self._cond:
                 self._infeasible.extend(kernel_batch)
             return False
-        specs, shape_rows, sids, infeasible, keys, ages = self._round_shapes(
-            kernel_batch, r
-        )
+        (
+            specs,
+            shape_rows,
+            sids,
+            infeasible,
+            keys,
+            ages,
+            loc,
+        ) = self._round_shapes(kernel_batch, r)
         if infeasible:
             # a demand column past the view's resource axis names a
             # resource no node has ever reported — unplaceable until the
@@ -3279,8 +3300,9 @@ class HeadServer:
                     spread_threshold=self.hybrid_config.spread_threshold,
                     shapes=(shape_rows, sids),
                     ages=ages,
+                    locality=loc,
                 )
-                sched = (specs, shape_rows, sids, keys, pending)
+                sched = (specs, shape_rows, sids, keys, pending, loc)
                 pending.ctx = sched
                 with self._cond:
                     self._deferred_rounds[id(sched)] = specs
@@ -3309,8 +3331,9 @@ class HeadServer:
                 spread_threshold=self.hybrid_config.spread_threshold,
                 shapes=(shape_rows, sids),
                 ages=ages,
+                locality=loc,
             )
-            sched = (specs, shape_rows, sids, keys, pending)
+            sched = (specs, shape_rows, sids, keys, pending, loc)
             rows = pending.result()
         else:
             demands = shape_rows[sids]
@@ -3350,7 +3373,18 @@ class HeadServer:
         With no waiting shapes the order is byte-identical to the
         single-objective prep. ``ages`` are normalized by
         ``sched_starve_rounds`` and ride the demand upload (kernel
-        starvation discount + preemption arming)."""
+        starvation discount + preemption arming).
+
+        Locality (cfg.sched_w_locality > 0): specs whose top-level
+        ObjectRef deps resolve to located, sized directory entries carry
+        a per-node resident-bytes vector; specs with DIFFERENT vectors
+        get their own kernel slot even at the same resource shape (a
+        shuffle's reduce tasks share one shape but want different
+        nodes), and the per-slot vectors ride the demand upload as the
+        row-normalized f32[U, N] ``loc`` matrix (kernel locality bonus).
+        Weight 0 — the default — skips every bit of this: slot keys,
+        shape order, and the uploaded arrays are byte-identical to the
+        pre-locality prep."""
         cache_r, cache = self._dense_cache
         if cache_r != r or len(cache) > 8192:
             # width change invalidates; the size cap bounds a workload
@@ -3358,6 +3392,53 @@ class HeadServer:
             # steady shape sets rebuild in one round
             cache = {}
             self._dense_cache = (r, cache)
+        w_loc = float(cfg.sched_w_locality)
+        loc_l: Optional[List[Optional[np.ndarray]]] = (
+            [] if w_loc > 0 else None
+        )
+        loc_c = 0
+        loc_by_spec: Dict[int, Optional[np.ndarray]] = {}
+        if loc_l is not None:
+            # ONE brief lock acquisition snapshots just the directory
+            # facts ((size, view rows) per unique dep); the O(deps)
+            # vector builds run lock-free below — neither a per-spec
+            # take/release nor holding the head's most contended lock
+            # across ndarray writes survives shuffle-sized rounds
+            dep_info: Dict[str, Optional[Tuple[float, Tuple[int, ...]]]] = {}
+            with self._lock:
+                loc_c = self.view.totals.shape[0]
+                for spec in batch:
+                    for dep in spec.deps:
+                        if dep in dep_info:
+                            continue
+                        e = self._objects.get(dep)
+                        if e is None or not e.size or not e.locations:
+                            dep_info[dep] = None
+                            continue
+                        rows_t = []
+                        for nid in e.locations:
+                            row = self.view.row_if_known(nid)
+                            if row is not None and row < loc_c:
+                                rows_t.append(row)
+                        dep_info[dep] = (
+                            (float(e.size), tuple(rows_t))
+                            if rows_t
+                            else None
+                        )
+            for spec in batch:
+                if not spec.deps:
+                    continue
+                vec: Optional[np.ndarray] = None
+                for dep in spec.deps:
+                    info = dep_info.get(dep)
+                    if info is None:
+                        continue
+                    size, rows_t = info
+                    if vec is None:
+                        vec = np.zeros(loc_c, dtype=np.float32)
+                    for row in rows_t:
+                        vec[row] += size
+                loc_by_spec[id(spec)] = vec
         slots: Dict[tuple, int] = {}
         rows_l: List[np.ndarray] = []
         keys_l: List[tuple] = []
@@ -3378,16 +3459,27 @@ class HeadServer:
             if row is None:
                 infeasible.append(spec)
                 continue
-            slot = slots.get(key)
+            if loc_l is None:
+                skey: tuple = key
+                lv = None
+            else:
+                lv = loc_by_spec.get(id(spec))
+                # the byte signature splits slots ONLY between specs with
+                # genuinely different residency; identical reduce fan-ins
+                # (and every no-dep spec) still share one slot
+                skey = (key, None if lv is None else lv.tobytes())
+            slot = slots.get(skey)
             if slot is None:
                 slot = len(rows_l)
-                slots[key] = slot
+                slots[skey] = slot
                 rows_l.append(row)
                 keys_l.append(key)
+                if loc_l is not None:
+                    loc_l.append(lv)
             specs.append(spec)
             sid_l.append(slot)
         if not specs:
-            return specs, None, None, infeasible, None, None
+            return specs, None, None, infeasible, None, None, None
         shape_rows = np.stack(rows_l).astype(np.float32, copy=False)
         sids = np.asarray(sid_l, dtype=np.int32)
         order = hardest_first_order(shape_rows)
@@ -3405,6 +3497,15 @@ class HeadServer:
         remap = np.empty(shape_rows.shape[0], dtype=np.int32)
         remap[order] = np.arange(shape_rows.shape[0], dtype=np.int32)
         keys = [keys_l[i] for i in order]
+        loc = None
+        if loc_l is not None and any(v is not None for v in loc_l):
+            loc = np.zeros((len(loc_l), loc_c), dtype=np.float32)
+            for i, lv in enumerate(loc_l):
+                if lv is not None:
+                    total = float(lv.sum())
+                    if total > 0:
+                        loc[i] = lv / total
+            loc = loc[order]
         return (
             specs,
             shape_rows[order],
@@ -3412,6 +3513,7 @@ class HeadServer:
             infeasible,
             keys,
             ages[order],
+            loc,
         )
 
     def _ensure_pipeline(self):
@@ -3482,21 +3584,33 @@ class HeadServer:
             placed_per_shape = np.bincount(
                 sids[placed_mask], minlength=u
             )
+            # aggregate per shape KEY first: locality slot-splitting can
+            # put the same resource key in several kernel slots, and the
+            # class's progress must be judged across ALL of them — a
+            # per-slot loop would let an unplaced slot re-age a class
+            # another slot just served (order-dependent starvation)
+            per_key: Dict[tuple, List[int]] = {}
+            for i, key in enumerate(keys):
+                if total_per_shape[i] == 0:
+                    continue
+                ent = per_key.get(key)
+                if ent is None:
+                    ent = per_key[key] = [0, 0]
+                ent[0] += int(placed_per_shape[i])
+                ent[1] += int(total_per_shape[i])
             # under the lock: the scheduler thread (_round_shapes ages
             # read, ring-path bumps), RPC threads (QueryState), and this
             # completion thread all touch the wait tables
             with self._cond:
-                for i, key in enumerate(keys):
-                    if total_per_shape[i] == 0:
-                        continue
-                    if placed_per_shape[i] > 0:
+                for key, (placed_n, total_n) in per_key.items():
+                    if placed_n > 0:
                         # the CLASS made progress this round: it is not
                         # starving, even with instances left over —
                         # aging a continuously-served shape made it
                         # "starve" and preempt its own running peers in
                         # a kill/requeue livelock
                         self._shape_wait.pop(key, None)
-                        if placed_per_shape[i] >= total_per_shape[i]:
+                        if placed_n >= total_n:
                             self._preempt_cooldown.pop(key, None)
                     else:
                         self._shape_wait[key] = (
@@ -3544,6 +3658,18 @@ class HeadServer:
             return
         demands_mat = shape_rows[sids[idx]]
         row_arr = rows[idx].astype(np.int64)
+        loc = sched[5] if len(sched) > 5 else None
+        if loc is not None:
+            # locality accounting: loc rows are normalized residency
+            # fractions, so loc[slot, chosen_row] IS the fraction of this
+            # lease's input bytes already on its node
+            slot_arr = sids[idx]
+            scored = loc[slot_arr].sum(axis=1) > 0
+            n_scored = int(scored.sum())
+            if n_scored:
+                frac = loc[slot_arr, np.clip(row_arr, 0, loc.shape[1] - 1)]
+                SCHED_LOCALITY_SCORED.inc(n_scored)
+                SCHED_LOCALITY_HIT_FRAC.inc(float(frac[scored].sum()))
         order = np.argsort(row_arr, kind="stable")
         srt = row_arr[order]
         starts = np.flatnonzero(
@@ -4999,6 +5125,14 @@ class HeadServer:
                     "preemptions_by_kind": (
                         SCHED_PREEMPTIONS.values_by_label()
                     ),
+                    # locality-scored placement: hit_frac_sum / scored ==
+                    # the shuffle plane's locality hit-rate
+                    "locality": {
+                        "scored": SCHED_LOCALITY_SCORED.value(),
+                        "hit_frac_sum": round(
+                            SCHED_LOCALITY_HIT_FRAC.value(), 3
+                        ),
+                    },
                     "autoscaler_solver": {
                         "runs": SOLVER_RUNS.value(),
                         "fallbacks": SOLVER_FALLBACKS.value(),

@@ -107,6 +107,76 @@ def _stripe_cfg() -> Tuple[int, int, int]:
     return stripe, conns, cap
 
 
+class _FetchGate:
+    """Process-wide in-flight byte budget across ALL concurrent socket
+    fetches (cfg.net_fetch_inflight_cap_bytes) — the shuffle reduce
+    side's arena backpressure: a task resolving many non-resident
+    partitions at once parks its later pulls until earlier ones land
+    (and, under arena pressure, until the spill path has drained the
+    coldest residents), instead of staging an unbounded byte wave.
+
+    Per-transfer stripe fan-out is separately capped by
+    ``net_inflight_cap_bytes``; this gate composes across transfers.
+    Advisory by construction: a transfer larger than the whole cap
+    proceeds alone, and a waiter past its bounded deadline proceeds
+    with the timeout counter bumped — backpressure must never become a
+    deadlock. The park is additionally capped at ``MAX_PARK_S``: the
+    acquire happens after the size handshake, when the SERVING side is
+    already mid-send holding its admission slot (and its idle-close
+    clock is ticking), so a parked fetch must release that remote
+    pressure quickly rather than pin it for a whole caller deadline."""
+
+    #: hard ceiling on one park (see class docstring) — well under the
+    #: server's idle-close window so a park never severs the connection
+    MAX_PARK_S = 15.0
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self.waits = 0
+        self.timeouts = 0
+
+    def acquire(self, nbytes: int, timeout_s: float = MAX_PARK_S) -> int:
+        from ray_tpu.config import cfg
+
+        cap = int(cfg.net_fetch_inflight_cap_bytes)
+        if cap <= 0 or nbytes <= 0:
+            return 0
+        timeout_s = min(timeout_s, self.MAX_PARK_S)
+        deadline = time.monotonic() + max(0.05, timeout_s)
+        with self._cv:
+            waited = False
+            while self._inflight > 0 and self._inflight + nbytes > cap:
+                if not waited:
+                    waited = True
+                    self.waits += 1
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self.timeouts += 1
+                    break
+                self._cv.wait(timeout=min(left, 1.0))
+            self._inflight += nbytes
+        return nbytes
+
+    def release(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._cv:
+            self._inflight -= nbytes
+            self._cv.notify_all()
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "inflight_bytes": self._inflight,
+                "waits": self.waits,
+                "timeouts": self.timeouts,
+            }
+
+
+FETCH_GATE = _FetchGate()
+
+
 # ---------------------------------------------------------------------------
 # serving side
 # ---------------------------------------------------------------------------
@@ -662,13 +732,23 @@ def fetch_bytes(
     """Pull one object over the link into host memory (driver-side /
     arena-less callers)."""
     out: List[bytearray] = []
+    gated = [0]
 
     def alloc(total: int) -> memoryview:
+        gated[0] = FETCH_GATE.acquire(
+            total,
+            _FetchGate.MAX_PARK_S
+            if deadline is None
+            else max(0.05, deadline - time.monotonic()),
+        )
         buf = bytearray(total)
         out.append(buf)
         return memoryview(buf)
 
-    _fetch(link, object_id, purpose, alloc, deadline)
+    try:
+        _fetch(link, object_id, purpose, alloc, deadline)
+    finally:
+        FETCH_GATE.release(gated[0])
     return out[0]
 
 
@@ -689,8 +769,17 @@ def fetch_to_store(
     joined bytes take ``put_bytes`` (which owns the spill fallback).
     Returns the object's size."""
     state: Dict[str, object] = {}
+    gated = [0]
 
     def alloc(total: int) -> memoryview:
+        # cross-fetch byte gate BEFORE staging arena pages: concurrent
+        # partition pulls queue here while earlier ones land/spill
+        gated[0] = FETCH_GATE.acquire(
+            total,
+            _FetchGate.MAX_PARK_S
+            if deadline is None
+            else max(0.05, deadline - time.monotonic()),
+        )
         staged = None
         beginner = getattr(store, "begin_put", None)
         if beginner is not None:
@@ -716,6 +805,8 @@ def fetch_to_store(
         if state.get("staged"):
             store.abort_put(object_id)
         raise
+    finally:
+        FETCH_GATE.release(gated[0])
     if state.get("dup"):
         return total
     if state.get("staged"):

@@ -209,6 +209,18 @@ define(
     "and takes any available one.",
 )
 define(
+    "sched_w_locality",
+    0.0,
+    "Weight of the data-locality term in the multi-objective scheduling "
+    "cost: a per-(shape, node) BONUS for nodes already holding the "
+    "task's input-partition bytes (object-directory locations x seal "
+    "sizes, uploaded with the demand rows), so shuffle reduce tasks "
+    "land where their map partitions live. 0 (default) keeps round "
+    "prep and the kernel program byte-identical to the pre-locality "
+    "path; specs with different residency split into their own kernel "
+    "slots when > 0.",
+)
+define(
     "sched_starve_rounds",
     32,
     "Park-retry rounds before a demand shape counts as STARVING: its "
@@ -525,6 +537,16 @@ define(
     256 << 20,
     "Cap on in-flight (requested, not yet landed) bytes per striped "
     "transfer — backpressure into the receiving arena.",
+)
+define(
+    "net_fetch_inflight_cap_bytes",
+    512 << 20,
+    "Cap on TOTAL in-flight socket-fetch bytes across all concurrent "
+    "peer pulls in one process (a shuffle reduce resolving many "
+    "partitions at once must not stage more than this into the arena "
+    "before the spill path can drain it). New fetches park until "
+    "running ones land; a single transfer larger than the cap still "
+    "proceeds alone. 0 disables the gate.",
 )
 define(
     "peer_link_ttl_s",
@@ -917,4 +939,35 @@ define(
     2,
     "Default per-actor in-flight cap for actor-pool map operators "
     "(pipelines the next block behind the running one).",
+)
+define(
+    "data_vector_shuffle",
+    True,
+    "Vectorized shuffle partitioning for numeric blocks (hash/bincount "
+    "+ stable-argsort gather instead of per-row list appends; ndarray "
+    "blocks keep their partitions as buffer-backed arrays so the "
+    "pickle-5 frames scatter-write straight into the shm arena). Off: "
+    "the generic row loop, kept as the fallback for non-numeric keys "
+    "and as the bench baseline.",
+)
+define(
+    "data_shuffle_eager_free",
+    True,
+    "Free each shuffle partition's map refs as its reduce task seals "
+    "(_flush_frees-style batches) instead of retaining every "
+    "map-partition ref until the whole reduce stage completes — bounds "
+    "arena fill by in-flight reduces, not dataset size. Freed "
+    "partitions are no longer available to re-reconstruct an "
+    "ALREADY-SEALED reduce output (same trade as the streaming "
+    "executor's eager intermediate frees).",
+)
+define(
+    "data_prefetch_batches",
+    2,
+    "Default prefetch depth (in blocks) of streaming dataset ingest: "
+    "iter_batches pulls this many upcoming blocks over the object "
+    "plane concurrently with the consumer's step, so a training loop "
+    "overlaps shuffle tail latency instead of stalling per block. Used "
+    "by train dataset shards; Dataset.iter_batches defaults to 0 "
+    "(off) unless prefetch_batches is passed.",
 )

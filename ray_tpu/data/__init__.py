@@ -11,6 +11,7 @@ from .dataset import (  # noqa: F401
     GroupedData,
     from_items,
     from_numpy,
+    from_numpy_blocks,
     range_,
 )
 from .execution import ActorPoolStrategy, actors  # noqa: F401

@@ -54,9 +54,21 @@ def rows_iter(block: Any) -> Iterator[Any]:
         yield from block
 
 
+def is_ndarray(block: Any) -> bool:
+    """ndarray blocks: rows along axis 0 (shuffle map/reduce outputs of
+    numeric datasets, ``from_numpy``). They ride the object plane as
+    buffer-backed pickle-5 frames — arena scatter writes on seal,
+    zero-copy views on same-node reads."""
+    import numpy as np
+
+    return isinstance(block, np.ndarray)
+
+
 def block_nbytes(block: Any) -> int:
     """Byte size for block-size-aware repartitioning."""
     if is_arrow(block):
+        return int(block.nbytes)
+    if is_ndarray(block):
         return int(block.nbytes)
     import cloudpickle
 
@@ -140,11 +152,19 @@ def block_to_table(block: Any):
 
 def concat_blocks(blocks: List[Any]):
     """One block from many (repartition coalescing): all-Arrow inputs
-    concat zero-copy; otherwise rows."""
+    concat zero-copy; all-ndarray inputs concat into one buffer;
+    otherwise rows."""
     if blocks and all(is_arrow(b) for b in blocks):
         import pyarrow as pa
 
         return pa.concat_tables(blocks)
+    if blocks and all(is_ndarray(b) for b in blocks):
+        import numpy as np
+
+        try:
+            return np.concatenate(blocks)
+        except ValueError:  # mismatched shapes/dtypes: fall through
+            pass
     out: List[Any] = []
     for b in blocks:
         out.extend(block_rows(b))

@@ -541,23 +541,31 @@ class Dataset:
         ]
 
     # execution (streaming)
-    def iter_blocks(self) -> Iterator[List[Any]]:
+    def iter_blocks(self, *, prefetch_blocks: int = 0) -> Iterator[List[Any]]:
         """Streaming executor: the op plan compiles to a stage topology
         (task fusion + actor-pool stages) executed as a pipeline with a
         byte-budget admission window per stage (execution.py). Blocks may
         be host lists or ObjectRefs (shuffle outputs stay in the object
         store until consumed — the driver only materializes a block at
-        its own consumption point, here)."""
+        its own consumption point, here).
+
+        ``prefetch_blocks``: pull up to this many upcoming blocks over
+        the object plane concurrently with the consumer (depth-N
+        prefetch) — a reduce output that seals while the consumer is
+        busy is already local by the time the iterator reaches it, so a
+        training step overlaps shuffle tail latency instead of stalling
+        per block."""
         if not self._ops:
-            for b in self._input_blocks:
-                yield ray_tpu.get(b) if isinstance(b, ray_tpu.ObjectRef) else b
+            yield from _prefetched_blocks(
+                iter(self._input_blocks), prefetch_blocks
+            )
             return
         from .execution import StreamingExecutor
 
-        for ref in StreamingExecutor(
-            self._input_blocks, self._build_stages()
-        ).run():
-            yield ray_tpu.get(ref)
+        yield from _prefetched_blocks(
+            StreamingExecutor(self._input_blocks, self._build_stages()).run(),
+            prefetch_blocks,
+        )
 
     def iter_rows(self) -> Iterator[Any]:
         from . import block as blk
@@ -566,14 +574,28 @@ class Dataset:
             yield from blk.rows_iter(block)
 
     def iter_batches(
-        self, *, batch_size: int = 256, batch_format: str = "numpy"
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        prefetch_batches: int = 0,
     ) -> Iterator[Any]:
         """Arrow blocks batch as zero-copy slices (a block boundary may
-        yield a short batch); row-list blocks buffer across blocks."""
+        yield a short batch); ndarray blocks slice their buffer
+        (zero-copy views); row-list blocks buffer across blocks.
+
+        ``prefetch_batches``: streaming-ingest depth, in BLOCKS — up to
+        this many upcoming blocks are pulled over the object plane while
+        the consumer processes the current one, overlapping fetch (and
+        the shuffle's reduce tail) with the train step. 0 (default) =
+        fully synchronous pulls; training dataset shards default to
+        cfg.data_prefetch_batches (train/session.py DataIterator)."""
         from . import block as blk
 
         buf: List[Any] = []
-        for block in self.iter_blocks():
+        for block in self.iter_blocks(
+            prefetch_blocks=max(0, int(prefetch_batches))
+        ):
             if blk.is_arrow(block):
                 if buf:
                     yield _rows_to_batch(buf, batch_format)
@@ -584,6 +606,15 @@ class Dataset:
                         block, i, min(batch_size, n - i)
                     )
                     yield blk.arrow_to_batch(piece, batch_format)
+                continue
+            if blk.is_ndarray(block):
+                if buf:
+                    yield _rows_to_batch(buf, batch_format)
+                    buf = []
+                for i in range(0, len(block), batch_size):
+                    yield _ndarray_to_batch(
+                        block[i : i + batch_size], batch_format
+                    )
                 continue
             for row in block:
                 buf.append(row)
@@ -623,6 +654,62 @@ class Dataset:
             f"Dataset(num_blocks={len(self._input_blocks)}, "
             f"num_ops={len(self._ops)})"
         )
+
+
+def _resolve_block(b: Any) -> Any:
+    return ray_tpu.get(b) if isinstance(b, ray_tpu.ObjectRef) else b
+
+
+def _prefetched_blocks(block_iter: Iterator[Any], depth: int) -> Iterator[Any]:
+    """Depth-N streaming consumption: keep up to ``depth`` upcoming
+    blocks' object-plane pulls in flight while the consumer holds the
+    current one. Results yield in ITERATOR order (a Dataset's block
+    order is its row order); the pulls themselves overlap both each
+    other and the consumer's step."""
+    if depth <= 0:
+        for b in block_iter:
+            yield _resolve_block(b)
+        return
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(
+        max_workers=min(depth, 8), thread_name_prefix="data-prefetch"
+    )
+    try:
+        window: Any = deque()
+        for b in block_iter:
+            window.append(pool.submit(_resolve_block, b))
+            if len(window) > depth:
+                yield window.popleft().result()
+        while window:
+            yield window.popleft().result()
+    finally:
+        # an abandoned iterator (consumer breaks out of its loop) must
+        # not block on up-to-`depth` in-flight fetches nobody will read:
+        # cancel queued pulls and return without joining — any running
+        # pull drains in its pool thread
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _ndarray_to_batch(piece: np.ndarray, batch_format: str):
+    """An ndarray block slice as a batch — the same shapes
+    _rows_to_batch builds from scalar rows, without materializing rows
+    ("numpy": a zero-copy {"data": view})."""
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+
+        from . import block as blk
+
+        # pa.array only accepts 1-D input; multi-dim rows become list
+        # rows (the same shape rows_to_arrow produced for them)
+        arr = pa.array(piece if piece.ndim == 1 else list(piece))
+        return blk.synthetic_table(arr, "data")
+    if batch_format == "pandas":
+        import pandas as pd
+
+        return pd.DataFrame({"data": list(piece)})
+    return {"data": piece}
 
 
 def _key_fn(key: Any) -> Optional[Callable]:
@@ -782,3 +869,20 @@ def range_(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:
 
 def from_numpy(arr: np.ndarray, **kwargs) -> Dataset:
     return from_items(list(arr), **kwargs)
+
+
+def from_numpy_blocks(
+    arr: np.ndarray, *, override_num_blocks: Optional[int] = None
+) -> Dataset:
+    """Dataset over raw ndarray blocks (rows along axis 0) — the
+    zero-copy shuffle path: blocks, map partitions, and reduce outputs
+    stay buffer-backed arrays end-to-end, so their pickle-5 frames
+    scatter-write straight into the shm arena at every seal and
+    iter_batches serves zero-copy {"data": view} batches. Use
+    ``io.from_numpy`` for the Arrow-table (named-column) form."""
+    n_blocks = override_num_blocks or min(
+        max(1, len(arr) // 65536 or 1), 200
+    )
+    return Dataset(
+        [b for b in np.array_split(arr, n_blocks) if len(b)], []
+    )

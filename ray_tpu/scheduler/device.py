@@ -198,6 +198,7 @@ def score_weights_from_cfg():
         het=float(cfg.sched_w_het),
         frag=float(cfg.sched_w_frag),
         starve=float(cfg.sched_w_starve),
+        locality=float(cfg.sched_w_locality),
     )
 
 
@@ -518,6 +519,7 @@ class DeviceSchedulerState:
         shapes=None,
         ages: Optional[np.ndarray] = None,
         weights=None,
+        locality: Optional[np.ndarray] = None,
     ) -> PendingRound:
         """Dispatch a placement round without blocking on its readback.
 
@@ -539,6 +541,13 @@ class DeviceSchedulerState:
         nomination (cfg.sched_preempt): ``PendingRound.preempt_rows()``
         then yields the per-shape victim-node nominations. ``weights``:
         hybrid.ScoreWeights override (default: the cfg knobs).
+
+        ``locality``: optional f32[U, N'] per-shape per-node locality
+        fraction (head._round_shapes: input bytes resident per node,
+        row-normalized). Uploaded — and traced into the kernel — only
+        when the resolved weights carry locality > 0, so the default
+        config never pays the extra upload and keeps the pre-locality
+        program byte-for-byte.
         """
         from ray_tpu.config import cfg
 
@@ -572,6 +581,17 @@ class DeviceSchedulerState:
         sd_dev = put(sd, self.device)
         sids_dev = put(sids, self.device)
         ages_dev = put(age_vec, self.device)
+        loc_dev = None
+        if locality is not None and getattr(weights, "locality", 0.0):
+            # pad shapes with zero rows (no locality data → neutral);
+            # clip/zero-pad the node axis to the resident capacity so a
+            # view growth between round prep and dispatch cannot feed
+            # the kernel a mis-shaped matrix
+            c = int(self._totals.shape[0])
+            loc = np.zeros((u_pad, c), dtype=np.float32)
+            nn = min(int(locality.shape[1]), c)
+            loc[:u, :nn] = locality[:u, :nn]
+            loc_dev = put(loc, self.device)
         SCHED_UPLOAD_MS.observe((time.perf_counter() - t_up) * 1e3)
         with self._lock:
             self._seed += 1
@@ -589,6 +609,7 @@ class DeviceSchedulerState:
                 spread_threshold=spread_threshold,
                 weights=weights,
                 preempt=preempt,
+                locality=loc_dev,
             )
             self._avail = res.avail_out
         node = res.node

@@ -101,17 +101,24 @@ class BatchResult(NamedTuple):
 
 
 class ScoreWeights(NamedTuple):
-    """Multi-objective scoring weights (cfg sched_w_util/het/frag/starve).
+    """Multi-objective scoring weights (cfg sched_w_util/het/frag/starve/
+    locality).
 
     Static under jit (a weight change recompiles, which is the rare
-    config-edit path, not the round path); ``(1, 0, 0, 0)`` recovers the
-    single-objective kernel exactly — the extra terms are skipped at
+    config-edit path, not the round path); ``(1, 0, 0, 0, 0)`` recovers
+    the single-objective kernel exactly — the extra terms are skipped at
     trace time, not multiplied by zero."""
 
     util: float = 1.0
     het: float = 0.0
     frag: float = 0.0
     starve: float = 0.0
+    # data locality (ISSUE 13 / ROADMAP 5): per-(shape, node) bonus for
+    # nodes already holding the shape's input bytes — the fragmentation
+    # term of arxiv 2512.10980 generalized from stranded slots to
+    # stranded BYTES (a reduce placed off its map partitions strands
+    # their resident copies behind a cross-node refetch).
+    locality: float = 0.0
 
 
 #: One-sided quantum of the waterfall kernels' utilization score: the ONE
@@ -458,16 +465,25 @@ def _shape_cost(
     thr: jax.Array,
     ref: jax.Array,
     weights: ScoreWeights,
+    loc: Optional[jax.Array] = None,
 ) -> jax.Array:
     """f32[N] multi-objective placement cost for one shape (lower is
     better; inf on nodes with no capacity). The ONE cost definition
     shared by the shapes waterfall and the parked-ring kernel. Weight
     terms are skipped at TRACE time when their weight is 0, so
-    weights=(1,0,0,0) emits exactly the single-objective program."""
+    weights=(1,0,0,0,0) emits exactly the single-objective program.
+
+    ``loc``: optional f32[N] locality fraction in [0, 1] — the share of
+    this shape's input bytes already resident on each node (normalized
+    host-side). A BONUS, not a penalty: all-zero rows (no located
+    inputs, or a consumer with no locality data like the parked ring)
+    leave the cost untouched, so locality-blind shapes keep the exact
+    single-objective ordering even at weight > 0."""
     cost = quantize_score(score)
     if weights.util != 1.0:
         cost = weights.util * cost
-    if weights.het or weights.frag:
+    has_loc = bool(weights.locality) and loc is not None
+    if weights.het or weights.frag or has_loc:
         # starving shapes discount the soft terms: a shape that has
         # waited w_starve-scaled ages takes ANY available node
         scale = 1.0 / (1.0 + weights.starve * age) if weights.starve else 1.0
@@ -479,6 +495,10 @@ def _shape_cost(
             cost = cost + (QUANTIZE_STEPS * weights.frag * scale) * _frag_penalty(
                 totals, avail_run, d, ref
             )
+        if has_loc:
+            # discounting the bonus too: a starving shape stops holding
+            # out for the partition-heavy node and takes any capacity
+            cost = cost - (QUANTIZE_STEPS * weights.locality * scale) * loc
     cost = cost + jitter
     return jnp.where(cap > 0, cost, jnp.inf)
 
@@ -523,8 +543,14 @@ def hybrid_schedule_shapes_multi_impl(
     spread_threshold: float = 0.5,
     weights: ScoreWeights = ScoreWeights(),
     preempt: bool = False,
+    locality: Optional[jax.Array] = None,
 ) -> ShapesResult:
     """Shape-grouped waterfall placement — the fastest scheduling kernel.
+
+    ``locality``: optional f32[U, N] per-shape per-node locality fraction
+    (share of the shape's input bytes resident on each node, normalized
+    host-side; see ``_shape_cost``). Consulted only when
+    ``weights.locality`` > 0 — None keeps the pre-locality trace.
 
     The reference queues leases per *scheduling class* (shape) and schedules
     shape-by-shape (cluster_lease_manager.cc:196 iterates shape queues); this
@@ -576,9 +602,14 @@ def hybrid_schedule_shapes_multi_impl(
         # quantized score + random jitter == uniform pick among near-tied
         # nodes (the reference's top-k randomization)
         jitter = jax.random.uniform(key, (n,), dtype=jnp.float32)
+        loc_row = (
+            locality[uidx]
+            if (weights.locality and locality is not None)
+            else None
+        )
         cost = _shape_cost(
             totals, avail_run, d, cap, score, jitter,
-            ages[uidx], ntypes, thr, ref, weights,
+            ages[uidx], ntypes, thr, ref, weights, loc_row,
         )
         # top-k beats a full argsort ~3x on CPU XLA and is exact here: a
         # request at rank r within its shape needs at most r+1 nodes of

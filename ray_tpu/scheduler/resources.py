@@ -431,6 +431,12 @@ class ClusterView:
     def row_of(self, node_id: str) -> int:
         return self._id_to_row[node_id]
 
+    def row_if_known(self, node_id: str) -> Optional[int]:
+        """Row index, or None for a node this view never interned —
+        locality scoring must skip stale directory locations instead of
+        raising (the object outlives its node's membership)."""
+        return self._id_to_row.get(node_id)
+
     def node_id(self, row: int) -> str:
         return self._node_ids[row]
 

@@ -9,7 +9,12 @@ plane, exactly the reference JaxTrainer split (train/v2/jax/jax_trainer.py:20,
 config.py:44-104).
 """
 from .checkpoint import Checkpoint  # noqa: F401
-from .session import get_context, report  # noqa: F401
+from .session import (  # noqa: F401
+    DataIterator,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from .trainer import (  # noqa: F401
     FailureConfig,
     JaxTrainer,

@@ -3,9 +3,46 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 from .checkpoint import Checkpoint
+
+
+class DataIterator:
+    """Per-worker view of one dataset shard (ray.train DataIterator
+    analog): ``iter_batches`` defaults to streaming ingest at
+    cfg.data_prefetch_batches depth, so the train step overlaps the
+    shard's object-plane pulls (and the shuffle reduce tail feeding
+    them) instead of stalling between batches."""
+
+    def __init__(self, dataset: Any):
+        self._ds = dataset
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        prefetch_batches: Optional[int] = None,
+    ) -> Iterator[Any]:
+        if prefetch_batches is None:
+            from ray_tpu.config import cfg
+
+            prefetch_batches = int(cfg.data_prefetch_batches)
+        return self._ds.iter_batches(
+            batch_size=batch_size,
+            batch_format=batch_format,
+            prefetch_batches=prefetch_batches,
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._ds.iter_rows()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+    def materialize(self):
+        return self._ds.materialize()
 
 
 @dataclass
@@ -17,6 +54,9 @@ class TrainContext:
     experiment_name: str = ""
     trial_dir: str = ""
     latest_checkpoint: Optional[Checkpoint] = None
+    # per-rank dataset shards (JaxTrainer datasets=), wrapped as
+    # DataIterators at access time
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
     # reporting channel back to the controller
     _reports: List[Dict[str, Any]] = field(default_factory=list)
     _lock: threading.Lock = field(default_factory=threading.Lock)
@@ -33,6 +73,15 @@ class TrainContext:
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint
 
+    def get_dataset_shard(self, name: str = "train") -> DataIterator:
+        ds = self.dataset_shards.get(name)
+        if ds is None:
+            raise KeyError(
+                f"no dataset shard {name!r}; pass datasets={{{name!r}: ds}} "
+                "to JaxTrainer"
+            )
+        return DataIterator(ds)
+
 
 _session = threading.local()
 
@@ -46,6 +95,13 @@ def get_context() -> TrainContext:
     if ctx is None:
         raise RuntimeError("not inside a train worker (no session context)")
     return ctx
+
+
+def get_dataset_shard(name: str = "train") -> DataIterator:
+    """ray.train.get_dataset_shard parity: this rank's shard of a
+    dataset passed to JaxTrainer(datasets=...), as a streaming
+    DataIterator."""
+    return get_context().get_dataset_shard(name)
 
 
 def report(

@@ -84,10 +84,13 @@ class _TrainWorker:
         os.environ.setdefault("RAY_TPU_WORLD_SIZE", str(world_size))
 
     def run(self, fn: Callable, config: Dict[str, Any],
-            restore: Optional[str]) -> List[Dict[str, Any]]:
+            restore: Optional[str],
+            dataset_shards: Optional[Dict[str, Any]] = None,
+            ) -> List[Dict[str, Any]]:
         self.ctx.latest_checkpoint = (
             Checkpoint(restore) if restore else None
         )
+        self.ctx.dataset_shards = dict(dataset_shards or {})
         self.ctx._reports = []
         _set_context(self.ctx)
         try:
@@ -118,11 +121,17 @@ class JaxTrainer:
         train_loop_config: Optional[Dict[str, Any]] = None,
         scaling_config: ScalingConfig = None,
         run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.train_loop = train_loop_per_worker
         self.config = dict(train_loop_config or {})
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # name -> Dataset; split() into one shard per rank at launch,
+        # consumed in the loop via train.get_dataset_shard(name)
+        # (streaming iter_batches with prefetch — ingest overlaps the
+        # train step)
+        self.datasets = dict(datasets or {})
 
     def fit(self) -> Result:
         name = self.run_config.name or f"train-{uuid.uuid4().hex[:6]}"
@@ -163,6 +172,14 @@ class JaxTrainer:
             raise TimeoutError(
                 f"placement group for {n} workers x {res} not schedulable"
             )
+        # one shard per rank, split ONCE per attempt: blocks become
+        # ObjectRefs here (pending ops execute through the streaming
+        # shuffle plane) and only the refs ship to the workers — each
+        # rank pulls its own shard's blocks over the object plane as its
+        # prefetching iterator reaches them
+        shard_lists = {
+            dname: ds.split(n) for dname, ds in self.datasets.items()
+        }
         workers = []
         try:
             workers = [
@@ -175,8 +192,16 @@ class JaxTrainer:
                 for i in range(n)
             ]
             refs = [
-                w.run.remote(self.train_loop, self.config, restore_path)
-                for w in workers
+                w.run.remote(
+                    self.train_loop,
+                    self.config,
+                    restore_path,
+                    {
+                        dname: shards[i]
+                        for dname, shards in shard_lists.items()
+                    },
+                )
+                for i, w in enumerate(workers)
             ]
             reports_per_rank = ray_tpu.get(refs)
             return reports_per_rank[0]  # rank-0 reports are authoritative
