@@ -1650,6 +1650,198 @@ def shuffle_bench() -> dict:
     return out
 
 
+def _elastic_bench_init(config):
+    import numpy as np
+
+    d = int(config["dim"])
+    return {"w": np.zeros(d), "opt": {"m": np.zeros(d)}}
+
+
+def _elastic_bench_step(state, step, gang, config):
+    import time as _time
+
+    import numpy as np
+
+    d = int(config["dim"])
+    work = int(config["work"])
+    partials = {}
+    for v in gang.owned_shards():
+        # deterministic integer-valued synthetic grads + some real work
+        x = np.full((work, d), float((v + step) % 7))
+        partials[v] = {"g": x.sum(axis=0)}
+    g = gang.allreduce_shards(partials)
+    w = state["w"] + g["g"]
+    m = state["opt"]["m"] + 1.0
+    _time.sleep(float(config.get("step_sleep", 0.0)))
+    return {"w": w, "opt": {"m": m}}, {
+        "step": step,
+        "world": gang.world,
+        "wall": _time.time(),
+    }
+
+
+def elastic_train_bench() -> dict:
+    """Tier: elastic-training step-time retention across a mid-run mesh
+    shrink and grow-back. A 2-rank STRICT_SPREAD gang trains on a 2-node
+    cluster; the node hosting rank 1 is SIGKILLed mid-run (checkpoint-
+    free shrink to the surviving topology via object-plane seals), a
+    replacement node joins, and the gang grows back. Exports
+    elastic_step_retention_pct = 100 x (median step rate after the
+    grow-back) / (median step rate before the kill), with a
+    RAY_TPU_BENCH_ELASTIC_RETENTION_FLOOR exit-1 gate, plus the
+    recovery gap and the disk-restore count (must be 0)."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.train import ElasticConfig, ElasticTrainer
+
+    os.environ.setdefault("RAY_TPU_HEALTH_TIMEOUT_S", "2.0")
+    total_steps = int(os.environ.get("RAY_TPU_BENCH_ELASTIC_STEPS", 150))
+    cluster = Cluster(use_device_scheduler=False)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    rt = cluster.client()
+    set_runtime(rt)
+    t0 = time.perf_counter()
+    try:
+        trainer = ElasticTrainer(
+            _elastic_bench_init,
+            _elastic_bench_step,
+            total_steps=total_steps,
+            train_loop_config={
+                "dim": 4096,
+                "work": 64,
+                "step_sleep": 0.04,
+            },
+            elastic_config=ElasticConfig(
+                min_workers=1,
+                max_workers=2,
+                virtual_shards=4,
+                seal_interval_steps=2,
+                grow=True,
+                placement_strategy="STRICT_SPREAD",
+                resources_per_worker={"CPU": 1.0},
+            ),
+        )
+        out_box = {}
+
+        def _fit():
+            try:
+                out_box["res"] = trainer.fit()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                out_box["exc"] = exc
+
+        th = threading.Thread(target=_fit)
+        th.start()
+        kill_at = max(6, total_steps // 3)
+        deadline = time.monotonic() + 120
+        while (
+            trainer.progress()["step"] < kill_at
+            and time.monotonic() < deadline
+            and th.is_alive()
+        ):
+            time.sleep(0.1)
+        if "exc" in out_box:
+            raise out_box["exc"]
+        gangs = rt.head.call("QueryState", {"kind": "gangs"})
+        victim = gangs.get(trainer.gang_id, {"members": {}})[
+            "members"
+        ].get("1")
+        if not victim:
+            # a skipped kill would publish green retention numbers for
+            # a fault scenario that never ran — fail the tier instead
+            raise RuntimeError(
+                "elastic bench: could not resolve rank-1's node to kill "
+                f"(gang state: {gangs.get(trainer.gang_id)})"
+            )
+        t_kill = time.monotonic()
+        cluster.kill_node(victim)
+        # capacity returns once the shrink landed (autoscaler restore)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and th.is_alive():
+            if any(
+                r["direction"] == "shrink" for r in trainer.reshape_log
+            ):
+                break
+            time.sleep(0.2)
+        shrink_s = time.monotonic() - t_kill
+        cluster.add_node({"CPU": 2.0}, num_workers=2)
+        th.join(timeout=300)
+        if "exc" in out_box:
+            raise out_box["exc"]
+        res = out_box.get("res")
+        if th.is_alive() or res is None:
+            raise TimeoutError("elastic bench fit() did not finish")
+        if res.error is not None:
+            raise res.error
+        hist = res.metrics_history
+        walls = {m["step"]: m["wall"] for m in hist}
+        el = res.metrics["elastic"]
+        shrinks = [
+            r for r in el["reshapes"] if r["direction"] == "shrink"
+        ]
+        grows = [r for r in el["reshapes"] if r["direction"] == "grow"]
+        kill_step = shrinks[0]["resume_step"] if shrinks else kill_at
+        post_start = (
+            grows[-1]["resume_step"] + 1 if grows else kill_step + 1
+        )
+
+        def _median_rate(lo: int, hi: int) -> float:
+            deltas = [
+                walls[s + 1] - walls[s]
+                for s in range(lo, hi - 1)
+                if s in walls and s + 1 in walls
+            ]
+            deltas = sorted(d for d in deltas if d > 0)
+            if not deltas:
+                return 0.0
+            return 1.0 / deltas[len(deltas) // 2]
+
+        rate_pre = _median_rate(2, kill_step)
+        rate_post = _median_rate(post_start, total_steps)
+        retention = (
+            100.0 * rate_post / rate_pre if rate_pre > 0 else 0.0
+        )
+        out = {
+            "elastic_steps": len(hist),
+            "elastic_steps_contiguous": [
+                m["step"] for m in hist
+            ] == list(range(total_steps)),
+            "elastic_step_rate_pre_per_s": round(rate_pre, 2),
+            "elastic_step_rate_post_per_s": round(rate_post, 2),
+            "elastic_step_retention_pct": round(retention, 1),
+            "elastic_shrink_detect_s": round(shrink_s, 2),
+            "elastic_reshapes": [
+                (r["direction"], r["from_world"], r["to_world"])
+                for r in el["reshapes"]
+            ],
+            "elastic_grow_back": bool(grows),
+            "elastic_disk_restores": el["disk_restores"],
+            "elastic_wall_s": round(time.perf_counter() - t0, 1),
+        }
+        floor = float(
+            os.environ.get(
+                "RAY_TPU_BENCH_ELASTIC_RETENTION_FLOOR", "0"
+            )
+            or 0.0
+        )
+        if floor > 0:
+            out["elastic_retention_floor_pct"] = floor
+            out["elastic_retention_ok"] = bool(
+                retention >= floor and el["disk_restores"] == 0
+            )
+        return out
+    finally:
+        set_runtime(None)
+        try:
+            rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
 def serve_bench() -> dict:
     """Tier: serving plane under open-loop load. Poisson-ish arrivals at
     a fixed QPS stream tokens from a 2-replica continuous-batching LLM
@@ -2061,6 +2253,11 @@ def main():
             cluster.update(shuffle_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["shuffle_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_ELASTIC", "1") != "0":
+        try:
+            cluster.update(elastic_train_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["elastic_train_error"] = repr(exc)
     if os.environ.get("RAY_TPU_BENCH_SERVE", "1") != "0":
         try:
             cluster.update(serve_bench())
@@ -2128,6 +2325,7 @@ def main():
         or out.get("xnode_floor_ok") is False
         or out.get("shuffle_floor_ok") is False
         or out.get("failover_p95_ok") is False
+        or out.get("elastic_retention_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
@@ -2141,7 +2339,8 @@ def main():
         # RAY_TPU_BENCH_SERVE_QPS_FLOOR /
         # RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S /
         # RAY_TPU_BENCH_SHUFFLE_FLOOR_MB_PER_S /
-        # RAY_TPU_BENCH_FAILOVER_P95_S):
+        # RAY_TPU_BENCH_FAILOVER_P95_S /
+        # RAY_TPU_BENCH_ELASTIC_RETENTION_FLOOR):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

@@ -331,6 +331,51 @@ class InvariantChecker:
             count = self.arena_zombies()
         return count
 
+    def wait_gang_reshaped(
+        self, prekill_epochs: Dict[str, int], timeout: float
+    ) -> List[str]:
+        """Elastic-training invariant after a rank_node_kill: every gang
+        that had a member on the corpse either advances its epoch past
+        the pre-kill value AND re-registers a membership whose nodes are
+        all alive (the reshaped generation), or finishes and
+        unregisters. Reads ``cluster.head`` each poll — the head object
+        can be replaced by a failover mid-soak."""
+        deadline = time.monotonic() + timeout
+        failures: List[str] = []
+        while time.monotonic() < deadline:
+            head = self.cluster.head
+            with head._lock:
+                gangs = {
+                    gid: {
+                        "epoch": g["epoch"],
+                        "members": dict(g["members"]),
+                    }
+                    for gid, g in head._gangs.items()
+                }
+                alive = {
+                    nid for nid, n in head.nodes.items() if n.alive
+                }
+            failures = []
+            for gid, pre_epoch in prekill_epochs.items():
+                g = gangs.get(gid)
+                if g is None:
+                    continue  # finished + unregistered: converged
+                if g["epoch"] <= pre_epoch:
+                    failures.append(
+                        f"gang {gid}: epoch {g['epoch']} never advanced "
+                        f"past pre-kill {pre_epoch}"
+                    )
+                elif not set(g["members"].values()) <= alive:
+                    failures.append(
+                        f"gang {gid}: reshaped membership still names "
+                        f"dead node(s) "
+                        f"{sorted(set(g['members'].values()) - alive)}"
+                    )
+            if not failures:
+                return []
+            time.sleep(0.3)
+        return failures
+
     def check_durable_state(self, pre: Snapshot) -> List[str]:
         head = self.cluster.head
         failures: List[str] = []

@@ -33,6 +33,11 @@ injection. Fault kinds:
                        in-flight streams must fail over with no
                        duplicated/dropped acked tokens and the replica
                        set must backfill to its desired count.
+- ``rank_node_kill``  — SIGKILL a node hosting elastic training gang
+                       ranks (picked from the head's gang table); the
+                       gang must fence its epoch, reshape to the
+                       surviving topology, and resume from object-plane
+                       seals with no disk restore.
 
 Every fault records recovery latency = time from injection until all
 invariants are green again; the run result carries p50/p95 plus objects
@@ -349,6 +354,48 @@ class ChaosOrchestrator:
                 f"severed {reply.get('dropped', 0)} data socket(s) "
                 f"served by {nid}"
             )
+        if kind == "rank_node_kill":
+            # SIGKILL a node hosting elastic gang ranks, chosen from the
+            # head's gang table: the gang-epoch protocol must fence the
+            # dead generation, reshape to the surviving topology, and
+            # resume from object-plane seals (no disk restore)
+            head = self.cluster.head
+            with head._lock:
+                gangs = {
+                    gid: {
+                        "epoch": g["epoch"],
+                        "members": dict(g["members"]),
+                    }
+                    for gid, g in head._gangs.items()
+                }
+            live = set(self._live_nodes())
+            hosts = sorted(
+                {
+                    n
+                    for g in gangs.values()
+                    for n in g["members"].values()
+                    if n in live
+                }
+            )
+            if not hosts:
+                return "skipped: no live node hosts an elastic gang rank"
+            nid = hosts[spec.target % len(hosts)]
+            self._killed_gang_nodes = {
+                gid: g["epoch"]
+                for gid, g in gangs.items()
+                if nid in g["members"].values()
+            }
+            self.cluster.kill_node(nid)
+            # backfill so the gang can grow back during the soak
+            self.cluster.add_node(
+                dict(self.node_resources),
+                num_workers=self.workers_per_node,
+                wait=False,
+            )
+            return (
+                f"SIGKILLed rank node {nid} "
+                f"({len(self._killed_gang_nodes)} gang(s) fencing)"
+            )
         if kind == "zygote_kill":
             nid = self._pick_node(spec)
             if nid is None:
@@ -384,6 +431,7 @@ class ChaosOrchestrator:
                 self._dropped_hex: Optional[str] = None
                 self._killed_owner = None
                 self._killed_replica = None
+                self._killed_gang_nodes: Optional[Dict[str, int]] = None
                 self._head_killed = False
                 self._pre_kill_epoch = 0
                 detail = self._inject(spec)
@@ -446,6 +494,18 @@ class ChaosOrchestrator:
                         check.failures.extend(owner_fail)
                     # pre-warm the next sacrificial owner off the clock
                     self._spawn_owner_proc()
+                if self._killed_gang_nodes:
+                    # elastic-training invariant: every gang that had a
+                    # rank on the corpse advances its epoch (the dead
+                    # generation is fenced) and re-registers a healthy
+                    # membership — or finishes and unregisters
+                    gang_fail = self.checker.wait_gang_reshaped(
+                        self._killed_gang_nodes,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if gang_fail:
+                        check.ok = False
+                        check.failures.extend(gang_fail)
                 if self._killed_replica is not None:
                     # serving invariants: in-flight streams fail over or
                     # restart with no duplicated/dropped acked tokens,

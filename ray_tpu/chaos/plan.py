@@ -55,9 +55,20 @@ FAILOVER_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
     ("head_kill_promote", 1.0),
 )
 
+# elastic-training mix: adds rank_node_kill (SIGKILL a node HOSTING
+# elastic gang ranks, chosen from the head's gang table). The gang must
+# fence its epoch, reshape to the surviving topology, and resume from
+# object-plane seals — no disk restore. Not in DEFAULT_MIX for the same
+# seed-stability reason; plans that drive an elastic training workload
+# pass this mix.
+TRAIN_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
+    ("rank_node_kill", 2.0),
+)
+
 KINDS = tuple(k for k, _ in SERVE_MIX) + (
     "peer_conn_drop",
     "head_kill_promote",
+    "rank_node_kill",
 )
 
 

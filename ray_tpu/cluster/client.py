@@ -3065,12 +3065,73 @@ class RemoteRuntime:
     # placement groups
     # ------------------------------------------------------------------
     def create_placement_group(
-        self, bundles: List[Dict[str, float]], strategy: str = "PACK"
+        self,
+        bundles: List[Dict[str, float]],
+        strategy: str = "PACK",
+        avoid_nodes: Optional[List[str]] = None,
     ) -> str:
         reply = self.head.call(
-            "CreatePlacementGroup", {"bundles": bundles, "strategy": strategy}
+            "CreatePlacementGroup",
+            {
+                "bundles": bundles,
+                "strategy": strategy,
+                "avoid_nodes": list(avoid_nodes or ()),
+            },
         )
         return reply["pg_id"]
+
+    # ------------------------------------------------------------------
+    # elastic-training gang membership (train/elastic.py)
+    # ------------------------------------------------------------------
+    def gang_register(
+        self,
+        gang_id: str,
+        members: Dict[int, str],
+        min_size: int = 1,
+        epoch_floor: int = 0,
+    ) -> int:
+        # re-registration is the designed recovery path (monotone epoch
+        # + epoch_floor), so retrying through a head blip/failover is
+        # safe — and a zero-retry register right after placement would
+        # abort fit() on a transient, leaking the just-placed gang
+        reply = self.head.call(
+            "GangRegister",
+            {
+                "gang_id": gang_id,
+                "owner": self.client_id,
+                "members": {str(r): n for r, n in members.items()},
+                "min_size": min_size,
+                "epoch_floor": epoch_floor,
+            },
+            retries=8,
+            retry_interval=0.25,
+        )
+        return int(reply["epoch"])
+
+    def gang_sync(
+        self, gang_id: str, epoch: int, timeout: float = 0.0
+    ) -> dict:
+        return self._read(
+            "GangSync",
+            {"gang_id": gang_id, "epoch": epoch, "timeout": timeout},
+            timeout=timeout + 15.0,
+        )
+
+    def gang_fence(self, gang_id: str, reason: str = "fence") -> int:
+        reply = self.head.call(
+            "GangFence", {"gang_id": gang_id, "reason": reason}
+        )
+        return int(reply["epoch"])
+
+    def gang_unregister(self, gang_id: str) -> None:
+        self.head.call("GangUnregister", {"gang_id": gang_id})
+
+    def free_objects(self, hex_ids: List[str]) -> None:
+        """Force-free object-plane entries this process knows are dead
+        (elastic state generations past their retention window)."""
+        if not hex_ids:
+            return
+        self.head.call("FreeObjects", {"object_ids": list(hex_ids)})
 
     def wait_placement_group(self, pg_id: str, timeout: float = 30.0) -> List[str]:
         deadline = time.monotonic() + timeout

@@ -158,6 +158,15 @@ SCHED_PREEMPTIONS = _MetricCounter(
     "requeued attempt-free through the lineage machinery).",
     label_names=("kind",),
 )
+GANG_EPOCH_BUMPS = _MetricCounter(
+    "gang_epoch_bumps_total",
+    "Gang-epoch advances in the elastic-training membership protocol, "
+    "by cause (node_death = a member's node was declared dead by the "
+    "health loop; fence = owner-requested fence, e.g. resize/grow or "
+    "actor-level death observed driver-side; register = a new gang "
+    "generation registered its membership).",
+    label_names=("reason",),
+)
 
 
 def _shape_key_of(spec) -> tuple:
@@ -229,6 +238,11 @@ class _PGState:
     ready: threading.Event = field(default_factory=threading.Event)
     node_per_bundle: List[str] = field(default_factory=list)
     removed: bool = False
+    # soft anti-affinity (gang-aware reshape placement): prefer not to
+    # land bundles on these nodes — the kernel first runs with them
+    # masked out and falls back to the full cluster when the masked
+    # placement is infeasible
+    avoid_nodes: List[str] = field(default_factory=list)
 
 
 class HeadServer:
@@ -428,6 +442,15 @@ class HeadServer:
         # Ephemeral by design — a restarted head repopulates within one
         # report period.
         self._serve_state: Dict[tuple, dict] = {}
+        # elastic-training gang membership: gang_id -> {"epoch", "owner",
+        # "members" {rank -> node_id}, "min_size", "dead_ranks", "updated"}.
+        # The epoch is the fence for every gang collective — stragglers
+        # from a dead epoch are rejected at the rendezvous exactly like
+        # stale control RPCs at the cluster fence. Ephemeral like
+        # _serve_state: the owning driver re-registers (with an epoch
+        # floor) after a head failover, and re-registration itself bumps
+        # the epoch, so a pre-failover straggler can never pass the fence.
+        self._gangs: Dict[str, dict] = {}
 
         self._dispatch_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="head-dispatch"
@@ -474,6 +497,10 @@ class HeadServer:
                 k for k in self._kv if k.startswith(r.get("prefix", ""))
             ],
             "ClusterInfo": self._h_cluster_info,
+            "GangRegister": self._h_gang_register,
+            "GangSync": self._h_gang_sync,
+            "GangFence": self._h_gang_fence,
+            "GangUnregister": self._h_gang_unregister,
             "ReportServeState": self._h_report_serve_state,
             "QueryState": self._h_query_state,
             "StandbyHello": self._h_standby_hello,
@@ -1256,6 +1283,9 @@ class HeadServer:
             self._cond.notify_all()
         # peer data links touching the dead node: revoke + notify holders
         self._revoke_node_peer_links(node_id)
+        # elastic gangs with a member on the corpse: advance their epochs
+        # so the membership protocol fences the dead generation
+        self._gangs_note_node_death(node_id)
         # in-flight leases on the dead node: retry or fail
         requeued = set()
         for lid, spec in lost_leases:
@@ -4759,6 +4789,7 @@ class HeadServer:
             pg_id=req.get("pg_id") or new_id(),
             bundles=[dict(b) for b in req["bundles"]],
             strategy=req.get("strategy", "PACK"),
+            avoid_nodes=[str(n) for n in (req.get("avoid_nodes") or ())],
         )
         with self._cond:
             self._pgs[state.pg_id] = state
@@ -4816,9 +4847,26 @@ class HeadServer:
                 for b in state.bundles
             ]
         )
-        rows, success, _ = schedule_bundles(
-            totals, avail, alive, bundles, state.strategy
-        )
+        if state.avoid_nodes:
+            from ray_tpu.scheduler.bundles import (
+                schedule_bundles_soft_avoid,
+            )
+
+            # rows are resolved under a later lock window than the
+            # arrays snapshot (and a client-supplied node id can intern
+            # a fresh row past it) — the helper bounds-guards them
+            with self._lock:
+                rows_to_avoid = [
+                    self.view.row_if_known(n) for n in state.avoid_nodes
+                ]
+            rows, success, _ = schedule_bundles_soft_avoid(
+                totals, avail, alive, bundles, state.strategy,
+                rows_to_avoid,
+            )
+        else:
+            rows, success, _ = schedule_bundles(
+                totals, avail, alive, bundles, state.strategy
+            )
         if not success:
             return False
         chosen = [self.view.node_id(int(r)) for r in rows]
@@ -4958,6 +5006,120 @@ class HeadServer:
                 )
         return {"nodes": nodes, "metrics": dict(self.metrics)}
 
+    # ------------------------------------------------------------------
+    # elastic-training gang membership (train/elastic.py rides these).
+    # The head is the epoch AUTHORITY: the health loop's node-death
+    # verdict bumps every gang with a member on the corpse, the owning
+    # driver mirrors the epoch into the gang's rendezvous hub, and any
+    # collective contribution stamped with a dead epoch is rejected at
+    # the hub exactly like a stale control RPC at the cluster fence.
+    # ------------------------------------------------------------------
+    def _h_gang_register(self, req: dict) -> dict:
+        gid = req["gang_id"]
+        members = {int(r): str(n) for r, n in (req.get("members") or {}).items()}
+        with self._cond:
+            prev = self._gangs.get(gid)
+            # monotone across generations AND head failovers: the owner
+            # passes the last epoch it saw as a floor after re-connecting
+            # to a promoted head that lost the (ephemeral) gang table
+            floor = max(
+                int(req.get("epoch_floor", 0)),
+                prev["epoch"] if prev else 0,
+            )
+            epoch = floor + 1
+            self._gangs[gid] = {
+                "epoch": epoch,
+                "owner": str(req.get("owner", "")),
+                "members": members,
+                "min_size": int(req.get("min_size", 1)),
+                "dead_ranks": [],
+                "updated": time.monotonic(),
+            }
+            self._cond.notify_all()
+        GANG_EPOCH_BUMPS.inc(labels={"reason": "register"})
+        return {"epoch": epoch}
+
+    def _h_gang_sync(self, req: dict) -> dict:
+        """Long-poll the gang's membership epoch: returns immediately
+        when the head's epoch differs from the caller's, else parks up
+        to min(timeout, cfg.gang_sync_max_wait_s) on the head cond (the
+        node-death bump notifies it)."""
+        gid = req["gang_id"]
+        known = int(req.get("epoch", -1))
+        wait_s = min(
+            float(req.get("timeout", 0.0)), float(cfg.gang_sync_max_wait_s)
+        )
+        deadline = time.monotonic() + max(0.0, wait_s)
+        with self._cond:
+            while True:
+                g = self._gangs.get(gid)
+                if g is None:
+                    return {"epoch": 0, "members": {}, "dead_ranks": []}
+                now = time.monotonic()
+                if g["epoch"] != known or now >= deadline or self._shutdown:
+                    return {
+                        "epoch": g["epoch"],
+                        "members": {
+                            str(r): n for r, n in g["members"].items()
+                        },
+                        "dead_ranks": list(g["dead_ranks"]),
+                    }
+                self._cond.wait(timeout=min(1.0, deadline - now))
+
+    def _h_gang_fence(self, req: dict) -> dict:
+        """Owner-requested epoch bump: resize/grow decisions and actor-
+        level deaths the driver observed before the health loop did."""
+        gid = req["gang_id"]
+        with self._cond:
+            g = self._gangs.get(gid)
+            if g is None:
+                return {"epoch": 0}
+            g["epoch"] += 1
+            g["updated"] = time.monotonic()
+            epoch = g["epoch"]
+            self._cond.notify_all()
+        GANG_EPOCH_BUMPS.inc(
+            labels={"reason": str(req.get("reason", "fence"))}
+        )
+        return {"epoch": epoch}
+
+    def _h_gang_unregister(self, req: dict) -> None:
+        with self._cond:
+            self._gangs.pop(req["gang_id"], None)
+            self._cond.notify_all()
+
+    def _gangs_note_node_death(self, node_id: str) -> None:
+        """Health-loop feed into the membership protocol: any gang with
+        a member on the dead node advances its epoch, so in-flight
+        collectives of the dead generation are rejected as stale the
+        moment the owner (or any rank) next touches the hub."""
+        bumped = []
+        with self._cond:
+            for gid, g in self._gangs.items():
+                dead = [
+                    r for r, n in g["members"].items() if n == node_id
+                ]
+                if not dead:
+                    continue
+                g["epoch"] += 1
+                g["updated"] = time.monotonic()
+                seen = set(g["dead_ranks"])
+                g["dead_ranks"].extend(
+                    r for r in dead if r not in seen
+                )
+                bumped.append((gid, g["epoch"], dead))
+            if bumped:
+                self._cond.notify_all()
+        for gid, epoch, dead in bumped:
+            GANG_EPOCH_BUMPS.inc(labels={"reason": "node_death"})
+            logger.warning(
+                "gang %s: node %s died with rank(s) %s; epoch -> %d",
+                gid,
+                node_id,
+                dead,
+                epoch,
+            )
+
     def _h_report_serve_state(self, req: dict) -> dict:
         with self._lock:
             self._serve_state[
@@ -4999,6 +5161,21 @@ class HeadServer:
                 },
                 "transfer_stripe_ms": TRANSFER_STRIPE_MS.summary(),
             }
+        if kind == "gangs":
+            # elastic-training membership: epoch + member map per gang
+            with self._lock:
+                return {
+                    gid: {
+                        "epoch": g["epoch"],
+                        "owner": g["owner"],
+                        "members": {
+                            str(r): n for r, n in g["members"].items()
+                        },
+                        "min_size": g["min_size"],
+                        "dead_ranks": list(g["dead_ranks"]),
+                    }
+                    for gid, g in self._gangs.items()
+                }
         if kind == "replication":
             # replicated control plane: role, shipping stream position,
             # per-standby follower lag, owner-shard occupancy, pending

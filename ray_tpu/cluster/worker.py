@@ -1945,6 +1945,49 @@ def run_worker(agent_address: str, worker_id: str, store_path: str) -> None:
     worker.serve_forever()
 
 
+def seal_local_value(value: Any, owner: str = "") -> Optional[str]:
+    """Arena-direct object seal from INSIDE a cluster worker: one
+    pickle-5 gather into the node's shm arena (PR 13's ndarray seal
+    path — numpy leaves scatter-write as out-of-band frames), the
+    SealInfo rides the worker's existing direct-seal batch to the agent
+    and from there to the head's object directory. ``owner`` (a driver
+    client id) is registered as the holder, so the object fate-shares
+    with that driver and stays alive until it frees the generation.
+
+    Returns the new object's hex id, or None when not running inside a
+    cluster worker (callers fall back to ``ray_tpu.put``). Used by the
+    elastic-training state plane to seal param/optimizer shards without
+    a head RPC on the data path.
+    """
+    import dataclasses as _dc
+
+    w = _CURRENT_WORKER
+    if w is None or w.store is None:
+        return None
+    from ray_tpu._ids import rand_hex
+
+    hex_id = rand_hex(14)
+    seal = w.put_value(hex_id, value)
+    if owner:
+        seal = _dc.replace(seal, owner=owner)
+    with w._direct_seal_cv:
+        w._direct_seals.append(seal)
+        w._direct_seal_cv.notify_all()
+    return hex_id
+
+
+def fetch_into_local_arena(hex_id: str, timeout: float = 60.0) -> Any:
+    """Pull ``hex_id`` through THIS worker's agent so a copy lands in
+    the local arena and the head directory gains a second location
+    (buddy replication for elastic state shards; the pull itself rides
+    the socket plane / chunked fallback like any located fetch).
+    Returns the deserialized value. Raises when not inside a worker."""
+    w = _CURRENT_WORKER
+    if w is None:
+        raise RuntimeError("fetch_into_local_arena: not inside a worker")
+    return w.get_object(hex_id, timeout=timeout)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--agent", required=True)

@@ -971,3 +971,52 @@ define(
     "by train dataset shards; Dataset.iter_batches defaults to 0 "
     "(off) unless prefetch_batches is passed.",
 )
+define(
+    "elastic_seal_interval_steps",
+    10,
+    "Elastic training: every N completed steps each rank seals its "
+    "param/optimizer state shard into the shm object plane (arena-"
+    "direct pickle-5 frames) as the checkpoint-free recovery point for "
+    "ranks that later die with their node. 0 disables periodic seals "
+    "(break-time seals still happen).",
+)
+define(
+    "elastic_buddy_replicate",
+    True,
+    "Elastic training: after a periodic state seal, the rank's buddy "
+    "(next rank, usually another node) pulls the sealed object through "
+    "its agent so the object directory holds a second arena copy — a "
+    "single node death can never lose a state shard. Rides the PR 11 "
+    "socket plane like any located pull.",
+)
+define(
+    "elastic_grow_poll_s",
+    1.0,
+    "Elastic training: driver-side capacity poll period. When the gang "
+    "runs below its target world size and the cluster again advertises "
+    "enough free capacity, the driver fences the gang and grows the "
+    "mesh back.",
+)
+define(
+    "elastic_hub_timeout_s",
+    60.0,
+    "Elastic training: per-collective rendezvous timeout at the gang "
+    "hub. A rank parked past this raises and treats the op as revoked "
+    "(the gang-epoch protocol decides whether it really was).",
+)
+define(
+    "elastic_place_wait_s",
+    15.0,
+    "Elastic training: per-attempt placement-group wait when placing a "
+    "gang generation. Short on purpose — an over-optimistic world size "
+    "(e.g. the head has not yet declared a corpse dead) must fail fast "
+    "into the shrink-to-what-fits retry path instead of parking the "
+    "whole gang.",
+)
+define(
+    "gang_sync_max_wait_s",
+    20.0,
+    "Head-side cap on one GangSync long-poll window; drivers re-arm "
+    "the poll, so detection latency is governed by the health loop, "
+    "not this cap.",
+)

@@ -33,11 +33,12 @@ class PlacementGroupState:
     """Head-side PG record + per-bundle reserved-resource ledgers."""
 
     def __init__(self, runtime, bundles: List[Dict[str, float]], strategy: str,
-                 name: str = ""):
+                 name: str = "", avoid_nodes: Optional[List[str]] = None):
         self.runtime = runtime
         self.id = uuid.uuid4().hex[:16]
         self.name = name
         self.strategy = strategy
+        self.avoid_nodes = [str(n) for n in (avoid_nodes or ())]
         self.bundle_specs = [dict(b) for b in bundles]
         self.bundles = [
             _Bundle(ResourceRequest.from_map(runtime.vocab, b)) for b in bundles
@@ -67,9 +68,19 @@ class PlacementGroupState:
                 np.stack([b.request.dense(width) for b in self.bundles]),
             )
         mat = self._dense[1]
-        nodes_idx, success, _ = schedule_bundles(
-            totals, avail, alive, mat, strategy=self.strategy
-        )
+        if self.avoid_nodes:
+            from ray_tpu.scheduler.bundles import (
+                schedule_bundles_soft_avoid,
+            )
+
+            nodes_idx, success, _ = schedule_bundles_soft_avoid(
+                totals, avail, alive, mat, self.strategy,
+                [rt.view.row_if_known(n) for n in self.avoid_nodes],
+            )
+        else:
+            nodes_idx, success, _ = schedule_bundles(
+                totals, avail, alive, mat, strategy=self.strategy
+            )
         if not success:
             return False
         chosen = [rt.view.node_id(int(r)) for r in nodes_idx]
@@ -178,16 +189,26 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
+    avoid_nodes: Optional[List[str]] = None,
 ):
+    """``avoid_nodes`` is a SOFT anti-affinity list (gang-aware reshape
+    placement): the bundle kernels first run with those nodes masked out
+    and fall back to the full cluster when the masked placement is
+    infeasible — an elastic gang avoiding a flapping node must never
+    park behind the preference."""
     from .runtime import get_runtime
 
     rt = get_runtime()
     if getattr(rt, "is_remote", False):
         from ray_tpu.cluster.client import RemotePlacementGroup
 
-        pg_id = rt.create_placement_group(list(bundles), strategy)
+        pg_id = rt.create_placement_group(
+            list(bundles), strategy, avoid_nodes=avoid_nodes
+        )
         return RemotePlacementGroup(pg_id, list(bundles), strategy)
-    state = PlacementGroupState(rt, bundles, strategy, name=name)
+    state = PlacementGroupState(
+        rt, bundles, strategy, name=name, avoid_nodes=avoid_nodes
+    )
     rt.register_pg(state)
     return PlacementGroup(state)
 

@@ -179,12 +179,48 @@ def strict_pack_bundles(
     return PackResult(nodes, ok, avail_out)
 
 
+def rows_to_avoid_mask(rows, alive) -> "np.ndarray | None":
+    """Bool mask over the node axis from a list of row indices (None /
+    out-of-range entries dropped — callers resolve rows from node ids
+    against a snapshot that may be narrower than the live view). Returns
+    None when nothing survives, so callers can skip the masked pass."""
+    width = int(np.shape(alive)[0])
+    rows = [
+        int(r) for r in rows if r is not None and 0 <= int(r) < width
+    ]
+    if not rows:
+        return None
+    mask = np.zeros(width, dtype=bool)
+    mask[np.asarray(rows)] = True
+    return mask
+
+
+def schedule_bundles_soft_avoid(
+    totals, avail, alive, bundles, strategy, avoid_rows
+):
+    """SOFT anti-affinity placement (gang-aware reshape): first run the
+    kernels with ``avoid_rows`` masked dead, then fall back to the full
+    cluster when the masked placement is infeasible — a gang avoiding a
+    flapping node must never park behind the preference. The one home
+    of this policy; both the head PG path and the local runtime call
+    it."""
+    avoid = rows_to_avoid_mask(avoid_rows, alive)
+    if avoid is not None:
+        rows, success, left = schedule_bundles(
+            totals, avail, alive, bundles, strategy, avoid=avoid
+        )
+        if success:
+            return rows, success, left
+    return schedule_bundles(totals, avail, alive, bundles, strategy)
+
+
 def schedule_bundles(
     totals,
     avail,
     alive,
     bundles: np.ndarray,
     strategy: str = "PACK",
+    avoid: "np.ndarray | None" = None,
 ):
     """Host entry point: sort, pad, dispatch to the strategy kernel, unsort.
 
@@ -205,6 +241,15 @@ def schedule_bundles(
     b = bundles.shape[0]
     if b == 0:
         return np.zeros(0, dtype=np.int32), True, avail
+    if avoid is not None:
+        # anti-affinity mask (gang-aware reshape placement): avoided rows
+        # enter the kernels as dead — they score -inf/-1 and can never
+        # host a bundle. Callers wanting SOFT avoidance re-run without
+        # the mask on failure; the kernels themselves stay oblivious.
+        alive = jnp.logical_and(
+            jnp.asarray(alive, dtype=bool),
+            jnp.logical_not(jnp.asarray(avoid, dtype=bool)),
+        )
     order = sort_bundles(bundles)
     sorted_host = bundles[order]
     padded = 1 << max(0, (b - 1).bit_length())

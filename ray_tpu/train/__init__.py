@@ -22,3 +22,11 @@ from .trainer import (  # noqa: F401
     RunConfig,
     ScalingConfig,
 )
+from .elastic import (  # noqa: F401
+    ElasticConfig,
+    ElasticTrainer,
+    GangContext,
+    GangEpochRevoked,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+)

@@ -40,27 +40,54 @@ class Checkpoint:
     # -- pytree helpers (TPU-first: params are jax/numpy pytrees) -------
     @classmethod
     def from_state(cls, state: Dict[str, Any], path: str) -> "Checkpoint":
-        """Persist a {name: pytree-or-json-able} dict as a checkpoint dir."""
+        """Persist a {name: pytree-or-json-able} dict as a checkpoint dir.
+
+        ATOMIC: everything lands in a sibling temp dir first and
+        ``os.replace``s into place, with ``checkpoint_meta.json``
+        written last as the commit marker — a crash mid-write leaves
+        either the old complete checkpoint or a ``.tmp-*`` orphan,
+        never a half-written directory a restore could pick up."""
         import jax
 
-        os.makedirs(path, exist_ok=True)
-        meta: Dict[str, str] = {}
-        for name, value in state.items():
-            if _is_pytree_of_arrays(value):
-                leaves, treedef = jax.tree.flatten(value)
-                np.savez(
-                    os.path.join(path, f"{name}.npz"),
-                    **{str(i): np.asarray(x) for i, x in enumerate(leaves)},
-                )
-                with open(os.path.join(path, f"{name}.treedef.pkl"), "wb") as f:
-                    pickle.dump(treedef, f)
-                meta[name] = "pytree"
-            else:
-                with open(os.path.join(path, f"{name}.pkl"), "wb") as f:
-                    pickle.dump(value, f)
-                meta[name] = "pickle"
-        with open(os.path.join(path, "checkpoint_meta.json"), "w") as f:
-            json.dump(meta, f)
+        path = os.path.abspath(path)
+        parent = os.path.dirname(path) or "."
+        os.makedirs(parent, exist_ok=True)
+        tmp = tempfile.mkdtemp(
+            prefix=os.path.basename(path) + ".tmp-", dir=parent
+        )
+        try:
+            meta: Dict[str, str] = {}
+            for name, value in state.items():
+                if _is_pytree_of_arrays(value):
+                    leaves, treedef = jax.tree.flatten(value)
+                    np.savez(
+                        os.path.join(tmp, f"{name}.npz"),
+                        **{
+                            str(i): np.asarray(x)
+                            for i, x in enumerate(leaves)
+                        },
+                    )
+                    with open(
+                        os.path.join(tmp, f"{name}.treedef.pkl"), "wb"
+                    ) as f:
+                        pickle.dump(treedef, f)
+                    meta[name] = "pytree"
+                else:
+                    with open(os.path.join(tmp, f"{name}.pkl"), "wb") as f:
+                        pickle.dump(value, f)
+                    meta[name] = "pickle"
+            with open(os.path.join(tmp, "checkpoint_meta.json"), "w") as f:
+                json.dump(meta, f)
+            try:
+                os.replace(tmp, path)
+            except OSError:
+                # target exists non-empty (caller overwrites a previous
+                # checkpoint at the same path): drop it, then swap
+                shutil.rmtree(path, ignore_errors=True)
+                os.replace(tmp, path)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
         return cls(path)
 
     def load_state(self) -> Dict[str, Any]:

@@ -54,6 +54,9 @@ class TrainContext:
     experiment_name: str = ""
     trial_dir: str = ""
     latest_checkpoint: Optional[Checkpoint] = None
+    # elastic runs: this rank's GangContext (epoch-fenced collectives
+    # over the virtual-shard grid); None under the legacy JaxTrainer
+    gang: Optional[Any] = None
     # per-rank dataset shards (JaxTrainer datasets=), wrapped as
     # DataIterators at access time
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
@@ -72,6 +75,13 @@ class TrainContext:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint
+
+    def get_gang(self) -> Any:
+        if self.gang is None:
+            raise RuntimeError(
+                "no gang context (not an elastic run; use ElasticTrainer)"
+            )
+        return self.gang
 
     def get_dataset_shard(self, name: str = "train") -> DataIterator:
         ds = self.dataset_shards.get(name)

@@ -40,6 +40,8 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
+    # ray parity: -1 means retry forever (elastic/chaos runs where the
+    # cluster is expected to heal); 0 means fail on the first error
     max_failures: int = 0
 
 
@@ -63,6 +65,34 @@ class Result:
     path: str
     error: Optional[BaseException] = None
     metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def kill_actors_bounded(actors, deadline_s: float) -> None:
+    """Best-effort parallel kill of a worker group under ONE wall-clock
+    deadline. Runs on daemon threads, not a pool: a kill RPC that
+    wedges past the deadline is simply abandoned — a daemon thread
+    can't block interpreter exit, and an infinite-retry trainer doesn't
+    accrue stuck pool threads across attempts."""
+    import threading
+
+    def _kill(w):
+        try:
+            ray_tpu.kill(w)
+        except Exception:  # noqa: BLE001
+            pass
+
+    threads = [
+        threading.Thread(
+            target=_kill, args=(w,), daemon=True,
+            name="train-teardown-kill",
+        )
+        for w in actors
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + float(deadline_s)
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 @ray_tpu.remote
@@ -133,7 +163,15 @@ class JaxTrainer:
         # train step)
         self.datasets = dict(datasets or {})
 
+    # retry backoff bounds (class attrs so tests can shrink them):
+    # decorrelated jitter keeps a persistently-unschedulable placement
+    # group from hot-looping create/remove against the head
+    RETRY_BACKOFF_BASE_S = 0.2
+    RETRY_BACKOFF_CAP_S = 10.0
+
     def fit(self) -> Result:
+        import random
+
         name = self.run_config.name or f"train-{uuid.uuid4().hex[:6]}"
         storage = self.run_config.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results"
@@ -144,6 +182,8 @@ class JaxTrainer:
         max_failures = self.run_config.failure_config.max_failures
         restore_path: Optional[str] = None
         attempt = 0
+        rng = random.Random()
+        sleep_s = self.RETRY_BACKOFF_BASE_S
         while True:
             try:
                 reports = self._run_attempt(name, trial_dir, restore_path)
@@ -151,7 +191,8 @@ class JaxTrainer:
             except Exception as exc:  # noqa: BLE001
                 attempt += 1
                 restore_path = self._latest_checkpoint_path(trial_dir)
-                if attempt > max_failures:
+                # max_failures=-1: infinite retries (ray parity)
+                if 0 <= max_failures < attempt:
                     return Result(
                         metrics={},
                         checkpoint=(
@@ -160,6 +201,21 @@ class JaxTrainer:
                         path=trial_dir,
                         error=exc,
                     )
+                # decorrelated jitter (AWS backoff family): sleep in
+                # [base, 3*prev], capped — retries de-phase instead of
+                # hammering an unschedulable PG in lockstep
+                sleep_s = min(
+                    self.RETRY_BACKOFF_CAP_S,
+                    rng.uniform(
+                        self.RETRY_BACKOFF_BASE_S, sleep_s * 3.0
+                    ),
+                )
+                time.sleep(sleep_s)
+
+    # teardown budget for killing the gang: a kill RPC against a node
+    # that died mid-attempt can wedge past its transport retries — the
+    # bundle reservation must not leak behind a hung finally
+    TEARDOWN_KILL_DEADLINE_S = 10.0
 
     # -- internals ------------------------------------------------------
     def _run_attempt(self, name, trial_dir, restore_path):
@@ -168,20 +224,23 @@ class JaxTrainer:
         pg = ray_tpu.placement_group(
             [dict(res)] * n, strategy=self.scaling.placement_strategy
         )
-        if not pg.wait(timeout_seconds=30):
-            raise TimeoutError(
-                f"placement group for {n} workers x {res} not schedulable"
-            )
-        # one shard per rank, split ONCE per attempt: blocks become
-        # ObjectRefs here (pending ops execute through the streaming
-        # shuffle plane) and only the refs ship to the workers — each
-        # rank pulls its own shard's blocks over the object plane as its
-        # prefetching iterator reaches them
-        shard_lists = {
-            dname: ds.split(n) for dname, ds in self.datasets.items()
-        }
         workers = []
         try:
+            if not pg.wait(timeout_seconds=30):
+                # raise INSIDE the try: the finally below removes the
+                # pending PG — before this, an unschedulable attempt
+                # leaked one parked reservation per retry
+                raise TimeoutError(
+                    f"placement group for {n} workers x {res} not schedulable"
+                )
+            # one shard per rank, split ONCE per attempt: blocks become
+            # ObjectRefs here (pending ops execute through the streaming
+            # shuffle plane) and only the refs ship to the workers — each
+            # rank pulls its own shard's blocks over the object plane as
+            # its prefetching iterator reaches them
+            shard_lists = {
+                dname: ds.split(n) for dname, ds in self.datasets.items()
+            }
             workers = [
                 _TrainWorker.options(
                     scheduling_strategy=PlacementGroupSchedulingStrategy(
@@ -206,27 +265,49 @@ class JaxTrainer:
             reports_per_rank = ray_tpu.get(refs)
             return reports_per_rank[0]  # rank-0 reports are authoritative
         finally:
-            for w in workers:
-                try:
-                    ray_tpu.kill(w)
-                except Exception:  # noqa: BLE001
-                    pass
-            ray_tpu.remove_placement_group(pg)
+            self._teardown(workers, pg)
 
-    def _latest_checkpoint_path(self, trial_dir: str) -> Optional[str]:
+    def _teardown(self, workers, pg) -> None:
+        """Bounded gang teardown: kills run concurrently under one
+        deadline (a kill against a dead node can hang on transport
+        retries), and the placement group is removed REGARDLESS — a
+        wedged kill must not leak the whole bundle reservation."""
+        kill_actors_bounded(workers, self.TEARDOWN_KILL_DEADLINE_S)
+        try:
+            ray_tpu.remove_placement_group(pg)
+        except Exception:  # noqa: BLE001 - head blip; lease sweeps cover
+            pass
+
+    @staticmethod
+    def _latest_checkpoint_path(trial_dir: str) -> Optional[str]:
+        # Only COMPLETE checkpoints count: from_state writes
+        # checkpoint_meta.json last (inside its temp dir, atomically
+        # renamed into place), so its presence is the commit marker — a
+        # crash mid-write must not leave a half-written directory the
+        # retry loop happily restores from.
+        def _complete(path: str) -> bool:
+            return os.path.isdir(path) and os.path.isfile(
+                os.path.join(path, "checkpoint_meta.json")
+            )
+
         # 1. durable pointer written by train.report (works for checkpoint
         # dirs outside trial_dir too)
         pointer = os.path.join(trial_dir, "_latest_checkpoint")
         if os.path.isfile(pointer):
             with open(pointer) as f:
                 path = f.read().strip()
-            if os.path.isdir(path):
+            if _complete(path):
                 return path
-        # 2. fall back to the checkpoint_* naming convention inside trial_dir
+        # 2. fall back to the checkpoint_* naming convention inside
+        # trial_dir, newest COMPLETE directory wins
         ckpts = sorted(
             d for d in os.listdir(trial_dir) if d.startswith("checkpoint_")
         ) if os.path.isdir(trial_dir) else []
-        return os.path.join(trial_dir, ckpts[-1]) if ckpts else None
+        for d in reversed(ckpts):
+            path = os.path.join(trial_dir, d)
+            if _complete(path):
+                return path
+        return None
 
     def _build_result(self, trial_dir, reports) -> Result:
         metrics = reports[-1]["metrics"] if reports else {}
