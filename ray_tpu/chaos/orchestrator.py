@@ -438,6 +438,17 @@ class ChaosOrchestrator:
                 logger.info(
                     "chaos #%d %s: %s", spec.index, spec.kind, detail
                 )
+                if not self._head_killed:
+                    # flight recorder (ISSUE 15): snapshot the head's
+                    # events/spans/metrics while the fault is fresh
+                    # (head faults dump from the promotion path instead
+                    # — this head is the corpse)
+                    try:
+                        self.cluster.head._dump_crash_bundle(
+                            f"chaos-{spec.kind}"
+                        )
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
                 promote_failures: List[str] = []
                 if self._head_killed:
                     # the promotion must land BEFORE the generic

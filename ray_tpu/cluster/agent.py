@@ -290,6 +290,15 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 - hygiene, never fatal
             logger.debug("orphan endpoint sweep failed", exc_info=True)
         try:
+            # and for dark-plane counter pages (native/counters.py)
+            from ray_tpu.native.counters import sweep_orphan_counters
+
+            swept = sweep_orphan_counters()
+            if swept:
+                logger.info("swept %d orphaned counter pages", swept)
+        except Exception:  # noqa: BLE001 - hygiene, never fatal
+            logger.debug("orphan counter sweep failed", exc_info=True)
+        try:
             from ray_tpu.native import NativeObjectStore
 
             inner = NativeObjectStore(
@@ -499,6 +508,17 @@ class NodeAgent:
                 name="agent-memmon",
                 daemon=True,
             ).start()
+
+        # metrics federation (ISSUE 15): this agent's registry ships as
+        # typed deltas on the coalesced head report at
+        # cfg.metrics_interval_s cadence; workers' deltas (relayed via
+        # WorkerSealed) queue here pre-labeled and ride the same report
+        from ray_tpu.util.metrics import DeltaExporter
+
+        self._metric_exporter = DeltaExporter()
+        self._metric_lock = threading.Lock()
+        self._worker_metric_relays: List[Dict[str, Any]] = []
+        self._metrics_last_ship = 0.0
 
         # coalescing completion/seal reporter (see _reporter_loop)
         self._report_queue: List[Dict[str, Any]] = []
@@ -1896,7 +1916,17 @@ class NodeAgent:
 
     def _h_worker_sealed(self, req: dict) -> None:
         """Out-of-band seal from a worker (ray_tpu.put inside a task,
-        async-actor results, streaming-generator items)."""
+        async-actor results, streaming-generator items). Worker registry
+        deltas piggyback here (the seal channel IS the worker's metrics
+        uplink): they queue pre-labeled and ride the agent's next
+        metrics ship instead of triggering a head report of their own."""
+        if req.get("metrics"):
+            with self._metric_lock:
+                self._worker_metric_relays.extend(req["metrics"])
+        if not (
+            req["seals"] or req.get("stream") or req.get("stream_done")
+        ):
+            return  # metrics-only push
         self._note_seals(req["seals"])
         report = {"node_id": self.node_id, "seals": req["seals"]}
         for k in ("stream", "stream_done"):
@@ -2340,6 +2370,53 @@ class NodeAgent:
                     self._report_queue.insert(0, report)
                 time.sleep(0.5)
 
+    def _ship_metrics(self) -> None:
+        """Metrics federation tick (report-loop cadence, interval-gated):
+        sync the dark-plane accumulators into this process's registry,
+        collect its typed deltas, and send them — plus any relayed
+        worker deltas — to the head on the coalesced report channel."""
+        now = time.monotonic()
+        if now - self._metrics_last_ship < cfg.metrics_interval_s:
+            return
+        self._metrics_last_ship = now
+        from ray_tpu.util.metrics import sync_gauge
+
+        from .event_loop import publish_dark_plane
+
+        publish_dark_plane()
+        try:
+            st = self.store.stats()
+            sync_gauge(
+                "arena_used_bytes",
+                float(st.get("used", 0)),
+                "Shm arena bytes in use on this node.",
+            )
+            sync_gauge(
+                "arena_capacity_bytes",
+                float(st.get("capacity", 0)),
+                "Shm arena capacity on this node.",
+            )
+        except Exception:  # noqa: BLE001 - store stats are optional
+            pass
+        records = self._metric_exporter.collect()
+        with self._metric_lock:
+            relays = self._worker_metric_relays
+            self._worker_metric_relays = []
+        entries: List[Dict[str, Any]] = []
+        if records:
+            entries.append(
+                {
+                    "node": self.node_id,
+                    "role": "agent",
+                    "records": records,
+                }
+            )
+        entries.extend(relays)
+        if entries:
+            self._report_to_head(
+                {"node_id": self.node_id, "metrics": entries}
+            )
+
     def _re_register(self) -> None:
         """Resync with a restarted head: RegisterNode is fence-exempt by
         design, re-attaches this node's actors/store inventory/held
@@ -2442,6 +2519,11 @@ class NodeAgent:
                 ]
             for h in dead:
                 self._on_worker_death(h, [])
+            if cfg.metrics_federation:
+                try:
+                    self._ship_metrics()
+                except Exception:  # noqa: BLE001 - never skip a beat
+                    logger.debug("metrics ship failed", exc_info=True)
             try:
                 reply = self.head.call(
                     "NodeReport",

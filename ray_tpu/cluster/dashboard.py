@@ -175,20 +175,15 @@ class Dashboard:
         return web.Response(text=logs, content_type="text/plain")
 
     async def _metrics(self, request) -> web.Response:
-        """Prometheus text exposition (metrics-agent scrape analog)."""
-        lines = []
-        info = self.head._h_cluster_info(None)
-        for name, value in info["metrics"].items():
-            lines.append(f"# TYPE ray_tpu_{name} counter")
-            lines.append(f"ray_tpu_{name} {value}")
-        alive = sum(1 for n in info["nodes"] if n["Alive"])
-        lines.append("# TYPE ray_tpu_nodes_alive gauge")
-        lines.append(f"ray_tpu_nodes_alive {alive}")
-        for n in info["nodes"]:
-            nid = n["NodeID"]
-            for res, avail in (n["Available"] or {}).items():
-                safe = res.replace("-", "_").replace(".", "_").replace("/", "_")
-                lines.append(
-                    f'ray_tpu_node_available{{node="{nid}",resource="{safe}"}} {avail}'
-                )
-        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+        """Prometheus text exposition: the head's federated registry —
+        typed HELP/TYPE, histograms with buckets, every sample labeled
+        node/role, agents' and workers' shipped deltas included. (The
+        old handler hand-rolled ~a dozen head counters as ``# TYPE ...
+        counter`` lines, mislabeling gauges and dropping every
+        histogram.) Rendering does one cluster-info pass plus a registry
+        walk — off the event loop like the node-debug proxy."""
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None, self.head.metrics_text)
+        return web.Response(
+            text=body, content_type="text/plain"
+        )

@@ -48,6 +48,43 @@ def loop_stats() -> List[dict]:
     return [lp.stats() for lp in list(_LOOPS)]
 
 
+def publish_dark_plane() -> None:
+    """Sync every dark-plane accumulator (plain-int wire counters, the
+    shm counter page shared with wire.cc/net.cc, compiled-pipeline
+    slots, ring fill levels) into the typed metrics registry. Called
+    from observability ticks — agent report loop, head scrape, DebugState
+    — never from a hot path; from there the federation ships them to the
+    head scrape."""
+    from ray_tpu.cluster import serialization as wire_mod
+
+    wire_mod.publish_wire_metrics()
+    try:
+        from ray_tpu.native import counters as dark
+
+        dark.publish()
+    except Exception:  # noqa: BLE001 - counters are optional
+        pass
+    try:
+        from ray_tpu.dag.channel import ring_stats
+        from ray_tpu.util.metrics import sync_gauge
+
+        fills = ring_stats()
+        if fills:
+            sync_gauge(
+                "pipeline_ring_used_bytes",
+                float(sum(r["used"] for r in fills)),
+                "Bytes currently occupying this process's open shm rings.",
+            )
+            sync_gauge(
+                "pipeline_ring_fill_max",
+                float(max(r["fill"] for r in fills)),
+                "Highest fill fraction across this process's open shm "
+                "rings at the last observability tick.",
+            )
+    except Exception:  # noqa: BLE001 - toolchain missing
+        pass
+
+
 def hotpath_state() -> dict:
     """One self-describing snapshot of this PROCESS's execution-plane hot
     path: framing-path selection + counters, fused-event-loop occupancy
@@ -62,6 +99,12 @@ def hotpath_state() -> dict:
         "wire": wire_mod.publish_wire_metrics(),
         "event_loops": loop_stats(),
     }
+    try:
+        from ray_tpu.native import counters as dark
+
+        state["dark_counters"] = dark.publish()
+    except Exception:  # noqa: BLE001 - counters are optional
+        state["dark_counters"] = {}
     try:
         from ray_tpu.dag.channel import ring_stats
 

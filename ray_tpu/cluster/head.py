@@ -19,7 +19,7 @@ import os
 import pickle
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -451,6 +451,30 @@ class HeadServer:
         # floor) after a head failover, and re-registration itself bumps
         # the epoch, so a pre-failover straggler can never pass the fence.
         self._gangs: Dict[str, dict] = {}
+        # metrics federation (ISSUE 15): typed registry deltas shipped by
+        # agents (their workers' relayed through them) merge here,
+        # namespaced by node/role labels; the dashboard /metrics scrape
+        # renders this plus the head's own registry. Ephemeral like
+        # _serve_state: senders keep shipping deltas to whichever head
+        # is leading, so a restarted head's accumulation restarts at the
+        # fault boundary (counters are since-head-start, documented).
+        from ray_tpu.util.metrics import FederatedRegistry
+        from ray_tpu.util.metrics import Gauge as _MetricGauge
+
+        self.federation = FederatedRegistry()
+        # created eagerly: a lazy first-scrape construction would race
+        # the dashboard executor against the crash-bundle pool, and a
+        # loser's instance could shadow the registry slot forever
+        self._node_avail_gauge = _MetricGauge(
+            "ray_tpu_node_available",
+            "Per-node available resource quantity.",
+            ("node", "resource"),
+        )
+        # scheduler decision attribution: task_id -> explanation (the
+        # five per-term cost contributions of the winning placement),
+        # bounded FIFO (cfg.sched_explain_keep)
+        self._explain: "OrderedDict[str, dict]" = OrderedDict()
+        self._explain_lock = threading.Lock()
 
         self._dispatch_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="head-dispatch"
@@ -1361,6 +1385,12 @@ class HeadServer:
             if spec.streaming:
                 self._fail_stream(spec, reason)
             self._release_lease_pins(spec.task_id)
+            # a task that burned its whole retry budget is a post-mortem
+            # moment: snapshot the flight recorder while the evidence
+            # (events, spans, metrics) is still in the windows
+            self._dump_crash_bundle(
+                f"task-retries-exhausted-{spec.task_id[:8]}"
+            )
 
     def _recover_object(
         self, object_id: str, dead_node: str, requeued: set
@@ -1467,6 +1497,10 @@ class HeadServer:
                 [object_id],
                 ObjectLostError(f"object {object_id} lost ({reason}); {why}"),
             )
+            if spec.max_retries > 0:
+                self._dump_crash_bundle(
+                    f"lineage-retries-exhausted-{spec.task_id[:8]}"
+                )
             return
         # lost INPUTS first: the requeued lease parks in dependency wait
         # until they re-seal, so their lineage must be re-executing too
@@ -1711,6 +1745,17 @@ class HeadServer:
                 node = self.nodes.get(node_id)
                 if node is not None and node.alive:
                     self.view.update_available(node_id, req["available"])
+        # metrics federation: typed registry deltas piggybacking on the
+        # coalesced report (agent's own + its workers', pre-labeled)
+        for ent in req.get("metrics", ()):
+            try:
+                self.federation.apply(
+                    ent.get("node", node_id or ""),
+                    ent.get("role", "agent"),
+                    ent.get("records", ()),
+                )
+            except Exception:  # noqa: BLE001 - a bad record must not
+                logger.exception("metrics federation apply failed")
         # borrows must land before the finished-lease unpin below: the pin is
         # what keeps a borrowed arg alive until its borrow is on the books
         if req.get("borrows"):
@@ -3564,6 +3609,20 @@ class HeadServer:
         placements out into grants."""
         SCHED_ROUND_MS.observe(round_ms)
         try:
+            from ray_tpu.util.tracing import SPANS
+
+            SPANS.record(
+                "sched_round",
+                "scheduler",
+                time.time() - round_ms / 1e3,
+                round_ms / 1e3,
+                pid="head",
+                batch=len(sched[0]),
+                placed=int((rows >= 0).sum()),
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+        try:
             self._fan_out_grants(sched, rows)
             if len(sched) > 4:
                 self._handle_preempt(sched, sched[4].preempt_rows())
@@ -3706,16 +3765,100 @@ class HeadServer:
             np.concatenate([[True], srt[1:] != srt[:-1]])
         )
         grants: Dict[str, List[LeaseRequest]] = {}
+        row_to_node: Dict[int, str] = {}
         with self._lock:
             # optimistic deduction so later rounds see the placement; the
             # agent's authoritative report will overwrite the rows.
             self.view.subtract_many(row_arr, demands_mat)
             for k, start in enumerate(starts):
                 end = starts[k + 1] if k + 1 < len(starts) else srt.size
-                grants[self.view.node_id(int(srt[start]))] = [
+                node_id = self.view.node_id(int(srt[start]))
+                row_to_node[int(srt[start])] = node_id
+                grants[node_id] = [
                     specs[idx[order[j]]] for j in range(start, end)
                 ]
         self._send_grants(grants)
+        if cfg.sched_explain:
+            try:
+                self._note_explanations(sched, rows, idx, row_arr, row_to_node)
+            except Exception:  # noqa: BLE001 - attribution is best-effort
+                logger.exception("placement attribution failed")
+
+    def _note_explanations(
+        self,
+        sched,
+        rows: np.ndarray,
+        idx: np.ndarray,
+        row_arr: np.ndarray,
+        row_to_node: Dict[int, str],
+    ) -> None:
+        """Scheduler decision attribution (ISSUE 15): record, per placed
+        spec, the five per-term cost contributions of its winning node
+        (``hybrid.TERM_NAMES``) into the bounded explanation table and a
+        SCHEDULED task event — so both ``QueryState explain_placement``
+        and the Chrome-trace export answer "why THIS node". Kernel
+        rounds carry exact terms read back with the placements; host
+        golden-model rounds record the placement with zeroed terms
+        (single-objective by construction), labeled by source."""
+        from ray_tpu.scheduler.hybrid import TERM_NAMES
+
+        specs = sched[0]
+        pending = sched[4] if len(sched) > 4 else None
+        terms = pending.terms_rows() if pending is not None else None
+        source = "kernel" if terms is not None else "host"
+        now = time.time()
+        entries: List[Tuple[str, dict]] = []
+        for j, i in enumerate(np.asarray(idx)):
+            spec = specs[int(i)]
+            node_id = row_to_node.get(int(row_arr[j]))
+            if node_id is None:
+                continue
+            if terms is not None:
+                tvec = terms[int(i)]
+                tdict = {
+                    name: float(tvec[t]) for t, name in enumerate(TERM_NAMES)
+                }
+            else:
+                tdict = {name: 0.0 for name in TERM_NAMES}
+                tdict["starve_discount"] = 1.0
+            trace = getattr(spec, "trace", None) or {}
+            entries.append(
+                (
+                    spec.task_id,
+                    {
+                        "task_id": spec.task_id,
+                        "name": spec.name,
+                        "node": node_id,
+                        "source": source,
+                        "terms": tdict,
+                        "trace_id": trace.get("trace_id"),
+                        "ts": now,
+                    },
+                )
+            )
+            self.events.record(
+                spec.task_id,
+                spec.name,
+                "SCHEDULED",
+                node_id,
+                sched_terms=tdict,
+                **_trace_args(spec),
+            )
+        if not entries:
+            return
+        keep = max(64, int(cfg.sched_explain_keep))
+        with self._explain_lock:
+            for tid, ent in entries:
+                self._explain[tid] = ent
+                self._explain.move_to_end(tid)
+            while len(self._explain) > keep:
+                self._explain.popitem(last=False)
+
+    def explain_placement(self, task_id: str) -> Optional[dict]:
+        """The recorded decision attribution for one scheduled task (or
+        None: never kernel-scheduled, evicted, or explain off)."""
+        with self._explain_lock:
+            return self._explain.get(task_id)
 
     def _ring_park_specs(self, specs: List[LeaseRequest]) -> None:
         """Pin freshly-parked kernel shapes in the on-device parked-demand
@@ -4979,6 +5122,86 @@ class HeadServer:
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
+    def metrics_text(self) -> str:
+        """The head scrape body (dashboard /metrics): dark-plane
+        counters synced, the head's hand-counted table and cluster
+        gauges published typed, the head's own registry merged into the
+        federation (node="head", role="head", cumulative), and the whole
+        federated registry — agents' and workers' shipped deltas
+        included — rendered as one parser-valid exposition."""
+        from ray_tpu.util.metrics import (
+            registry_dump,
+            sync_counter,
+            sync_gauge,
+        )
+
+        try:
+            from .event_loop import publish_dark_plane
+
+            publish_dark_plane()
+        except Exception:  # noqa: BLE001 - dark-plane sync is optional
+            pass
+        info = self._h_cluster_info(None)
+        for name, value in info["metrics"].items():
+            # the historical hand-rolled scrape names (ray_tpu_*) stay,
+            # now typed through the registry instead of string-built
+            sync_counter(
+                f"ray_tpu_{name}", float(value),
+                "Head lifecycle counter (HeadServer.metrics table).",
+            )
+        alive = sum(1 for n in info["nodes"] if n["Alive"])
+        sync_gauge(
+            "ray_tpu_nodes_alive", float(alive), "Live nodes in the view."
+        )
+        for n in info["nodes"]:
+            for res, avail_v in (n["Available"] or {}).items():
+                safe = (
+                    res.replace("-", "_").replace(".", "_").replace("/", "_")
+                )
+                self._node_avail_gauge.set(
+                    float(avail_v),
+                    {"node": n["NodeID"], "resource": safe},
+                )
+        self.federation.apply("head", "head", registry_dump(), replace=True)
+        return self.federation.text()
+
+    def _dump_crash_bundle(self, reason: str) -> None:
+        """Flight-recorder trigger (async: file I/O stays off whatever
+        failure path tripped it; the recorder's own throttle bounds
+        storms)."""
+        if not cfg.crash_bundles:
+            return
+        from ray_tpu.util import flight_recorder
+
+        if flight_recorder.throttled():
+            return  # storm: don't even burn a pool slot
+        try:
+            self._dispatch_pool.submit(self._dump_crash_bundle_now, reason)
+        except RuntimeError:  # pool shut down
+            pass
+
+    def _dump_crash_bundle_now(self, reason: str) -> Optional[str]:
+        from ray_tpu.util import flight_recorder
+
+        if flight_recorder.throttled():
+            # re-checked here: the expensive QueryState snapshots below
+            # must not run for a dump the recorder would discard
+            return None
+        try:
+            state = {
+                "summary": self._h_query_state({"kind": "summary"}),
+                "sched": self._h_query_state({"kind": "sched"}),
+            }
+        except Exception:  # noqa: BLE001 - partial state beats none
+            state = {}
+        return flight_recorder.dump_bundle(
+            reason,
+            events=self.events,
+            state=state,
+            metrics_text=self.metrics_text,
+            extra_meta={"epoch": self.cluster_epoch, "role": self.role},
+        )
+
     def _h_cluster_info(self, req) -> dict:
         with self._lock:
             totals, avail, _ = self.view.active_arrays()
@@ -5129,6 +5352,14 @@ class HeadServer:
 
     def _h_query_state(self, req: dict) -> Any:
         kind = req.get("kind", "summary")
+        if kind == "explain_placement":
+            # scheduler decision attribution (ISSUE 15): the five
+            # per-term cost contributions of one task's winning placement
+            return self.explain_placement(req.get("task_id", ""))
+        if kind == "metrics_text":
+            # the federated scrape body over RPC (dashboard-less tests,
+            # remote bundle collection)
+            return self.metrics_text()
         if kind == "rpc_handlers":
             # per-handler timing (instrumented_io_context stats analog)
             from .rpc import HANDLER_STATS

@@ -136,6 +136,16 @@ if _native_wire_enabled():
         _NATIVE = _load_native_wire()
     except Exception:  # noqa: BLE001 - toolchain missing: Python fallback
         _NATIVE = None
+if _NATIVE is not None:
+    try:
+        # dark-plane counters: hand the C library this process's
+        # shm-resident slot page — frames/bytes count where they move,
+        # read out on the observability tick (native/counters.py)
+        from ray_tpu.native import counters as _dark_counters
+
+        _dark_counters.register_with_wire(_NATIVE)
+    except Exception:  # noqa: BLE001 - counting is optional
+        pass
 
 #: True when the C framing path is active for this process.
 NATIVE_WIRE = _NATIVE is not None

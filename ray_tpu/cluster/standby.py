@@ -429,6 +429,26 @@ class StandbyHead:
             head.cluster_epoch,
             elapsed_ms,
         )
+        try:
+            # failover is a post-mortem moment (ISSUE 15): the promoted
+            # head snapshots a flight-recorder bundle of what it
+            # inherited, and the promotion lands as a trace span
+            from ray_tpu.util.tracing import SPANS
+
+            SPANS.record(
+                "head_failover",
+                "control",
+                time.time() - elapsed_ms / 1e3,
+                elapsed_ms / 1e3,
+                pid="head",
+                from_epoch=self.leader_epoch,
+                to_epoch=head.cluster_epoch,
+            )
+            head._dump_crash_bundle(
+                f"head-failover-epoch{head.cluster_epoch}"
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            logger.debug("failover bundle failed", exc_info=True)
         cb = self.on_promoted
         if cb is not None:
             try:

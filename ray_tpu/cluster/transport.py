@@ -550,6 +550,27 @@ def _request(
     return total, plen
 
 
+def _record_fetch_span(
+    object_id: str, t_wall: float, total: int, stripes: int
+) -> None:
+    """Socket-plane trace span for one completed fetch (ISSUE 15):
+    merged into the Chrome-trace export beside task slices."""
+    try:
+        from ray_tpu.util.tracing import SPANS
+
+        SPANS.record(
+            "socket_fetch",
+            "transport",
+            t_wall,
+            time.time() - t_wall,
+            object_id=object_id[:16],
+            bytes=int(total),
+            stripes=int(stripes),
+        )
+    except Exception:  # noqa: BLE001 - observability only
+        pass
+
+
 def _fetch(
     link: PeerLink,
     object_id: str,
@@ -586,6 +607,7 @@ def _fetch(
         return min(cap, left)
 
     t0 = time.perf_counter()
+    t_wall = time.time()
     # the probe tolerates ONE stale pooled connection (severed while
     # idle, or reaped by the server's idle backstop): retry on a fresh
     # dial before degrading the whole transfer to the RPC fallback.
@@ -621,6 +643,7 @@ def _fetch(
     link.touch()
     if plen >= total:
         link.give_back(conn)
+        _record_fetch_span(object_id, t_wall, total, 1)
         return total
 
     # remaining stripes across parallel connections, resumable per stripe
@@ -694,6 +717,12 @@ def _fetch(
                     link.discard(my_conn)
                     my_conn = None
                 last = exc
+                try:
+                    from ray_tpu.native import counters as _dark
+
+                    _dark.add("net_stripe_retries_total")
+                except Exception:  # noqa: BLE001 - counting is optional
+                    pass
         raise StripeFetchError(
             f"stripe {off} of {object_id} failed after retries"
         ) from last
@@ -720,6 +749,7 @@ def _fetch(
             f"striped pull of {object_id} failed: {exc!r}"
         ) from exc
     link.touch()
+    _record_fetch_span(object_id, t_wall, total, 1 + len(stripes))
     return total
 
 

@@ -201,6 +201,12 @@ class Worker:
         self._direct_clients: Dict[str, RpcClient] = {}
         self._direct_seals: list = []  # SealInfo batch for the agent
         self._direct_seal_cv = threading.Condition()
+        # metrics federation (ISSUE 15): this worker's registry ships as
+        # typed deltas on the seal channel (the agent relays them on its
+        # next head report); created lazily on the first due tick so an
+        # idle worker stays import-light
+        self._metric_exporter = None
+        self._metrics_last_ship = time.monotonic()
         threading.Thread(
             target=self._direct_sender_loop,
             name="direct-result-send",
@@ -1290,6 +1296,52 @@ class Worker:
                         len(results),
                     )
 
+    def _metrics_due(self) -> bool:
+        from ray_tpu.config import cfg
+
+        return bool(cfg.metrics_federation) and (
+            time.monotonic() - self._metrics_last_ship
+            >= cfg.metrics_interval_s
+        )
+
+    def _metrics_entries(self) -> list:
+        """Metrics federation tick (interval-gated): sync the dark-plane
+        accumulators into this process's registry and collect its typed
+        deltas, pre-labeled with this worker's node/role so they ride
+        the agent's next head report untouched."""
+        from ray_tpu.config import cfg
+
+        if not cfg.metrics_federation:
+            return []
+        now = time.monotonic()
+        if now - self._metrics_last_ship < cfg.metrics_interval_s:
+            return []
+        self._metrics_last_ship = now
+        try:
+            from ray_tpu.cluster.event_loop import publish_dark_plane
+            from ray_tpu.util.metrics import DeltaExporter
+
+            publish_dark_plane()
+            if self._metric_exporter is None:
+                self._metric_exporter = DeltaExporter()
+            records = self._metric_exporter.collect()
+        except Exception:  # noqa: BLE001 - metrics must not stall seals
+            logger.debug("worker metrics collect failed", exc_info=True)
+            return []
+        if not records:
+            return []
+        # role carries a stable per-process discriminator: two workers
+        # on one node must not collapse to the same series key (their
+        # per-process gauges would overwrite each other; counters still
+        # sum correctly across the per-worker series)
+        return [
+            {
+                "node": self.node_id,
+                "role": f"worker:{self.worker_id[:8]}",
+                "records": records,
+            }
+        ]
+
     def _direct_seal_loop(self) -> None:
         while True:
             with self._direct_seal_cv:
@@ -1299,6 +1351,10 @@ class Worker:
                     or self._stream_done_reports
                 ):
                     self._direct_seal_cv.wait(timeout=1.0)
+                    # the seal channel doubles as the metrics uplink: a
+                    # due tick breaks the wait even with nothing sealed
+                    if self._metrics_due():
+                        break
                 seals = self._direct_seals
                 self._direct_seals = []
                 stream = self._stream_reports
@@ -1310,6 +1366,11 @@ class Worker:
                 msg["stream"] = stream
             if stream_done:
                 msg["stream_done"] = stream_done
+            metrics = self._metrics_entries()
+            if metrics:
+                msg["metrics"] = metrics
+            if not (seals or stream or stream_done or metrics):
+                continue
             while True:
                 try:
                     self.agent.call("WorkerSealed", msg, timeout=30.0)

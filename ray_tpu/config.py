@@ -318,6 +318,76 @@ define(
 )
 
 # ---------------------------------------------------------------------------
+# flight recorder (ISSUE 15): federation, spans, attribution, crash bundles
+# ---------------------------------------------------------------------------
+define(
+    "trace_spans",
+    True,
+    "Record process-level duration spans (scheduler rounds, serve "
+    "request lifecycle, socket-plane stripes, elastic reshape phases) "
+    "into util.tracing.SPANS; merged into every Chrome-trace export and "
+    "crash bundle. All sites are off the per-task hot path.",
+)
+define(
+    "metrics_federation",
+    True,
+    "Ship typed registry deltas to the head (workers piggyback on the "
+    "seal channel, agents on the coalesced head report); the head "
+    "merges them into one node/role-labeled scrape body.",
+)
+define(
+    "metrics_interval_s",
+    2.0,
+    "Registry-delta ship cadence for the metrics federation (workers "
+    "and agents collect at most this often; idle registries ship "
+    "nothing).",
+)
+define(
+    "sched_explain",
+    True,
+    "Read back the per-term cost contributions (util/het/frag/locality "
+    "+ starvation discount) of every winning placement from the round "
+    "kernel and keep them queryable via QueryState explain_placement. "
+    "Adds one f32[B,5] readback per round; placements are unchanged.",
+)
+define(
+    "sched_explain_keep",
+    4096,
+    "Bounded count of per-task placement explanations retained on the "
+    "head (oldest evicted first).",
+)
+define(
+    "crash_bundles",
+    True,
+    "Dump a bounded flight-recorder bundle (recent task events, trace "
+    "spans, a metrics snapshot, debug state) on chaos faults, "
+    "retries-exhausted task failures, and head failover.",
+)
+define(
+    "crash_bundle_dir",
+    "",
+    "Base directory for crash bundles (empty = <tmpdir>/ray_tpu_bundles); "
+    "each process writes under a per-run subdirectory.",
+)
+define(
+    "crash_bundle_window_s",
+    60.0,
+    "Crash bundles include only task events / spans from the last this "
+    "many seconds.",
+)
+define(
+    "crash_bundle_keep",
+    8,
+    "Max bundles kept per run directory (oldest rotated out).",
+)
+define(
+    "crash_bundle_min_interval_s",
+    5.0,
+    "Throttle: at most one crash bundle per process per this interval "
+    "(a failure storm must not turn the recorder into the outage).",
+)
+
+# ---------------------------------------------------------------------------
 # cluster control plane
 # ---------------------------------------------------------------------------
 define("head_address", "", "Cluster head address for implicit ray_tpu.init().")

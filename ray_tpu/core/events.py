@@ -51,9 +51,18 @@ class TaskEventBuffer:
             out[e.task_id] = e
         return out
 
-    def dump_timeline(self, path: Optional[str] = None) -> List[dict]:
+    def dump_timeline(
+        self, path: Optional[str] = None, include_process_spans: bool = True
+    ) -> List[dict]:
         """Chrome tracing format: one complete ('X') slice per RUNNING →
-        FINISHED/FAILED pair, plus instant events for queueing states."""
+        FINISHED/FAILED pair, plus instant events for queueing states.
+
+        Instant events carry their recorded ``extra`` (trace ids, and —
+        for SCHEDULED events the head stamps — the scheduler's per-term
+        cost breakdown), so one trace answers both "where did it run"
+        and "why was it placed there". Process-level spans from
+        ``util.tracing.SPANS`` (scheduler rounds, serve requests, socket
+        stripes, elastic reshape phases) merge into the same export."""
         spans: List[dict] = []
         open_running: Dict[str, TaskEvent] = {}
         for e in self.events():
@@ -84,17 +93,25 @@ class TaskEventBuffer:
                     }
                 )
             elif e.state in ("SUBMITTED", "SCHEDULED"):
-                spans.append(
-                    {
-                        "name": f"{e.name}:{e.state.lower()}",
-                        "cat": "scheduler",
-                        "ph": "i",
-                        "s": "p",
-                        "ts": e.timestamp * 1e6,
-                        "pid": e.node_id or "cluster",
-                        "tid": 0,
-                    }
-                )
+                span = {
+                    "name": f"{e.name}:{e.state.lower()}",
+                    "cat": "scheduler",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": e.timestamp * 1e6,
+                    "pid": e.node_id or "cluster",
+                    "tid": 0,
+                }
+                if e.extra:
+                    span["args"] = {"task_id": e.task_id, **e.extra}
+                spans.append(span)
+        if include_process_spans:
+            try:
+                from ray_tpu.util.tracing import SPANS
+
+                spans.extend(SPANS.slices())
+            except Exception:  # noqa: BLE001 - export must not fail
+                pass
         if path:
             with open(path, "w") as f:
                 json.dump(spans, f)

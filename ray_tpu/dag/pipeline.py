@@ -64,6 +64,15 @@ MSG = struct.Struct("<IB")
 # live pipelines (weak) for observability surfaces
 _PIPELINES: "weakref.WeakSet" = weakref.WeakSet()
 
+# dark-plane counters (ISSUE 15): the event loop bumps shm-resident
+# int64 slots (native/counters.py) — one lock-free store per item,
+# synced into the typed registry on the observability tick
+from ray_tpu.native import counters as _dark_counters  # noqa: E402
+
+_C_SUBMITTED = _dark_counters._IDX["pipeline_items_submitted_total"]
+_C_COMPLETED = _dark_counters._IDX["pipeline_items_completed_total"]
+_C_RESPILLED = _dark_counters._IDX["pipeline_items_respilled_total"]
+
 
 def pipeline_stats() -> List[dict]:
     return [p.stats() for p in list(_PIPELINES)]
@@ -420,6 +429,7 @@ class CompiledPipeline:
                 entry: dict = {"ev": threading.Event(), "frame": frame}
                 self._pending[slot] = entry
                 self._submitted += 1
+                _dark_counters.block().add(_C_SUBMITTED)
         if broken:
             return self._submit_eager(value)
         if not self._remote:
@@ -476,6 +486,7 @@ class CompiledPipeline:
             entry["ev"].set()
             self._sem.release()
             self._completed += 1
+            _dark_counters.block().add(_C_COMPLETED)
 
     def _collect_local(self) -> None:
         while not self._stop.is_set():
@@ -497,6 +508,7 @@ class CompiledPipeline:
             entry["ev"].set()
             self._sem.release()
             self._completed += 1
+            _dark_counters.block().add(_C_COMPLETED)
 
     # -- failure handling ----------------------------------------------
     def _check_stall(self) -> None:
@@ -567,6 +579,7 @@ class CompiledPipeline:
         except BaseException as exc:  # noqa: BLE001
             entry["err"] = TaskError(exc, self._name)
         self._respilled += 1
+        _dark_counters.block().add(_C_RESPILLED)
         entry["ev"].set()
         self._sem.release()
 

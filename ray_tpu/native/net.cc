@@ -37,9 +37,25 @@ int set_timeout_ms(int fd, int which, int timeout_ms) {
   return setsockopt(fd, SOL_SOCKET, which, &tv, sizeof(tv));
 }
 
+// Dark-plane counter slots (native/counters.py CounterBlock, same page
+// wire.cc registers). Relaxed atomics; slot indices are ABI shared with
+// counters.py SLOTS.
+long long* g_counters = nullptr;
+constexpr int kSlotTxBytes = 3;
+constexpr int kSlotTxFrames = 4;
+constexpr int kSlotRxBytes = 5;
+
+inline void bump(int slot, long long v) {
+  if (g_counters)
+    __atomic_add_fetch(&g_counters[slot], v, __ATOMIC_RELAXED);
+}
+
 }  // namespace
 
 extern "C" {
+
+// Register the shm counter page (nullptr disables).
+void rtpu_net_set_counters(long long* slots) { g_counters = slots; }
 
 // Bind + listen on host:port (port 0 = ephemeral). Returns the listen fd
 // or -errno.
@@ -179,6 +195,8 @@ int64_t rtpu_net_send_vec(int fd, const void* const* bufs,
       consumed0 = 0;
     }
   }
+  bump(kSlotTxBytes, static_cast<long long>(total));
+  bump(kSlotTxFrames, 1);
   return static_cast<int64_t>(total);
 }
 
@@ -198,6 +216,7 @@ int64_t rtpu_net_recv_exact(int fd, void* buf, uint64_t len) {
     if (r == 0) return got == 0 ? 0 : -ECONNRESET;
     got += static_cast<uint64_t>(r);
   }
+  bump(kSlotRxBytes, static_cast<long long>(len));
   return static_cast<int64_t>(len);
 }
 

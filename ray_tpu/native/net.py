@@ -87,6 +87,15 @@ def native_lib():
             _NATIVE = _load_native()
         except Exception:  # noqa: BLE001 - toolchain missing: Python path
             _NATIVE = None
+        if _NATIVE is not None:
+            try:
+                # dark-plane counters: register this process's shm slot
+                # page so tx/rx bytes count inside the C syscall loop
+                from . import counters as _dark_counters
+
+                _dark_counters.register_with_net(_NATIVE)
+            except Exception:  # noqa: BLE001 - counting is optional
+                pass
     return _NATIVE
 
 
@@ -206,6 +215,9 @@ class NetSocket:
                     for p in parts
                 )
                 self._sock.sendall(joined[sent:])
+            from . import counters as _dark_counters
+
+            _dark_counters.add("net_py_tx_bytes_total", total)
             return total
         except socket.timeout as exc:
             raise NetTimeoutError("send timed out") from exc
@@ -231,6 +243,9 @@ class NetSocket:
                 if r == 0:
                     raise NetClosedError("peer closed during recv")
                 got += r
+            from . import counters as _dark_counters
+
+            _dark_counters.add("net_py_rx_bytes_total", got)
         except socket.timeout as exc:
             raise NetTimeoutError("recv timed out") from exc
         except ConnectionError as exc:

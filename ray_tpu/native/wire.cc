@@ -40,9 +40,27 @@ inline uint64_t get_u64(const uint8_t* p) {
   return v;
 }
 
+// Dark-plane counter slots (native/counters.py CounterBlock): an
+// mmap-resident int64 page registered once per process. Relaxed atomics
+// — rate indicators, not ordering primitives. Slot indices are ABI
+// shared with counters.py SLOTS.
+long long* g_counters = nullptr;
+constexpr int kSlotJoins = 0;
+constexpr int kSlotParses = 1;
+constexpr int kSlotBytes = 2;
+
+inline void bump(int slot, long long v) {
+  if (g_counters)
+    __atomic_add_fetch(&g_counters[slot], v, __ATOMIC_RELAXED);
+}
+
 }  // namespace
 
 extern "C" {
+
+// Register the shm counter page (nullptr disables). Counting is off
+// until the first registration, so standalone users pay nothing.
+void rtpu_wire_set_counters(long long* slots) { g_counters = slots; }
 
 // Total frame size for a build with these parts (0 buffers = bare pickle,
 // no frame). Overflow-safe: returns 0 on length-table overflow.
@@ -93,6 +111,8 @@ int64_t rtpu_wire_join(const uint8_t* pkl, uint64_t pkl_len,
     if (buf_lens[i]) std::memcpy(p, bufs[i], buf_lens[i]);
     p += buf_lens[i];
   }
+  bump(kSlotJoins, 1);
+  bump(kSlotBytes, static_cast<long long>(p - dst));
   return static_cast<int64_t>(p - dst);
 }
 
@@ -127,6 +147,7 @@ int64_t rtpu_wire_parse(const uint8_t* data, uint64_t len, uint64_t* out,
     out[3 + 2 * i] = blen;
     off += blen;
   }
+  bump(kSlotParses, 1);
   return static_cast<int64_t>(nbufs);
 }
 

@@ -27,6 +27,7 @@ packing itself is the device program.
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import NamedTuple
 
 import jax
@@ -241,6 +242,8 @@ def schedule_bundles(
     b = bundles.shape[0]
     if b == 0:
         return np.zeros(0, dtype=np.int32), True, avail
+    t0 = _time.time()
+    t0_mono = _time.perf_counter()
     if avoid is not None:
         # anti-affinity mask (gang-aware reshape placement): avoided rows
         # enter the kernels as dead — they score -inf/-1 and can never
@@ -274,4 +277,22 @@ def schedule_bundles(
     nodes_sorted = np.asarray(res.node)[:b]
     nodes = np.full_like(nodes_sorted, -1)
     nodes[order] = nodes_sorted
-    return nodes, bool((nodes_sorted >= 0).all()), res.avail_out
+    success = bool((nodes_sorted >= 0).all())
+    # PG rounds are rare (create/reshape), so a span per call is cheap;
+    # it lands beside the sched_round slices in the trace export
+    try:
+        from ray_tpu.util.tracing import SPANS
+
+        SPANS.record(
+            "pg_schedule",
+            "scheduler",
+            t0,
+            _time.perf_counter() - t0_mono,
+            pid="scheduler",
+            strategy=strategy,
+            bundles=int(b),
+            success=success,
+        )
+    except Exception:  # noqa: BLE001 - observability only
+        pass
+    return nodes, success, res.avail_out

@@ -171,7 +171,9 @@ def _jitted_fns():
             # (~1 MB at 10k nodes) — noise next to the overlap it buys.
             kernel = jax.jit(
                 hybrid_schedule_shapes_multi_impl,
-                static_argnames=("spread_threshold", "weights", "preempt"),
+                static_argnames=(
+                    "spread_threshold", "weights", "preempt", "explain",
+                ),
             )
             push = jax.jit(
                 lambda avail, rows, vals: avail.at[rows].set(vals),
@@ -291,13 +293,18 @@ class PendingRound:
     already dispatched keep executing behind it (avail chain).
     """
 
-    __slots__ = ("_node", "_b", "_preempt", "_u", "dispatched_at", "ctx")
+    __slots__ = (
+        "_node", "_b", "_preempt", "_u", "_terms", "dispatched_at", "ctx",
+    )
 
-    def __init__(self, node, b: int, ctx=None, preempt=None, u: int = 0):
+    def __init__(
+        self, node, b: int, ctx=None, preempt=None, u: int = 0, terms=None
+    ):
         self._node = node
         self._b = b
         self._preempt = preempt  # int32[U_pad] device, or None
         self._u = u              # real (unpadded) shape count
+        self._terms = terms      # f32[B_pad, 5] device, or None
         self.dispatched_at = time.perf_counter()
         self.ctx = ctx  # opaque caller payload (e.g. the round's specs)
 
@@ -329,6 +336,17 @@ class PendingRound:
             return None
         self._preempt = None
         return np.asarray(p)[: self._u]
+
+    def terms_rows(self) -> Optional[np.ndarray]:
+        """f32[B, 5] per-request cost attribution (hybrid.TERM_NAMES
+        order; zero rows for unplaced requests), or None when the round
+        dispatched without explain. Like ``preempt_rows``: call after
+        ``result()`` — it rode the same async host copy."""
+        t = self._terms
+        if t is None:
+            return None
+        self._terms = None
+        return np.asarray(t)[: self._b]
 
 
 class DeviceSchedulerState:
@@ -565,6 +583,7 @@ class DeviceSchedulerState:
         if weights is None:
             weights = score_weights_from_cfg()
         preempt = bool(cfg.sched_preempt) and ages is not None
+        explain = bool(cfg.sched_explain)
 
         u_pad = _bucket(u + 1, 2)
         b_pad = _bucket(b)
@@ -610,6 +629,7 @@ class DeviceSchedulerState:
                 weights=weights,
                 preempt=preempt,
                 locality=loc_dev,
+                explain=explain,
             )
             self._avail = res.avail_out
         node = res.node
@@ -617,6 +637,8 @@ class DeviceSchedulerState:
             node.copy_to_host_async()
             if preempt:
                 res.preempt_node.copy_to_host_async()
+            if explain:
+                res.terms.copy_to_host_async()
         except AttributeError:  # pragma: no cover - older jax arrays
             pass
         return PendingRound(
@@ -625,6 +647,7 @@ class DeviceSchedulerState:
             ctx=ctx,
             preempt=res.preempt_node if preempt else None,
             u=u,
+            terms=res.terms if explain else None,
         )
 
     def schedule(self, demands: np.ndarray, spread_threshold: float = 0.5):
@@ -829,6 +852,7 @@ class DeviceSchedulerState:
                 # program no round ever runs)
                 weights = score_weights_from_cfg()
                 preempt_flag = bool(cfg.sched_preempt)
+                explain_flag = bool(cfg.sched_explain)
                 t_pad = (
                     self._thr.shape[0] if self._thr is not None else 1
                 )
@@ -860,6 +884,7 @@ class DeviceSchedulerState:
                             spread_threshold=spread_threshold,
                             weights=weights,
                             preempt=preempt_flag,
+                            explain=explain_flag,
                         )
                         res.node.block_until_ready()
                         self.stats["prewarmed"] += 1

@@ -451,6 +451,19 @@ class ShapesResult(NamedTuple):
     # node with the lowest utilization cost, for starving (age >= 1.0)
     # shapes with unmet demand and zero current capacity; -1 = none.
     preempt_node: jax.Array
+    # f32[B, 5] per-request cost attribution at the WINNING node
+    # (explain=True only; a [1, 5] zero placeholder otherwise):
+    # columns = (util, het, frag, locality, starve-discount) — the
+    # weighted contributions exactly as they entered the cost, plus the
+    # starvation discount scale applied to the soft terms. Rows of
+    # unplaced requests are zero.
+    terms: jax.Array
+
+
+#: ``ShapesResult.terms`` column order — the ONE naming of the decision
+#: attribution vector, shared by the kernel, the head's explanation
+#: table, and the Chrome-trace export.
+TERM_NAMES = ("util", "het", "frag", "locality", "starve_discount")
 
 
 def _shape_cost(
@@ -466,7 +479,8 @@ def _shape_cost(
     ref: jax.Array,
     weights: ScoreWeights,
     loc: Optional[jax.Array] = None,
-) -> jax.Array:
+    want_terms: bool = False,
+):
     """f32[N] multi-objective placement cost for one shape (lower is
     better; inf on nodes with no capacity). The ONE cost definition
     shared by the shapes waterfall and the parked-ring kernel. Weight
@@ -478,29 +492,56 @@ def _shape_cost(
     host-side). A BONUS, not a penalty: all-zero rows (no located
     inputs, or a consumer with no locality data like the parked ring)
     leave the cost untouched, so locality-blind shapes keep the exact
-    single-objective ordering even at weight > 0."""
+    single-objective ordering even at weight > 0.
+
+    ``want_terms`` (decision attribution, ISSUE 15): additionally
+    return f32[5, N] per-node term vectors in ``TERM_NAMES`` order —
+    each weighted contribution exactly as it entered the cost (locality
+    negative: it is a bonus), row 4 the starvation discount scale. The
+    cost composition itself is op-for-op identical either way, so the
+    explain variant places bit-identically."""
     cost = quantize_score(score)
     if weights.util != 1.0:
         cost = weights.util * cost
+    util_c = cost
+    n = score.shape[0]
+    zeros = jnp.zeros((n,), dtype=jnp.float32) if want_terms else None
+    het_c = frag_c = loc_c = zeros
+    scale = 1.0
     has_loc = bool(weights.locality) and loc is not None
     if weights.het or weights.frag or has_loc:
         # starving shapes discount the soft terms: a shape that has
         # waited w_starve-scaled ages takes ANY available node
         scale = 1.0 / (1.0 + weights.starve * age) if weights.starve else 1.0
         if weights.het:
-            cost = cost + (QUANTIZE_STEPS * weights.het * scale) * _het_penalty(
+            het_c = (QUANTIZE_STEPS * weights.het * scale) * _het_penalty(
                 d, ntypes, thr
             )
+            cost = cost + het_c
         if weights.frag:
-            cost = cost + (QUANTIZE_STEPS * weights.frag * scale) * _frag_penalty(
+            frag_c = (QUANTIZE_STEPS * weights.frag * scale) * _frag_penalty(
                 totals, avail_run, d, ref
             )
+            cost = cost + frag_c
         if has_loc:
             # discounting the bonus too: a starving shape stops holding
             # out for the partition-heavy node and takes any capacity
-            cost = cost - (QUANTIZE_STEPS * weights.locality * scale) * loc
+            loc_c = (QUANTIZE_STEPS * weights.locality * scale) * loc
+            cost = cost - loc_c
     cost = cost + jitter
-    return jnp.where(cap > 0, cost, jnp.inf)
+    cost = jnp.where(cap > 0, cost, jnp.inf)
+    if not want_terms:
+        return cost
+    terms = jnp.stack(
+        [
+            util_c,
+            het_c,
+            frag_c,
+            -loc_c,  # as it entered the cost (a bonus is negative)
+            jnp.full((n,), scale, dtype=jnp.float32),
+        ]
+    )
+    return cost, terms
 
 
 def _nominate_preemption(
@@ -544,6 +585,7 @@ def hybrid_schedule_shapes_multi_impl(
     weights: ScoreWeights = ScoreWeights(),
     preempt: bool = False,
     locality: Optional[jax.Array] = None,
+    explain: bool = False,
 ) -> ShapesResult:
     """Shape-grouped waterfall placement — the fastest scheduling kernel.
 
@@ -554,6 +596,13 @@ def hybrid_schedule_shapes_multi_impl(
 
     The reference queues leases per *scheduling class* (shape) and schedules
     shape-by-shape (cluster_lease_manager.cc:196 iterates shape queues); this
+    ``explain`` (static): additionally accumulate each placed request's
+    per-term cost attribution at its winning node
+    (``ShapesResult.terms``, see ``TERM_NAMES``) — one extra f32[B, 5]
+    carry through the scan plus a gather per shape; the placement math
+    (including RNG consumption) is untouched, so explain=True places
+    bit-identically to explain=False.
+
     kernel keeps that structure but places every request of a shape at once:
 
       for each shape u (sequential scan, hardest shapes first):
@@ -594,7 +643,8 @@ def hybrid_schedule_shapes_multi_impl(
     group_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
     rank_sorted = idx - group_start  # rank within shape, in sorted order
 
-    def per_shape(avail_run, uidx):
+    def per_shape(carry, uidx):
+        avail_run, terms_acc = carry
         d = shape_demands[uidx]
         cap, has_demand, feas = _shape_capacity(totals, avail_run, alive, d)
         score = _critical_score(totals, avail_run, spread_threshold)
@@ -607,10 +657,18 @@ def hybrid_schedule_shapes_multi_impl(
             if (weights.locality and locality is not None)
             else None
         )
-        cost = _shape_cost(
-            totals, avail_run, d, cap, score, jitter,
-            ages[uidx], ntypes, thr, ref, weights, loc_row,
-        )
+        tvec = None
+        if explain:
+            cost, tvec = _shape_cost(
+                totals, avail_run, d, cap, score, jitter,
+                ages[uidx], ntypes, thr, ref, weights, loc_row,
+                want_terms=True,
+            )
+        else:
+            cost = _shape_cost(
+                totals, avail_run, d, cap, score, jitter,
+                ages[uidx], ntypes, thr, ref, weights, loc_row,
+            )
         # top-k beats a full argsort ~3x on CPU XLA and is exact here: a
         # request at rank r within its shape needs at most r+1 nodes of
         # the cost order, ranks are < b <= k, and every cap>0 node sorts
@@ -633,6 +691,16 @@ def hybrid_schedule_shapes_multi_impl(
         avail_run = jnp.where(
             has_demand, avail_run - counts[:, None] * d[None, :], avail_run
         )
+        if explain:
+            # attribution gather: every request this shape placed takes
+            # the [5] term column of its winning node (exactly one shape
+            # writes any request's row, so summing into the carry is a
+            # scatter, not an accumulation)
+            safe_node = jnp.maximum(node_u, 0)
+            contrib = jnp.where(
+                valid[:, None], tvec[:, safe_node].T, 0.0
+            )  # f32[B, 5] in sorted-request order
+            terms_acc = terms_acc + contrib
         if preempt:
             unmet = jnp.sum(sel) > jnp.sum(valid)
             pre_u = _nominate_preemption(
@@ -640,16 +708,28 @@ def hybrid_schedule_shapes_multi_impl(
             )
         else:
             pre_u = jnp.int32(-1)
-        return avail_run, (node_u, pre_u)
+        return (avail_run, terms_acc), (node_u, pre_u)
 
-    avail_out, (nodes_per_shape, preempt_nodes) = jax.lax.scan(
-        per_shape, avail, jnp.arange(u, dtype=jnp.int32)
+    terms0 = (
+        jnp.zeros((b, 5), dtype=jnp.float32)
+        if explain
+        else jnp.zeros((1, 5), dtype=jnp.float32)
+    )
+    (avail_out, terms_sorted), (nodes_per_shape, preempt_nodes) = jax.lax.scan(
+        per_shape, (avail, terms0), jnp.arange(u, dtype=jnp.int32)
     )
     nodes_sorted = jnp.max(nodes_per_shape, axis=0)  # exactly one shape wrote >=0
     nodes = jnp.full((b,), -1, dtype=jnp.int32).at[order].set(
         nodes_sorted.astype(jnp.int32)
     )
-    return ShapesResult(nodes, avail_out, preempt_nodes)
+    if explain:
+        # back to original request order (rows of unplaced requests are 0)
+        terms = jnp.zeros((b, 5), dtype=jnp.float32).at[order].set(
+            terms_sorted
+        )
+    else:
+        terms = terms0
+    return ShapesResult(nodes, avail_out, preempt_nodes, terms)
 
 
 def hybrid_schedule_shapes_impl(
@@ -688,7 +768,8 @@ hybrid_schedule_shapes = functools.partial(
 )(hybrid_schedule_shapes_impl)
 
 hybrid_schedule_shapes_multi = functools.partial(
-    jax.jit, static_argnames=("spread_threshold", "weights", "preempt")
+    jax.jit,
+    static_argnames=("spread_threshold", "weights", "preempt", "explain"),
 )(hybrid_schedule_shapes_multi_impl)
 
 

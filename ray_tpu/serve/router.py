@@ -380,6 +380,7 @@ class RoutedStream:
         self.delivered = 0
         self.failovers = 0
         self._t0 = time.monotonic()
+        self._t0_wall = time.time()
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self._finished = False
@@ -547,6 +548,28 @@ class RoutedStream:
                 * 1000.0,
                 labels=self._labels,
             )
+        try:
+            # request-lifecycle span (ISSUE 15): one slice per stream in
+            # the Chrome-trace export, beside the task slices it caused
+            from ray_tpu.util.tracing import SPANS
+
+            SPANS.record(
+                "serve_stream",
+                "serve",
+                self._t0_wall,
+                time.monotonic() - self._t0,
+                pid=f"serve:{self._labels['deployment']}",
+                code=code,
+                delivered=self.delivered,
+                failovers=self.failovers,
+                ttft_ms=(
+                    (self._t_first - self._t0) * 1000.0
+                    if self._t_first is not None
+                    else None
+                ),
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
         self._router._note_finished(code)
         self._ticket.done()
 
@@ -569,6 +592,7 @@ class _UnaryRequest:
         self.ref = ref
         self._ticket = ticket
         self._t0 = t0
+        self._t0_wall = time.time()
         self._done = False
         self._labels = {"deployment": router._rs.dep.name}
 
@@ -599,6 +623,19 @@ class _UnaryRequest:
                 (time.monotonic() - self._t0) * 1000.0,
                 labels=self._labels,
             )
+            try:
+                from ray_tpu.util.tracing import SPANS
+
+                SPANS.record(
+                    "serve_unary",
+                    "serve",
+                    self._t0_wall,
+                    time.monotonic() - self._t0,
+                    pid=f"serve:{self._labels['deployment']}",
+                    code=code,
+                )
+            except Exception:  # noqa: BLE001 - observability only
+                pass
             self._router._note_finished(code)
             self._ticket.done()
 

@@ -54,6 +54,7 @@ import ray_tpu
 from ray_tpu.config import cfg
 from ray_tpu.util.metrics import Counter as _Counter
 from ray_tpu.util.metrics import Histogram as _Histogram
+from ray_tpu.util.tracing import SPANS
 
 from .checkpoint import Checkpoint
 from .session import TrainContext, _set_context
@@ -1420,6 +1421,8 @@ class ElasticTrainer:
                     f"elastic gang below min_workers "
                     f"({world} < {self.elastic.min_workers})"
                 )
+            t_place = time.monotonic()
+            t_place_wall = time.time()
             try:
                 pg, nodes, actors = self._place(world, self._avoid_now())
             except TimeoutError:
@@ -1447,6 +1450,17 @@ class ElasticTrainer:
                 continue
             backoff = 0.2
             place_start = None
+            # reshape-phase span (ISSUE 15): one slice per placement in
+            # the Chrome-trace export, beside the generation slices
+            SPANS.record(
+                "elastic_place",
+                "elastic",
+                t_place_wall,
+                time.monotonic() - t_place,
+                pid=f"gang:{self.gang_id[:8]}",
+                world=world,
+                generation=self._generation,
+            )
             try:
                 epoch = self._register(nodes)
                 hub = self._ensure_hub(epoch, world)
@@ -1491,6 +1505,7 @@ class ElasticTrainer:
                     start_step,
                 )
                 t_watch = time.monotonic()
+                t_watch_wall = time.time()
                 results, errors = self._watch(gen)
             except BaseException:
                 # a failure between placement and drain (head blip
@@ -1503,6 +1518,16 @@ class ElasticTrainer:
                 raise
             t_drain = time.monotonic()
             self._teardown_generation(gen.actors, gen.pg)
+            SPANS.record(
+                "elastic_generation",
+                "elastic",
+                t_watch_wall,
+                t_drain - t_watch,
+                pid=f"gang:{self.gang_id[:8]}",
+                generation=gen.index,
+                world=world,
+                epoch=gen.epoch,
+            )
             logger.info(
                 "gang %s gen %d: drained in %.2fs, teardown %.2fs "
                 "(%d results, %d errors)",
@@ -1546,6 +1571,8 @@ class ElasticTrainer:
                 self._retire_seals(list(final_state_seal))
                 break
             # ---- reshape path ----
+            t_reshape = time.monotonic()
+            t_reshape_wall = time.time()
             dead_nodes = sorted(
                 {
                     nodes[r]
@@ -1602,6 +1629,18 @@ class ElasticTrainer:
             )
             self._target_world = next_world
             ELASTIC_RESHAPES.inc(labels={"direction": direction})
+            SPANS.record(
+                "elastic_reshape",
+                "elastic",
+                t_reshape_wall,
+                time.monotonic() - t_reshape,
+                pid=f"gang:{self.gang_id[:8]}",
+                direction=direction,
+                from_world=world,
+                to_world=next_world,
+                resume_step=int(resume["step"]),
+                dead_nodes=len(dead_nodes),
+            )
             self.reshape_log.append(
                 {
                     "generation": gen.index,

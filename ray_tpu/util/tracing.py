@@ -21,7 +21,11 @@ Here the context is a small dict ``{"trace_id", "span_id"}`` carried in
 from __future__ import annotations
 
 import contextvars
-from typing import Optional
+import threading
+import time as _time
+from collections import deque
+from contextlib import contextmanager
+from typing import List, Optional
 
 from ray_tpu._ids import rand_hex
 from ray_tpu.config import cfg
@@ -89,3 +93,79 @@ def event_args(trace: Optional[dict]) -> dict:
     if trace.get("parent_id"):
         out["parent_id"] = trace["parent_id"]
     return out
+
+
+# ---------------------------------------------------------------------------
+# process-local span recorder (ISSUE 15): named duration spans beyond the
+# per-task lifecycle — scheduler rounds, serve request lifecycle,
+# socket-plane stripes, elastic reshape phases. Spans land in a bounded
+# ring and merge into every Chrome-trace export
+# (core/events.TaskEventBuffer.dump_timeline) and crash bundle.
+# ---------------------------------------------------------------------------
+
+
+class SpanBuffer:
+    """Bounded ring of completed spans in Chrome-trace 'X' form."""
+
+    def __init__(self, max_spans: int = 50_000):
+        self._spans: deque = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+
+    def record(
+        self,
+        name: str,
+        cat: str,
+        start_ts: float,
+        dur_s: float,
+        pid: str = "",
+        tid=0,
+        **args,
+    ) -> None:
+        """One completed span: ``start_ts`` is epoch seconds
+        (time.time()), ``dur_s`` its wall duration. ``args`` must be
+        JSON-serializable (they land in trace exports verbatim)."""
+        if not cfg.trace_spans:
+            return
+        span = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": start_ts * 1e6,
+            "dur": max(0.0, dur_s) * 1e6,
+            "pid": pid or "process",
+            "tid": tid,
+        }
+        if args:
+            span["args"] = args
+        with self._lock:
+            self._spans.append(span)
+
+    @contextmanager
+    def span(self, name: str, cat: str = "runtime", pid: str = "", **args):
+        t0 = _time.time()
+        try:
+            yield
+        finally:
+            self.record(name, cat, t0, _time.time() - t0, pid=pid, **args)
+
+    def slices(
+        self, since_s: Optional[float] = None, cat: Optional[str] = None
+    ) -> List[dict]:
+        """Snapshot (optionally only spans STARTING within the last
+        ``since_s`` seconds, the crash-bundle window)."""
+        with self._lock:
+            spans = list(self._spans)
+        if since_s is not None:
+            cutoff = (_time.time() - since_s) * 1e6
+            spans = [s for s in spans if s["ts"] >= cutoff]
+        if cat is not None:
+            spans = [s for s in spans if s["cat"] == cat]
+        return spans
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+#: the process's span ring (one per process, like the metrics registry)
+SPANS = SpanBuffer()

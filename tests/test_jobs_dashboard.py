@@ -150,8 +150,15 @@ def test_dashboard_endpoints(cluster):
     assert "metrics" in status
     with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
         text = r.read().decode()
-    assert "ray_tpu_nodes_alive 1" in text
-    assert "ray_tpu_leases_submitted" in text
+    # the scrape is the head's FEDERATED registry now: typed families,
+    # every sample namespaced by node/role, parser-valid end to end
+    from ray_tpu.util.metrics import validate_exposition
+
+    fams = validate_exposition(text)
+    assert fams["ray_tpu_nodes_alive"]["kind"] == "gauge"
+    (_, labels, value), = fams["ray_tpu_nodes_alive"]["samples"]
+    assert value == 1 and dict(labels)["node"] == "head"
+    assert fams["ray_tpu_leases_submitted"]["kind"] == "counter"
 
 
 def test_dashboard_job_rest(cluster, tmp_path):

@@ -37,11 +37,11 @@ def test_metrics_instruments_and_prometheus_text():
 
 def test_metrics_http_endpoint():
     rm.Gauge("rtpu_http_gauge").set(7)
-    port = rm.start_metrics_server(port=0)
-    with urllib.request.urlopen(
-        f"http://127.0.0.1:{port}/metrics", timeout=10
-    ) as resp:
-        body = resp.read().decode()
+    with rm.start_metrics_server(port=0) as port:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            body = resp.read().decode()
     assert "rtpu_http_gauge 7.0" in body
 
 
