@@ -2039,6 +2039,267 @@ def serve_bench() -> dict:
         cluster.shutdown()
 
 
+class _BenchTokenServer:
+    """Deterministic resumable token streamer for the router-scale
+    tier: cheap enough that the ingress routers (not the replicas) are
+    the measured surface, slow enough (per-token sleep) that a router
+    kill lands mid-stream."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = float(delay_s)
+
+    def stream_to(self, writer, request):
+        n = int(request.get("n", 16))
+        for i in range(int(request.get("resume_from", 0)), n):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            writer.write(f"tok{i}")
+        writer.close_channel()
+        return n
+
+    def pid(self):
+        return os.getpid()
+
+
+def router_scale_bench() -> dict:
+    """Tier: horizontally scaled ingress. Open-loop fixed-QPS token
+    streams against the SAME deployment behind 1 -> 2 -> 4 ingress
+    routers (consistent-hash tenant assignment, budget-reconciled
+    admission shards), exporting per-fleet-size sustained QPS
+    (serve_qps_per_router) and e2e p99; then a router-kill failover row
+    (kill one of two routers mid-stream, streams must resume
+    token-exact on the sibling) exporting router_failover_p95_s.
+    Gates: RAY_TPU_BENCH_ROUTER_SCALE_FLOOR (4-router p99 must stay
+    within 1.5x the single-router p99, and aggregate QPS must not
+    regress) and RAY_TPU_BENCH_ROUTER_FAILOVER_P95_S."""
+    import random as _random
+    import threading
+
+    import ray_tpu.serve as serve
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.serve.admission import Overloaded
+    from ray_tpu.serve.fleet import SERVE_ROUTER_FAILOVER_S
+    from ray_tpu.serve.router import SERVE_E2E_MS
+
+    qps = float(os.environ.get("RAY_TPU_BENCH_ROUTER_QPS", "40"))
+    duration_s = float(
+        os.environ.get("RAY_TPU_BENCH_ROUTER_SECONDS", "6")
+    )
+    n_tokens = int(os.environ.get("RAY_TPU_BENCH_ROUTER_TOKENS", "8"))
+    tenants = [f"tenant-{i}" for i in range(8)]
+    cluster = Cluster(use_device_scheduler=False)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    rt = cluster.client()
+    set_runtime(rt)
+    t_start = time.perf_counter()
+    out: dict = {}
+    saved_routers = os.environ.get("RAY_TPU_SERVE_ROUTERS")
+    saved_shm = os.environ.get("RAY_TPU_SERVE_SHM_STREAMS")
+
+    def _run_level(n_routers: int) -> dict:
+        os.environ["RAY_TPU_SERVE_ROUTERS"] = str(n_routers)
+        name = f"rsbench{n_routers}"
+        app = serve.deployment(
+            name=name, num_replicas=2, resumable_streams=True
+        )(_BenchTokenServer).bind()
+        serve.run(app)
+        router = serve.get_router(name)
+        rng = _random.Random(17)
+        lbl = {"deployment": name}
+        e2e_base = SERVE_E2E_MS.buckets_snapshot(lbl)
+        results: list = []
+        lock = threading.Lock()
+
+        def one_request(idx):
+            stream = None
+            try:
+                stream = router.stream(
+                    {"n": n_tokens}, rng.choice(tenants)
+                )
+                n = sum(1 for _ in stream)
+                with lock:
+                    results.append(n)
+            except Overloaded:
+                pass
+            except Exception:  # noqa: BLE001
+                with lock:
+                    results.append(-1)
+            finally:
+                if stream is not None:
+                    stream.close()
+
+        # warm the replica dispatch path off the clock
+        warm = [
+            threading.Thread(target=one_request, args=(i,))
+            for i in range(4)
+        ]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=60)
+        with lock:
+            results.clear()
+        threads: list = []
+        t0 = time.perf_counter()
+        launched = 0
+        while time.perf_counter() - t0 < duration_s:
+            threads.append(
+                threading.Thread(target=one_request, args=(launched,))
+            )
+            threads[-1].start()
+            launched += 1
+            next_at = t0 + launched / qps
+            delay = next_at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t0
+        from ray_tpu.util.metrics import percentile_from_buckets
+
+        cur = SERVE_E2E_MS.buckets_snapshot(lbl)
+        window = [max(0, a - b) for a, b in zip(cur, e2e_base)]
+        p99 = percentile_from_buckets(
+            SERVE_E2E_MS.boundaries, window, 0.99
+        )
+        with lock:
+            completed = sum(1 for r in results if r == n_tokens)
+        return {
+            "qps": round(completed / wall, 2),
+            "p99_ms": round(p99, 1),
+            "launched": launched,
+            "completed": completed,
+        }
+
+    try:
+        levels = {}
+        for n_routers in (1, 2, 4):
+            levels[n_routers] = _run_level(n_routers)
+            out[f"router_scale_qps_{n_routers}"] = levels[n_routers][
+                "qps"
+            ]
+            out[f"router_scale_p99_ms_{n_routers}"] = levels[n_routers][
+                "p99_ms"
+            ]
+            out[f"serve_qps_per_router_{n_routers}"] = round(
+                levels[n_routers]["qps"] / n_routers, 2
+            )
+        # ---- router-kill failover row: one of two routers dies
+        # mid-stream; every in-flight stream must resume token-exact on
+        # the sibling. Slow tokens so the kill lands mid-generation.
+        # Force the push transport: a router kill only severs push-sink
+        # streams — same-host shm rings would ride out the death and the
+        # failover row would measure nothing.
+        os.environ["RAY_TPU_SERVE_ROUTERS"] = "2"
+        os.environ["RAY_TPU_SERVE_SHM_STREAMS"] = "0"
+        app = serve.deployment(
+            name="rsfail", num_replicas=2, resumable_streams=True
+        )(_BenchTokenServer).bind(0.02)
+        serve.run(app)
+        fleet = serve.get_router("rsfail")
+        flbl = {"deployment": "rsfail"}
+        fo_base = SERVE_ROUTER_FAILOVER_S.buckets_snapshot(flbl)
+        kills = int(
+            os.environ.get("RAY_TPU_BENCH_ROUTER_KILLS", "3")
+        )
+        resumed = 0
+        exact = 0
+        rng = _random.Random(23)
+        for _ in range(kills):
+            streams = [
+                fleet.stream({"n": 40}, t) for t in tenants[:4]
+            ]
+            # let every stream deliver a few tokens first
+            got = {id(s): [s.read(timeout=30.0)] for s in streams}
+            victim = streams[0]._rid
+            fleet.chaos_kill_router(rid=victim)
+            from ray_tpu.serve.router import ChannelClosed
+
+            for s in streams:
+                try:
+                    while True:
+                        got[id(s)].append(s.read(timeout=30.0))
+                except ChannelClosed:
+                    pass
+                finally:
+                    s.close()
+                if s.router_failovers > 0:
+                    resumed += 1
+                    if got[id(s)] == [f"tok{i}" for i in range(40)]:
+                        exact += 1
+            # restore the two-router fleet for the next kill
+            from ray_tpu.serve.deployment import _apps, _routers
+            from ray_tpu.serve.fleet import RouterFleet
+
+            _routers["rsfail"].close()
+            fleet = RouterFleet(_apps["rsfail"])
+            _routers["rsfail"] = fleet
+        from ray_tpu.util.metrics import percentile_from_buckets
+
+        fo_cur = SERVE_ROUTER_FAILOVER_S.buckets_snapshot(flbl)
+        fo_win = [max(0, a - b) for a, b in zip(fo_cur, fo_base)]
+        fo_p95 = percentile_from_buckets(
+            SERVE_ROUTER_FAILOVER_S.boundaries, fo_win, 0.95
+        )
+        out["router_kills"] = kills
+        out["router_streams_resumed"] = resumed
+        out["router_streams_token_exact"] = exact
+        out["router_failover_p95_s"] = round(fo_p95, 3)
+        out["router_scale_wall_s"] = round(
+            time.perf_counter() - t_start, 1
+        )
+        floor = float(
+            os.environ.get("RAY_TPU_BENCH_ROUTER_SCALE_FLOOR", "0")
+            or 0.0
+        )
+        if floor > 0:
+            # scale gate: p99 at 4 routers within 1.5x of 1 router, and
+            # the 4-router fleet sustains at least `floor` x the
+            # single-router QPS (the floor encodes the expected scaling,
+            # e.g. 1.0 = no regression)
+            p99_ok = out["router_scale_p99_ms_4"] <= max(
+                1.5 * out["router_scale_p99_ms_1"], 50.0
+            )
+            qps_ok = out["router_scale_qps_4"] >= (
+                floor * out["router_scale_qps_1"]
+            )
+            exact_ok = resumed == exact
+            out["router_scale_floor"] = floor
+            out["router_scale_ok"] = bool(p99_ok and qps_ok and exact_ok)
+        fo_budget = float(
+            os.environ.get("RAY_TPU_BENCH_ROUTER_FAILOVER_P95_S", "0")
+            or 0.0
+        )
+        if fo_budget > 0:
+            out["router_failover_budget_s"] = fo_budget
+            out["router_failover_ok"] = bool(
+                out["router_failover_p95_s"] <= fo_budget
+                and resumed == exact
+            )
+        return out
+    finally:
+        if saved_routers is None:
+            os.environ.pop("RAY_TPU_SERVE_ROUTERS", None)
+        else:
+            os.environ["RAY_TPU_SERVE_ROUTERS"] = saved_routers
+        if saved_shm is None:
+            os.environ.pop("RAY_TPU_SERVE_SHM_STREAMS", None)
+        else:
+            os.environ["RAY_TPU_SERVE_SHM_STREAMS"] = saved_shm
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        set_runtime(None)
+        try:
+            rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
 def sim_sched_bench() -> dict:
     """Tier 2b: simulated-scale scheduler. A 10k-node synthetic topology
     with a six-figure pending-demand backlog driven through the REAL head
@@ -2263,6 +2524,11 @@ def main():
             cluster.update(serve_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["serve_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_ROUTER_SCALE", "1") != "0":
+        try:
+            cluster.update(router_scale_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["router_scale_error"] = repr(exc)
     if tiers is not None:
         # TPU attempt 2: ~10 minutes of e2e tiers later the tunnel may
         # have recovered; attempt 3 at the very end with a raised
@@ -2322,6 +2588,8 @@ def main():
         or out.get("wait_p99_ok") is False
         or out.get("serve_p99_ok") is False
         or out.get("serve_qps_ok") is False
+        or out.get("router_scale_ok") is False
+        or out.get("router_failover_ok") is False
         or out.get("xnode_floor_ok") is False
         or out.get("shuffle_floor_ok") is False
         or out.get("failover_p95_ok") is False
@@ -2337,6 +2605,8 @@ def main():
         # RAY_TPU_BENCH_WAIT_P99_CEILING_ROUNDS /
         # RAY_TPU_BENCH_SERVE_P99_CEILING_MS /
         # RAY_TPU_BENCH_SERVE_QPS_FLOOR /
+        # RAY_TPU_BENCH_ROUTER_SCALE_FLOOR /
+        # RAY_TPU_BENCH_ROUTER_FAILOVER_P95_S /
         # RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S /
         # RAY_TPU_BENCH_SHUFFLE_FLOOR_MB_PER_S /
         # RAY_TPU_BENCH_FAILOVER_P95_S /

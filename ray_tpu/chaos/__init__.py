@@ -19,6 +19,7 @@ from .plan import (  # noqa: F401
     FAILOVER_MIX,
     KINDS,
     NET_MIX,
+    ROUTER_MIX,
     SERVE_MIX,
     ChaosPlan,
     FaultSpec,

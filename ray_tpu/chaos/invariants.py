@@ -278,6 +278,50 @@ class InvariantChecker:
             "replica kill (streams wedged instead of failing over)"
         ]
 
+    def wait_streams_resume_cross_router(
+        self, adapter, timeout: float
+    ) -> List[str]:
+        """After a router_kill: EVERY stream that was in flight on the
+        killed router must complete token-exact on a sibling — the
+        replicated delivered-count checkpoint plus the consumer-side
+        skip window may neither duplicate nor drop one acked delta.
+        A watched stream erroring out entirely is also a breach (the
+        failover path wedged), unlike replica_kill where hard errors
+        on unlucky double-kills are tolerated."""
+        if adapter is None:
+            return ["router_kill injected with no serve adapter"]
+        watched = getattr(adapter, "watched_outcomes", None)
+        if watched is None:
+            return ["router_kill injected but adapter tracks no streams"]
+        deadline = time.monotonic() + timeout
+        outcomes: dict = {}
+        while time.monotonic() < deadline:
+            if adapter.verify_failures:
+                return list(adapter.verify_failures)
+            outcomes = watched()
+            if outcomes and all(
+                v != "pending" for v in outcomes.values()
+            ):
+                break
+            if not outcomes:
+                break  # kill landed with nothing in flight: nothing owed
+            time.sleep(0.2)
+        failures = []
+        bad = sorted(
+            sid for sid, v in outcomes.items() if v != "ok"
+        )
+        if bad:
+            failures.append(
+                f"{len(bad)}/{len(outcomes)} in-flight stream(s) did "
+                "not resume token-exact on a sibling router after the "
+                f"kill: {[f'{s[:8]}={outcomes[s]}' for s in bad]}"
+            )
+        # and the fleet keeps serving: fresh streams still complete
+        failures += self.wait_streams_resume(
+            adapter, timeout=max(1.0, deadline - time.monotonic())
+        )
+        return failures
+
     def wait_replica_backfilled(self, adapter, timeout: float) -> List[str]:
         """After a replica_kill the replica set must restore its desired
         count with replicas that actually answer calls."""

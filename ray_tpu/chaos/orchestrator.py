@@ -330,6 +330,21 @@ class ChaosOrchestrator:
                 return f"skipped: replica pid {pid} already gone"
             self._killed_replica = pid
             return f"SIGKILLed serve replica worker pid {pid}"
+        if kind == "router_kill":
+            # abruptly kill one ingress router of the fleet: its push
+            # endpoint vanishes and its in-flight streams FAIL; the
+            # siblings inheriting the hash ranges must resume every one
+            # token-exact from the replicated delivered checkpoints
+            if self.serve_adapter is None:
+                return "skipped: no serve workload registered"
+            kill = getattr(self.serve_adapter, "kill_router", None)
+            if kill is None:
+                return "skipped: serve workload is not fleet-aware"
+            rid = kill(self._rng)
+            if rid is None:
+                return "skipped: no killable router (fleet of one?)"
+            self._killed_router = rid
+            return f"killed ingress router {rid} mid-stream"
         if kind == "peer_conn_drop":
             # sever every data socket one node is SERVING mid-transfer:
             # pullers' in-flight stripes fail and must RESUME (only the
@@ -431,6 +446,7 @@ class ChaosOrchestrator:
                 self._dropped_hex: Optional[str] = None
                 self._killed_owner = None
                 self._killed_replica = None
+                self._killed_router: Optional[str] = None
                 self._killed_gang_nodes: Optional[Dict[str, int]] = None
                 self._head_killed = False
                 self._pre_kill_epoch = 0
@@ -532,6 +548,20 @@ class ChaosOrchestrator:
                     if serve_fail:
                         check.ok = False
                         check.failures.extend(serve_fail)
+                if self._killed_router is not None:
+                    # router-fleet invariant: every stream that was in
+                    # flight on the corpse completes token-exact on a
+                    # sibling (zero duplicated/dropped acked deltas),
+                    # and fresh streams keep completing after the kill
+                    fleet_fail = (
+                        self.checker.wait_streams_resume_cross_router(
+                            self.serve_adapter,
+                            timeout=self.checker.actor_restart_budget_s,
+                        )
+                    )
+                    if fleet_fail:
+                        check.ok = False
+                        check.failures.extend(fleet_fail)
                 recovery = time.monotonic() - t0
                 CHAOS_RECOVERY.observe(recovery)
                 if not check.ok:

@@ -65,7 +65,17 @@ TRAIN_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
     ("rank_node_kill", 2.0),
 )
 
-KINDS = tuple(k for k, _ in SERVE_MIX) + (
+# router-fleet mix: adds router_kill on top of the serve mix (abruptly
+# kill one ingress router of a fleet mid-stream; the sibling inheriting
+# the hash range must resume every in-flight stream token-exact from
+# the replicated delivered-count checkpoints). Not in DEFAULT_MIX or
+# SERVE_MIX for the same seed-stability reason — plans that drive a
+# multi-router fleet pass this mix.
+ROUTER_MIX: Tuple[Tuple[str, float], ...] = SERVE_MIX + (
+    ("router_kill", 2.0),
+)
+
+KINDS = tuple(k for k, _ in ROUTER_MIX) + (
     "peer_conn_drop",
     "head_kill_promote",
     "rank_node_kill",

@@ -2,22 +2,23 @@
 
 Drives a *resumable* streaming deployment (the LLM continuous engine —
 per-request deterministic generation) through the serving router while
-the orchestrator injects ``replica_kill`` faults, and verifies the
-serving plane's core promise end to end: every completed stream's token
-sequence equals the expected sequence EXACTLY — a mid-stream replica
-SIGKILL that fails over may neither duplicate nor drop a single acked
-token.
+the orchestrator injects ``replica_kill`` / ``router_kill`` faults, and
+verifies the serving plane's core promise end to end: every completed
+stream's token sequence equals the expected sequence EXACTLY — a
+mid-stream replica SIGKILL (or ingress-router kill, when ``router`` is
+a :class:`~ray_tpu.serve.fleet.RouterFleet`) that fails over may
+neither duplicate nor drop a single acked token.
 
 The workload doubles as the orchestrator's ``serve_adapter``: it knows
-how to pick a live replica worker pid to kill, how many replicas are
-supposed to exist (the replica set's desired count), and whether
-streams kept completing after the fault.
+how to pick a live replica worker pid (or a live router) to kill, how
+many replicas are supposed to exist, and whether the streams that were
+in flight at a fault completed token-exact afterwards.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import ray_tpu
 
@@ -25,7 +26,10 @@ import ray_tpu
 class ServeStreamWorkload:
     """``concurrency`` threads open stream after stream against
     ``router`` and verify each completed stream against
-    ``expected_tokens`` (the deterministic reference sequence)."""
+    ``expected_tokens`` (the deterministic reference sequence). With a
+    fleet and multiple ``tenants``, the threads' streams spread across
+    the routers (consistent-hash assignment), so a router kill lands
+    mid-stream."""
 
     def __init__(
         self,
@@ -33,27 +37,41 @@ class ServeStreamWorkload:
         payload: dict,
         expected_tokens: List[str],
         concurrency: int = 2,
+        tenants: Optional[List[str]] = None,
     ):
         self.router = router
         self.payload = dict(payload)
         self.expected = list(expected_tokens)
         self.concurrency = concurrency
+        self.tenants = list(tenants or ["default"])
         self.completed = 0
         self.stream_errors = 0
         self.verify_failures: List[str] = []
+        self.routers_killed = 0
+        # router-kill accounting: stream_id -> outcome ("ok" |
+        # "verify_fail" | "error") for every stream that was IN FLIGHT
+        # at the moment of a router kill — the cross-router resume
+        # invariant reads this
+        self._watched: Dict[str, str] = {}
+        self._inflight: Dict[int, object] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
     # -- stream loop ----------------------------------------------------
-    def _loop(self) -> None:
+    def _loop(self, idx: int) -> None:
         from ray_tpu.serve.router import ChannelClosed
 
+        tenant = self.tenants[idx % len(self.tenants)]
         while not self._stop.is_set():
             got: List[str] = []
             stream = None
+            sid = None
             try:
-                stream = self.router.stream(self.payload)
+                stream = self.router.stream(self.payload, tenant)
+                sid = getattr(stream, "stream_id", None)
+                with self._lock:
+                    self._inflight[idx] = stream
                 while True:
                     try:
                         got.append(stream.read(timeout=30.0))
@@ -65,12 +83,16 @@ class ServeStreamWorkload:
                 # an unlucky double-kill is counted but tolerated
                 with self._lock:
                     self.stream_errors += 1
+                    self._inflight.pop(idx, None)
+                    if sid in self._watched:
+                        self._watched[sid] = "error"
                 time.sleep(0.2)
                 continue
             finally:
                 if stream is not None:
                     stream.close()
-            if got != self.expected:
+            ok = got == self.expected
+            if not ok:
                 div = next(
                     (
                         i
@@ -88,11 +110,18 @@ class ServeStreamWorkload:
             else:
                 with self._lock:
                     self.completed += 1
+            with self._lock:
+                self._inflight.pop(idx, None)
+                if sid in self._watched:
+                    self._watched[sid] = "ok" if ok else "verify_fail"
 
     def start(self) -> None:
         for i in range(self.concurrency):
             t = threading.Thread(
-                target=self._loop, name=f"serve-chaos-{i}", daemon=True
+                target=self._loop,
+                args=(i,),
+                name=f"serve-chaos-{i}",
+                daemon=True,
             )
             t.start()
             self._threads.append(t)
@@ -136,3 +165,55 @@ class ServeStreamWorkload:
 
     def target_replicas(self) -> int:
         return self.router._rs.target
+
+    # -- router-fleet adapter surface ------------------------------------
+    def kill_router(self, rng) -> Optional[str]:
+        """Abruptly kill one live ingress router (fleet deployments
+        only), preferring one that currently owns in-flight streams so
+        the kill actually lands mid-stream. Snapshots those streams
+        into the cross-router resume watchlist. Returns the victim's
+        router id, or None when no kill is possible (single router /
+        plain ServeRouter)."""
+        fleet = self.router
+        if not hasattr(fleet, "chaos_kill_router"):
+            return None
+        with self._lock:
+            inflight = [
+                s
+                for s in self._inflight.values()
+                if getattr(s, "stream_id", None) is not None
+            ]
+        owned: Dict[str, List[object]] = {}
+        for s in inflight:
+            owned.setdefault(getattr(s, "_rid", ""), []).append(s)
+        victim = None
+        candidates = [rid for rid, _ in fleet.live_routers() if rid in owned]
+        if candidates:
+            victim = rng.choice(sorted(candidates))
+        # register the watchlist BEFORE the kill: a stream completing in
+        # the gap then records "ok" instead of dangling as pending
+        pre = [s.stream_id for s in owned.get(victim, ())] if victim else []
+        with self._lock:
+            for sid in pre:
+                self._watched.setdefault(sid, "pending")
+        rid = fleet.chaos_kill_router(rid=victim, rng=rng)
+        if rid is None:
+            with self._lock:
+                for sid in pre:
+                    if self._watched.get(sid) == "pending":
+                        del self._watched[sid]
+            return None
+        with self._lock:
+            self.routers_killed += 1
+        return rid
+
+    def watched_outcomes(self) -> Dict[str, str]:
+        """Outcome per stream that was in flight on a killed router."""
+        with self._lock:
+            return dict(self._watched)
+
+    def routers_live(self) -> int:
+        fleet = self.router
+        if not hasattr(fleet, "live_routers"):
+            return 1
+        return len(fleet.live_routers())

@@ -402,6 +402,19 @@ class HeadServer:
 
         self.events = TaskEventBuffer()
         self._recovered_epoch = 0
+        # router-fleet assignment tables (horizontally scaled ingress):
+        # deployment -> {"epoch": int, "members": [router_id]}. The
+        # epoch is the fence for every fleet control RPC — a deposed
+        # router's late acquire/ckpt/budget traffic is rejected exactly
+        # like stale cluster-epoch stamps. Durable (snapshot + WAL) so
+        # a promoted standby keeps fencing the same epochs.
+        self._serve_fleets: Dict[str, dict] = {}
+        # fleet stream leases: stream_id -> {stream_id, deployment,
+        # tenant, router_id, delivered, ts}. The delivered-count
+        # checkpoints are what make router failover token-exact — a
+        # sibling inheriting the hash range resumes from here. Sharded
+        # + WAL-persisted like task leases / peer links.
+        self._serve_streams: ShardedTable = ShardedTable(cfg.head_shards)
         if persist_path:
             self._load_persisted()
         # cluster epoch (epoch-fenced control plane): strictly increases
@@ -442,6 +455,9 @@ class HeadServer:
         # Ephemeral by design — a restarted head repopulates within one
         # report period.
         self._serve_state: Dict[tuple, dict] = {}
+        # per-deployment router budget reports (ephemeral — one
+        # reconcile window repopulates): dep -> rid -> report
+        self._serve_budget: Dict[str, dict] = {}
         # elastic-training gang membership: gang_id -> {"epoch", "owner",
         # "members" {rank -> node_id}, "min_size", "dead_ranks", "updated"}.
         # The epoch is the fence for every gang collective — stragglers
@@ -526,6 +542,14 @@ class HeadServer:
             "GangFence": self._h_gang_fence,
             "GangUnregister": self._h_gang_unregister,
             "ReportServeState": self._h_report_serve_state,
+            "ServeFleetJoin": self._h_serve_fleet_join,
+            "ServeFleetLeave": self._h_serve_fleet_leave,
+            "ServeAssignment": self._h_serve_assignment,
+            "ServeStreamAcquire": self._h_serve_stream_acquire,
+            "ServeStreamCkpt": self._h_serve_stream_ckpt,
+            "ServeStreamRelease": self._h_serve_stream_release,
+            "ServeStreamLookup": self._h_serve_stream_lookup,
+            "ServeBudget": self._h_serve_budget,
             "QueryState": self._h_query_state,
             "StandbyHello": self._h_standby_hello,
             "HeadRole": self._h_head_role,
@@ -644,6 +668,15 @@ class HeadServer:
                     rid: dict(row)
                     for rid, row in self._pending_revokes.items()
                 },
+                # router-fleet assignment epochs + stream-lease ckpts:
+                # a restarted head must keep fencing the same epochs
+                # and resuming streams token-exact
+                "serve_fleets": {
+                    dep: dict(f) for dep, f in self._serve_fleets.items()
+                },
+                "serve_streams": [
+                    dict(row) for row in self._serve_streams.values()
+                ],
             } | streams_part
 
     def _snapshot_streams(self) -> dict:
@@ -773,6 +806,13 @@ class HeadServer:
             self._restore_peer_link(row)
         for rid, row in snap.get("pending_revokes", {}).items():
             self._pending_revokes[rid] = dict(row)
+        for dep, f in snap.get("serve_fleets", {}).items():
+            self._serve_fleets[dep] = {
+                "epoch": int(f.get("epoch", 0)),
+                "members": list(f.get("members", ())),
+            }
+        for row in snap.get("serve_streams", []):
+            self._serve_streams[row["stream_id"]] = dict(row)
         for actor_id, fields in snap.get("actors", {}).items():
             info = ActorInfo(**fields)
             # hosting agents re-register and re-attach; until then, unknown
@@ -828,6 +868,26 @@ class HeadServer:
                 self._pending_revokes[rec[1]["revoke_id"]] = dict(rec[1])
             elif kind == "revoke_done":
                 self._pending_revokes.pop(rec[1], None)
+            elif kind == "serve_fleet":
+                row = rec[1]
+                self._serve_fleets[row["deployment"]] = {
+                    "epoch": int(row.get("epoch", 0)),
+                    "members": list(row.get("members", ())),
+                }
+            elif kind == "serve_stream":
+                row = dict(rec[1])
+                self._serve_streams[row["stream_id"]] = row
+            elif kind == "serve_stream_ckpt":
+                row = self._serve_streams.get(rec[1]["stream_id"])
+                if row is not None:
+                    row["delivered"] = max(
+                        int(row.get("delivered", 0)),
+                        int(rec[1].get("delivered", 0)),
+                    )
+                    if rec[1].get("router_id"):
+                        row["router_id"] = rec[1]["router_id"]
+            elif kind == "serve_stream_gone":
+                self._serve_streams.pop(rec[1], None)
         logger.info(
             "recovered head state: %d kv keys, %d actors, %d jobs, "
             "%d WAL records",
@@ -1262,6 +1322,26 @@ class HeadServer:
             self._expire_peer_links()
             self._check_owner_liveness()
             self._expire_pending_revokes()
+            self._expire_serve_streams()
+
+    def _expire_serve_streams(self) -> None:
+        """Reap fleet stream-lease rows whose owner stopped
+        checkpointing (consumer crashed without release): a bounded
+        leak, mirroring task-lease TTL expiry. The TTL is generous —
+        a live stream checkpoints every reconcile window."""
+        ttl = max(60.0, 40 * float(cfg.serve_budget_reconcile_s))
+        now = time.time()
+        with self._lock:
+            stale = [
+                sid
+                for sid, row in self._serve_streams.items()
+                if now - float(row.get("ts") or now) > ttl
+            ]
+            for sid in stale:
+                self._serve_streams.pop(sid, None)
+                self._wal(("serve_stream_gone", sid))
+        if stale:
+            self._wal_flush()
 
     def _on_node_death(self, node_id: str) -> None:
         with self._cond:
@@ -5350,6 +5430,207 @@ class HeadServer:
             ] = {"state": req.get("state") or {}, "ts": time.time()}
         return {"ok": True}
 
+    # ------------------------------------------------------------------
+    # router-fleet control plane (horizontally scaled ingress): the head
+    # owns the tenant->router assignment table (epoch-fenced, WAL-
+    # persisted) and the stream-lease checkpoints that make router
+    # failover token-exact. Steady-state serving makes ZERO of these
+    # calls — only membership changes, one batched checkpoint per
+    # reconcile window per fleet, and budget reconciliation at
+    # cfg.serve_budget_reconcile_s cadence touch the head.
+    # ------------------------------------------------------------------
+    def _serve_fence_locked(
+        self, deployment: str, epoch: int
+    ) -> Optional[dict]:
+        """Assignment-epoch fence (caller holds self._lock): a control
+        RPC stamped with a stale fleet epoch gets a typed stale reply —
+        the sender was deposed and must refresh its assignment before
+        touching stream leases or budgets again."""
+        f = self._serve_fleets.get(deployment)
+        cur = int(f["epoch"]) if f else 0
+        if int(epoch) != cur:
+            return {"stale": True, "epoch": cur}
+        return None
+
+    def _h_serve_fleet_join(self, req: dict) -> dict:
+        dep = req["deployment"]
+        rid = req["router_id"]
+        with self._lock:
+            f = self._serve_fleets.setdefault(
+                dep, {"epoch": 0, "members": []}
+            )
+            if rid not in f["members"]:
+                f["members"] = sorted(f["members"] + [rid])
+                f["epoch"] = int(f["epoch"]) + 1
+                self._wal(
+                    (
+                        "serve_fleet",
+                        {
+                            "deployment": dep,
+                            "epoch": f["epoch"],
+                            "members": list(f["members"]),
+                        },
+                    )
+                )
+            reply = {"epoch": f["epoch"], "members": list(f["members"])}
+        self._wal_flush()
+        return reply
+
+    def _h_serve_fleet_leave(self, req: dict) -> dict:
+        dep = req["deployment"]
+        rid = req["router_id"]
+        with self._lock:
+            f = self._serve_fleets.setdefault(
+                dep, {"epoch": 0, "members": []}
+            )
+            if rid in f["members"]:
+                f["members"] = [m for m in f["members"] if m != rid]
+                f["epoch"] = int(f["epoch"]) + 1
+                self._wal(
+                    (
+                        "serve_fleet",
+                        {
+                            "deployment": dep,
+                            "epoch": f["epoch"],
+                            "members": list(f["members"]),
+                        },
+                    )
+                )
+            (self._serve_budget.get(dep) or {}).pop(rid, None)
+            reply = {"epoch": f["epoch"], "members": list(f["members"])}
+        self._wal_flush()
+        return reply
+
+    def _h_serve_assignment(self, req: dict) -> dict:
+        with self._lock:
+            f = self._serve_fleets.get(req["deployment"]) or {
+                "epoch": 0,
+                "members": [],
+            }
+            return {"epoch": f["epoch"], "members": list(f["members"])}
+
+    def _h_serve_stream_acquire(self, req: dict) -> dict:
+        dep = req["deployment"]
+        with self._lock:
+            stale = self._serve_fence_locked(dep, req.get("epoch", 0))
+            if stale is not None:
+                return stale
+            sid = req["stream_id"]
+            row = self._serve_streams.get(sid) or {
+                "stream_id": sid,
+                "deployment": dep,
+                "tenant": req.get("tenant", "default"),
+                "delivered": 0,
+            }
+            row["router_id"] = req["router_id"]
+            row["delivered"] = max(
+                int(row.get("delivered", 0)),
+                int(req.get("delivered", 0)),
+            )
+            row["ts"] = time.time()
+            self._serve_streams[sid] = row
+            self._wal(("serve_stream", dict(row)))
+            reply = {"row": dict(row)}
+        self._wal_flush()
+        return reply
+
+    def _h_serve_stream_ckpt(self, req: dict) -> dict:
+        dep = req["deployment"]
+        rid = req["router_id"]
+        with self._lock:
+            stale = self._serve_fence_locked(dep, req.get("epoch", 0))
+            if stale is not None:
+                return stale
+            applied = 0
+            for sid, delivered in (req.get("ckpts") or {}).items():
+                row = self._serve_streams.get(sid)
+                if row is None or row.get("router_id") != rid:
+                    # the stream moved to a sibling after this batch was
+                    # cut: its checkpoint is stale, drop it
+                    continue
+                nxt = max(int(row.get("delivered", 0)), int(delivered))
+                if nxt == row.get("delivered"):
+                    continue
+                row["delivered"] = nxt
+                row["ts"] = time.time()
+                # one WAL record per stream id: the replication layer
+                # shards records by stream_id, a batched record could
+                # not be routed to owner shards
+                self._wal(
+                    (
+                        "serve_stream_ckpt",
+                        {
+                            "stream_id": sid,
+                            "delivered": nxt,
+                            "router_id": rid,
+                        },
+                    )
+                )
+                applied += 1
+            reply = {"ok": True, "applied": applied}
+        self._wal_flush()
+        return reply
+
+    def _h_serve_stream_release(self, req: dict) -> dict:
+        with self._lock:
+            dropped = 0
+            for sid in req.get("stream_ids") or ():
+                if self._serve_streams.pop(sid, None) is not None:
+                    self._wal(("serve_stream_gone", sid))
+                    dropped += 1
+            reply = {"ok": True, "dropped": dropped}
+        self._wal_flush()
+        return reply
+
+    def _h_serve_stream_lookup(self, req: dict) -> dict:
+        with self._lock:
+            row = self._serve_streams.get(req.get("stream_id", ""))
+            return {"row": dict(row) if row else None}
+
+    def _h_serve_budget(self, req: dict) -> dict:
+        """Budget reconciliation: fold this router's per-tenant usage/
+        demand report in, prune stale or deposed reporters, and hand
+        back its share of the GLOBAL admission rate (∝ summed WFQ
+        weights of its active tenants) plus the cluster-headroom bit
+        that fixes shed retry hints."""
+        from ray_tpu.serve.fleet import compute_budget_shares
+
+        dep = req["deployment"]
+        rid = req["router_id"]
+        window = max(0.05, float(cfg.serve_budget_reconcile_s))
+        with self._lock:
+            stale = self._serve_fence_locked(dep, req.get("epoch", 0))
+            if stale is not None:
+                return stale
+            members = set(
+                (self._serve_fleets.get(dep) or {}).get("members", ())
+            )
+            reports = self._serve_budget.setdefault(dep, {})
+            reports[rid] = {
+                "usage": dict(req.get("usage") or {}),
+                "waiting": dict(req.get("waiting") or {}),
+                "weights": dict(req.get("weights") or {}),
+                "ts": time.monotonic(),
+            }
+            now = time.monotonic()
+            for other in list(reports):
+                if other not in members or now - reports[other][
+                    "ts"
+                ] > max(3.0, 4 * window):
+                    del reports[other]
+            shares = compute_budget_shares(
+                reports,
+                float(cfg.serve_admission_qps),
+                float(cfg.serve_admission_burst),
+                window,
+            )
+            share = shares.get(rid) or {
+                "rate": 0.0,
+                "burst": float(cfg.serve_admission_burst),
+                "headroom": True,
+            }
+            return {**share, "window_s": window}
+
     def _h_query_state(self, req: dict) -> Any:
         kind = req.get("kind", "summary")
         if kind == "explain_placement":
@@ -5561,7 +5842,16 @@ class HeadServer:
                     blob["reporter"] = cid
                     blob["age_s"] = round(now - entry["ts"], 2)
                     deployments[dep] = blob
-                return {"deployments": deployments}
+                return {
+                    "deployments": deployments,
+                    # router-fleet assignment tables: epoch + member
+                    # list per deployment (the ring derives from these)
+                    "fleets": {
+                        dep: dict(f)
+                        for dep, f in self._serve_fleets.items()
+                    },
+                    "stream_leases": len(self._serve_streams),
+                }
             if kind == "dispatch":
                 # the task-lease dispatch plane (lease-cached direct
                 # dispatch): active leases + per-owner counts + lifecycle

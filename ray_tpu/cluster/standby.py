@@ -44,6 +44,9 @@ _SHARDED_KINDS = {
     "task_lease_gone": lambda rec: rec[1],
     "peer_link": lambda rec: rec[1]["link_id"],
     "peer_link_gone": lambda rec: rec[1],
+    "serve_stream": lambda rec: rec[1]["stream_id"],
+    "serve_stream_ckpt": lambda rec: rec[1]["stream_id"],
+    "serve_stream_gone": lambda rec: rec[1],
 }
 
 
@@ -99,6 +102,8 @@ class StandbyHead:
         self._task_leases: ShardedTable = ShardedTable(n)
         self._peer_links: ShardedTable = ShardedTable(n)
         self._pending_revokes: Dict[str, dict] = {}
+        self._serve_fleets: Dict[str, dict] = {}
+        self._serve_streams: ShardedTable = ShardedTable(n)
         self.metrics = {
             "wal_applied": 0,
             "snapshots_installed": 0,
@@ -181,6 +186,12 @@ class StandbyHead:
             rid: dict(row)
             for rid, row in snap.get("pending_revokes", {}).items()
         }
+        self._serve_fleets = {
+            dep: dict(f) for dep, f in snap.get("serve_fleets", {}).items()
+        }
+        self._serve_streams = ShardedTable(self._num_shards)
+        for row in snap.get("serve_streams", []):
+            self._serve_streams[row["stream_id"]] = dict(row)
         if "epoch" in snap:
             self.leader_epoch = max(
                 self.leader_epoch, int(snap.get("epoch", 0))
@@ -306,6 +317,25 @@ class StandbyHead:
             self._pending_revokes[rec[1]["revoke_id"]] = dict(rec[1])
         elif kind == "revoke_done":
             self._pending_revokes.pop(rec[1], None)
+        elif kind == "serve_fleet":
+            row = rec[1]
+            self._serve_fleets[row["deployment"]] = {
+                "epoch": int(row.get("epoch", 0)),
+                "members": list(row.get("members", ())),
+            }
+        elif kind == "serve_stream":
+            self._serve_streams[rec[1]["stream_id"]] = dict(rec[1])
+        elif kind == "serve_stream_ckpt":
+            row = self._serve_streams.get(rec[1]["stream_id"])
+            if row is not None:
+                row["delivered"] = max(
+                    int(row.get("delivered", 0)),
+                    int(rec[1].get("delivered", 0)),
+                )
+                if rec[1].get("router_id"):
+                    row["router_id"] = rec[1]["router_id"]
+        elif kind == "serve_stream_gone":
+            self._serve_streams.pop(rec[1], None)
 
     # -- promotion -------------------------------------------------------
     def tables_snapshot(self) -> dict:
@@ -339,6 +369,13 @@ class StandbyHead:
                     rid: dict(r)
                     for rid, r in self._pending_revokes.items()
                 },
+                "serve_fleets": {
+                    dep: dict(f)
+                    for dep, f in self._serve_fleets.items()
+                },
+                "serve_streams": [
+                    dict(r) for r in self._serve_streams.values()
+                ],
             }
 
     def promote(
@@ -572,6 +609,8 @@ class StandbyHead:
                     "task_leases": len(self._task_leases),
                     "peer_links": len(self._peer_links),
                     "pending_revokes": len(self._pending_revokes),
+                    "serve_fleets": len(self._serve_fleets),
+                    "serve_streams": len(self._serve_streams),
                 },
             }
 

@@ -917,6 +917,33 @@ define(
     "SLO autoscaler control-loop tick.",
 )
 define(
+    "serve_routers",
+    1,
+    "Ingress router replicas per deployment (the router fleet). Tenants "
+    "map to routers by consistent hash; each router runs its own "
+    "admission shard and push sink. 1 = the single-router layout.",
+)
+define(
+    "serve_ring_vnodes",
+    64,
+    "Virtual nodes per router on the tenant->router consistent-hash "
+    "ring (higher = smoother ranges, slower ring rebuild).",
+)
+define(
+    "serve_budget_reconcile_s",
+    0.25,
+    "Router-fleet budget reconcile period: each router reports per-"
+    "tenant usage/demand and receives its share of the global admission "
+    "rate (and flushes stream delivered-count checkpoints).",
+)
+define(
+    "serve_stream_ckpt_every",
+    8,
+    "Delivered-count checkpoint granularity for fleet streams: a "
+    "stream's row is re-checkpointed to the head once it advanced this "
+    "many deltas since the last flush (finished streams always flush).",
+)
+define(
     "serve_drain_timeout_s",
     30.0,
     "Graceful-drain budget for a retiring replica: in-flight streams "

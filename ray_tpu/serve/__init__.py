@@ -24,5 +24,16 @@ from .deployment import (  # noqa: F401
     start_http_proxy,
     start_proto_grpc_ingress,
 )
-from .router import RoutedStream, ServeRouter  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetStream,
+    HashRing,
+    RouterDeposedError,
+    RouterFleet,
+)
+from .router import (  # noqa: F401
+    RoutedStream,
+    RouterKilled,
+    ServeRouter,
+    StreamRedirected,
+)
 from .slo_autoscaler import SLOAutoscaler, SLOConfig  # noqa: F401

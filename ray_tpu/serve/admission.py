@@ -155,6 +155,14 @@ class AdmissionController:
         self._seq = itertools.count()
         self.sheds = 0
         self.admitted = 0
+        # fleet-shard state (router fleet): per-tenant admits since the
+        # last reconcile drain, plus the head's last global-budget word
+        # (is there cluster-wide headroom, and how soon does the next
+        # reconcile re-split rates) — used to fix the retry hint when
+        # the LOCAL bucket is dry but the GLOBAL budget is not
+        self._usage: Dict[str, int] = {}
+        self._global_headroom = False
+        self._reconcile_window_s = 0.0
 
     def _weight(self, tenant: str) -> float:
         return max(1e-6, float(self._weights.get(tenant, 1.0)))
@@ -211,6 +219,7 @@ class AdmissionController:
             self._granted_pending -= 1
         self._inflight += 1
         self.admitted += 1
+        self._usage[tenant] = self._usage.get(tenant, 0) + 1
         SERVE_QUEUE_DEPTH.set(self._inflight)
         return Ticket(self, tenant)
 
@@ -265,15 +274,22 @@ class AdmissionController:
                 self._granted_pending -= 1
                 self._inflight += 1
                 self.admitted += 1
+                self._usage[waiter.tenant] = (
+                    self._usage.get(waiter.tenant, 0) + 1
+                )
                 SERVE_QUEUE_DEPTH.set(self._inflight)
                 return Ticket(self, waiter.tenant)
             self._abandon_locked(waiter)
         self.sheds += 1
         SERVE_SHED.inc(labels={"reason": reason})
-        raise Overloaded(
-            reason,
-            retry_after_s=max(0.1, self._bucket.next_available_s()),
-        )
+        hint = self._bucket.next_available_s()
+        if self._global_headroom and hint > self._reconcile_window_s > 0:
+            # this shard's bucket is dry but the CLUSTER budget is not:
+            # the next reconcile re-splits rates toward this router's
+            # demand, so the honest backoff is one reconcile window —
+            # not the local bucket's (misleadingly long) refill time
+            hint = self._reconcile_window_s
+        raise Overloaded(reason, retry_after_s=max(0.1, hint))
 
     def _abandon_locked(self, waiter: _Waiter) -> None:
         if waiter.abandoned:
@@ -297,6 +313,56 @@ class AdmissionController:
             SERVE_QUEUE_DEPTH.set(self._inflight)
             self._pump_locked()
             self._cv.notify_all()
+
+    # -- fleet sharding (router fleet budget reconciliation) -----------
+    def set_rate(self, rate: float, burst: Optional[float] = None) -> None:
+        """Re-split: adopt this shard's share of the global admission
+        rate. Accrued tokens are clamped to the new burst so a shrinking
+        share cannot be spent from the old allowance."""
+        with self._cv:
+            bucket = self._bucket
+            bucket._refill(bucket._clock())
+            bucket.rate = float(rate)
+            if burst is not None:
+                bucket.burst = max(1.0, float(burst))
+            bucket._tokens = min(bucket._tokens, bucket.burst)
+            self._pump_locked()
+            self._cv.notify_all()
+
+    def note_global_budget(
+        self, headroom: bool, reconcile_window_s: float
+    ) -> None:
+        """The head's last budget word: whether the CLUSTER-wide rate
+        has headroom, and how long until the next re-split. Shapes the
+        :class:`Overloaded` retry hint (see ``_shed_locked``)."""
+        with self._cv:
+            self._global_headroom = bool(headroom)
+            self._reconcile_window_s = float(reconcile_window_s)
+
+    def take_usage(self) -> Dict[str, int]:
+        """Per-tenant admits since the last call (reconcile report);
+        drains the counters."""
+        with self._cv:
+            usage, self._usage = self._usage, {}
+            return usage
+
+    def waiting_by_tenant(self) -> Dict[str, int]:
+        """Parked demand per tenant (reconcile report)."""
+        with self._cv:
+            return {
+                t: sum(1 for w in q if not w.abandoned)
+                for t, q in self._queues.items()
+                if q
+            }
+
+    def set_tenant_weights(self, weights: Dict[str, float]) -> None:
+        with self._cv:
+            self._weights = dict(weights or {})
+
+    @property
+    def tenant_weights(self) -> Dict[str, float]:
+        with self._cv:
+            return dict(self._weights)
 
     # -- observability -------------------------------------------------
     def stats(self) -> dict:

@@ -39,6 +39,10 @@ class Deployment:
     resumable_streams: bool = False
     stats_method: Optional[str] = None
     slo: Optional[Any] = None
+    # per-tenant WFQ weights, enforced CLUSTER-WIDE by the router
+    # fleet's budget reconciliation (a weight-3 tenant drains ~3x a
+    # weight-1 tenant even when their streams land on different routers)
+    tenant_weights: Optional[Dict[str, float]] = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -54,6 +58,7 @@ class Deployment:
             self.resumable_streams,
             self.stats_method,
             self.slo,
+            dict(self.tenant_weights) if self.tenant_weights else None,
         )
         for k, v in overrides.items():
             setattr(d, k, v)
@@ -496,12 +501,16 @@ def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
         return DeploymentHandle(_apps[key])
     rs = _ReplicaSet(app)
     _apps[key] = rs
-    # the serving router (lease-routed ingress path): created alongside
-    # every deployment; ingresses and handles that want admission/
-    # streaming/failover semantics go through it via get_router()
-    from .router import ServeRouter
+    # the ingress router fleet (horizontally scaled front door):
+    # cfg.serve_routers ServeRouter replicas behind a consistent-hash
+    # tenant assignment, sharded admission reconciled to the global
+    # budget, token-exact cross-router stream failover. Duck-types the
+    # single-router surface, so get_router() callers are unchanged;
+    # with serve_routers=1 this IS the old layout plus a one-entry
+    # assignment table.
+    from .fleet import RouterFleet
 
-    router = ServeRouter(rs)
+    router = RouterFleet(rs)
     _routers[key] = router
     # deployments that declare a stats method (e.g. the LLM servers'
     # serve_stats: engine + prefix-cache counters) get it sampled into
@@ -534,8 +543,10 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 
 def get_router(name: str):
-    """The deployment's ServeRouter (admission + lease-routed dispatch +
-    push-plane streaming)."""
+    """The deployment's ingress :class:`~.fleet.RouterFleet` (admission
+    + lease-routed dispatch + push-plane streaming + cross-router
+    failover). Router-protocol compatible with the old single
+    ServeRouter."""
     return _routers[name]
 
 

@@ -96,6 +96,25 @@ class ChannelClosed(Exception):
     both to this one)."""
 
 
+class StreamRedirected(ChannelClosed):
+    """Typed redirect: a push landed on a sink that no longer owns the
+    stream's hash range (its router was deposed or replaced). The writer
+    must stop generating — the fleet re-dispatches the stream on the
+    sibling that inherited the range."""
+
+    def __init__(self, msg: str, epoch: int = 0):
+        super().__init__(msg)
+        self.epoch = int(epoch)
+
+
+class RouterKilled(RuntimeError):
+    """The ingress ROUTER owning this stream died (chaos router_kill /
+    abrupt teardown) — not a replica death. Replica-level failover must
+    not fire; recovery is fleet-level: the sibling inheriting the
+    tenant's hash range re-dispatches with ``resume_from`` taken from
+    the replicated stream-lease table."""
+
+
 def _is_closed_exc(exc: BaseException) -> bool:
     from ray_tpu.experimental import ChannelClosed as _CC
 
@@ -143,6 +162,7 @@ class _SinkStream:
         self._cv = threading.Condition()
         self._closed = False
         self.cancelled = False
+        self._error: Optional[BaseException] = None
         self._max = max_buffer
 
     def push(self, seq: int, items: list, closed: bool) -> dict:
@@ -166,10 +186,20 @@ class _SinkStream:
 
     def read(self, timeout: Optional[float] = None):
         with self._cv:
-            if not self._buf and not self._closed and not self.cancelled:
+            if (
+                not self._buf
+                and not self._closed
+                and not self.cancelled
+                and self._error is None
+            ):
                 self._cv.wait(timeout=timeout if timeout is not None else 5.0)
             if self._buf:
                 return self._buf.popleft()
+            if self._error is not None:
+                # transport failed under the reader (router killed):
+                # surface it immediately — waiting out the read window
+                # would eat the whole failover budget doing nothing
+                raise self._error
             if self._closed or self.cancelled:
                 # cancel counts as end-of-stream reader-side too: a
                 # blocked reader must not wait out its window (and then
@@ -183,18 +213,35 @@ class _SinkStream:
             self.cancelled = True
             self._cv.notify_all()
 
+    def fail(self, exc: BaseException) -> None:
+        """Poison the stream: the next (or a blocked) read raises
+        ``exc`` instead of draining the window. Buffered deltas stay
+        readable — they were acked to the writer, and the failover
+        resume point must count them."""
+        with self._cv:
+            self._error = exc
+            self._cv.notify_all()
+
 
 class StreamSink:
-    """Per-process push endpoint for token deltas: replica workers RPC
+    """Per-ROUTER push endpoint for token deltas: replica workers RPC
     ``ServeStreamPush`` batches straight here — the streaming analog of
     the direct-call result push plane (no relay actor, no polling, no
-    head involvement)."""
+    head involvement).
 
-    def __init__(self):
+    Fate-shared with its owning router (``router_id``): closing the
+    router stops the sink, and a DEPOSED router's sink answers every
+    push with a typed redirect (``{"redirect": True}`` →
+    :class:`StreamRedirected` writer-side) instead of silently accepting
+    deltas for hash ranges it no longer owns."""
+
+    def __init__(self, router_id: str = ""):
         from ray_tpu.cluster.rpc import RpcServer
 
+        self.router_id = router_id
         self._lock = threading.Lock()
         self._streams: Dict[str, _SinkStream] = {}
+        self._deposed_epoch: Optional[int] = None
         self._server = RpcServer(
             {"ServeStreamPush": self._h_push, "Ping": lambda r: "pong"},
             port=0,
@@ -219,6 +266,16 @@ class StreamSink:
 
     def _h_push(self, req: dict) -> dict:
         with self._lock:
+            if self._deposed_epoch is not None:
+                # this router lost its hash ranges: a stale replica
+                # still pushing here gets a TYPED redirect, never a
+                # silent accept into a buffer nobody reads
+                return {
+                    "redirect": True,
+                    "epoch": self._deposed_epoch,
+                    "depth": 0,
+                    "cancelled": True,
+                }
             stream = self._streams.get(req["stream_id"])
         if stream is None:
             # unknown/finished stream: tell the writer to stop generating
@@ -226,6 +283,37 @@ class StreamSink:
         return stream.push(
             int(req["seq"]), list(req.get("items") or ()), bool(req.get("closed"))
         )
+
+    def depose(self, epoch: int) -> None:
+        """The router was replaced at assignment ``epoch``: reject every
+        further push with a typed redirect and end the registered
+        streams (their consumers re-dispatch through the new owner)."""
+        with self._lock:
+            self._deposed_epoch = int(epoch)
+            streams, self._streams = list(self._streams.values()), {}
+        for s in streams:
+            s.fail(
+                RouterKilled(
+                    f"router {self.router_id or '?'} deposed at "
+                    f"assignment epoch {epoch}"
+                )
+            )
+
+    def chaos_kill(self) -> None:
+        """Abrupt router death (chaos ``router_kill``): the RPC endpoint
+        vanishes mid-push and every registered stream FAILS (not a clean
+        close — a killed router's streams must not masquerade as
+        complete). Writers see the sink unreachable and stop
+        generating, exactly the SIGKILL shape."""
+        with self._lock:
+            streams, self._streams = list(self._streams.values()), {}
+        try:
+            self._server.stop()
+        except Exception:  # noqa: BLE001 - already down
+            pass
+        rid = self.router_id or "?"
+        for s in streams:
+            s.fail(RouterKilled(f"router {rid} killed mid-stream"))
 
     def stop(self) -> None:
         with self._lock:
@@ -241,6 +329,9 @@ _sink: Optional[StreamSink] = None
 
 
 def stream_sink() -> StreamSink:
+    """Back-compat process-wide sink. Routers own their sinks now
+    (``ServeRouter._own_sink`` — fate-shared lifecycle); this singleton
+    remains only for callers that predate the fleet."""
     global _sink
     with _sink_lock:
         if _sink is None:
@@ -304,6 +395,11 @@ class PushWriter:
             # ingress gone: stop generating (same contract as a closed ring)
             raise _CC(f"serve stream sink unreachable: {exc!r}") from exc
         self._seq += 1
+        if reply.get("redirect"):
+            raise StreamRedirected(
+                "serve stream sink deposed (hash range moved)",
+                epoch=int(reply.get("epoch") or 0),
+            )
         if reply.get("cancelled") and not closed:
             raise _CC("consumer cancelled the stream")
         depth = int(reply.get("depth") or 0)
@@ -325,6 +421,11 @@ class PushWriter:
                     f"serve stream sink unreachable: {exc!r}"
                 ) from exc
             self._seq += 1
+            if reply.get("redirect"):
+                raise StreamRedirected(
+                    "serve stream sink deposed (hash range moved)",
+                    epoch=int(reply.get("epoch") or 0),
+                )
             if reply.get("cancelled"):
                 raise _CC("consumer cancelled the stream")
             depth = int(reply.get("depth") or 0)
@@ -372,11 +473,23 @@ class RoutedStream:
     to the producing replica. Raises :class:`ChannelClosed` at end of
     stream."""
 
-    def __init__(self, router: "ServeRouter", payload, tenant: str, ticket):
+    def __init__(
+        self,
+        router: "ServeRouter",
+        payload,
+        tenant: str,
+        ticket,
+        resume_base: int = 0,
+    ):
         self._router = router
         self._payload = payload
         self._ticket = ticket
         self.tenant = tenant
+        # deltas already delivered by a PREVIOUS router incarnation
+        # (fleet failover): every dispatch resumes past base+delivered,
+        # so a replica failover after a router failover still skips the
+        # full acked prefix
+        self.resume_base = int(resume_base)
         self.delivered = 0
         self.failovers = 0
         self._t0 = time.monotonic()
@@ -389,7 +502,7 @@ class RoutedStream:
         self._labels = {"deployment": router._rs.dep.name}
         SERVE_STREAMS.inc(labels=self._labels)
         try:
-            self._attach(router._dispatch_stream(payload, 0))
+            self._attach(router._dispatch_stream(payload, self.resume_base))
         except BaseException:
             self._finish("500")
             raise
@@ -499,6 +612,12 @@ class RoutedStream:
             # re-dispatch — a failover here would leak a sink stream
             # nobody reads and wedge a replica slot generating into it
             return False
+        if isinstance(exc, RouterKilled):
+            # the ROUTER died, not the replica: replica-level failover
+            # would re-dispatch through the corpse. Surface the error —
+            # the fleet re-dispatches on the sibling that inherited the
+            # tenant's hash range.
+            return False
         if isinstance(exc, BaseException) and not _is_replica_death(exc):
             return False  # application error from a healthy replica
         if not self._router.resumable:
@@ -512,11 +631,14 @@ class RoutedStream:
         except Exception:  # noqa: BLE001
             pass
         self._router._note_replica_failure(self._replica, exc)
-        # resume_from = deltas ALREADY HANDED to the consumer: the new
+        # resume_from = deltas ALREADY HANDED to the consumer (plus any
+        # prefix a previous router incarnation delivered): the new
         # replica regenerates deterministically and skips exactly those,
         # so acked deltas are neither repeated nor lost
         self._attach(
-            self._router._dispatch_stream(self._payload, self.delivered)
+            self._router._dispatch_stream(
+                self._payload, self.resume_base + self.delivered
+            )
         )
         return True
 
@@ -647,8 +769,10 @@ class ServeRouter:
         self,
         replica_set,
         admission: Optional[AdmissionController] = None,
+        router_id: str = "r0",
     ):
         self._rs = replica_set
+        self.router_id = router_id
         self.admission = admission or controller_from_cfg()
         self.resumable = bool(
             getattr(replica_set.dep, "resumable_streams", False)
@@ -662,6 +786,13 @@ class ServeRouter:
         self._host_cache: dict = {}
         self._hosts = None
         self._closed = False
+        self.killed = False
+        # per-router push sink, built on first streaming dispatch and
+        # fate-shared with this router (close/kill/depose) — a replaced
+        # router's sink must never keep accepting pushes for streams
+        # nobody reads
+        self._sink: Optional[StreamSink] = None
+        self._sink_lock = threading.Lock()
         self._reporter: Optional[threading.Thread] = None
 
     # -- unary ----------------------------------------------------------
@@ -694,10 +825,14 @@ class ServeRouter:
         return self.submit(payload, tenant, method).result(timeout)
 
     # -- streaming ------------------------------------------------------
-    def stream(self, payload, tenant: str = "default") -> RoutedStream:
+    def stream(
+        self, payload, tenant: str = "default", resume_base: int = 0
+    ) -> RoutedStream:
         ticket = self.admission.admit(tenant)
         try:
-            return RoutedStream(self, payload, tenant, ticket)
+            return RoutedStream(
+                self, payload, tenant, ticket, resume_base=resume_base
+            )
         except Overloaded:
             raise
         except BaseException:
@@ -718,7 +853,7 @@ class ServeRouter:
             if dispatched is not None:
                 return dispatched
         if cfg.serve_push_streams:
-            sink = stream_sink()
+            sink = self._own_sink()
             sid, stream = sink.open()
             writer = PushWriter(sink.address, sid)
             try:
@@ -805,6 +940,20 @@ class ServeRouter:
 
         return ch.reader, ref, replica, cleanup
 
+    def _own_sink(self) -> StreamSink:
+        """This router's push endpoint (lazy — unary-only deployments
+        never pay for the RpcServer). Fate-shared: close()/chaos_kill()/
+        depose() act on it, unlike the old process-wide singleton whose
+        lifetime nobody owned."""
+        with self._sink_lock:
+            if self._sink is None:
+                if self._closed:
+                    raise RouterKilled(
+                        f"router {self.router_id} is closed"
+                    )
+                self._sink = StreamSink(router_id=self.router_id)
+            return self._sink
+
     def _same_host_pred(self):
         from .proxy import _local_hosts, same_host_predicate
 
@@ -859,6 +1008,7 @@ class ServeRouter:
         misses = SERVE_LEASE_MISSES.value(self._labels)
         return {
             "deployment": self._rs.dep.name,
+            "router_id": self.router_id,
             "replicas": replicas,
             "codes": codes,
             "admission": self.admission.stats(),
@@ -915,4 +1065,34 @@ class ServeRouter:
         self._reporter.start()
 
     def close(self) -> None:
+        """Graceful teardown; the sink fate-shares (satellite of the old
+        leaked-singleton bug: a replaced router's sink kept accepting
+        pushes forever)."""
         self._closed = True
+        with self._sink_lock:
+            sink, self._sink = self._sink, None
+        if sink is not None:
+            sink.stop()
+
+    def depose(self, epoch: int) -> None:
+        """This router lost its hash ranges at assignment ``epoch``:
+        further pushes get a typed redirect, registered streams end with
+        :class:`RouterKilled` so their consumers re-dispatch through the
+        new owner."""
+        self._closed = True
+        with self._sink_lock:
+            sink = self._sink
+        if sink is not None:
+            sink.depose(epoch)
+
+    def chaos_kill(self) -> None:
+        """Abrupt death for chaos ``router_kill``: the push endpoint
+        vanishes, in-flight streams FAIL (no clean close), admission
+        state is lost with the process — the SIGKILL shape for an
+        in-process router."""
+        self.killed = True
+        self._closed = True
+        with self._sink_lock:
+            sink, self._sink = self._sink, None
+        if sink is not None:
+            sink.chaos_kill()
