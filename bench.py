@@ -867,6 +867,15 @@ def tpu_tiers_child() -> None:
     def mark(stage: str, payload: dict) -> None:
         print(f"MARK:{stage}:" + json.dumps(payload), flush=True)
 
+    # wedge forensics: when a stage hangs (accelerator transport wedge),
+    # periodically dump every thread's stack to stderr — the parent's
+    # stderr tail then shows WHERE the child sat when it was killed,
+    # instead of the bare "stage exceeded its budget" epitaph
+    trace_s = float(os.environ.get("RAY_TPU_BENCH_CHILD_TRACE_S", "0") or 0)
+    if trace_s > 0:
+        import faulthandler
+
+        faulthandler.dump_traceback_later(trace_s, repeat=True)
     try:
         import jax
 
@@ -904,6 +913,13 @@ def _run_tpu_child(env_extra: dict, budgets: dict) -> tuple:
 
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ, **env_extra)
+    # stack-dump cadence: just inside the tightest stage budget, so a
+    # wedged stage writes at least one all-thread traceback to stderr
+    # before the parent kills it
+    env.setdefault(
+        "RAY_TPU_BENCH_CHILD_TRACE_S",
+        str(max(5.0, min(budgets.values()) * 0.8)),
+    )
     stderr_f = tempfile.TemporaryFile(mode="w+")
     proc = subprocess.Popen(
         [sys.executable, "-c", "import bench; bench.tpu_tiers_child()"],
@@ -972,6 +988,10 @@ def _device_preflight(timeout_s: float = 10.0) -> tuple:
     import sys
 
     code = (
+        # dump all stacks just before the parent's kill so the skip
+        # reason names the wedged frame, not just the timeout
+        "import faulthandler\n"
+        f"faulthandler.dump_traceback_later({max(2.0, timeout_s - 2.0)})\n"
         "import jax, jax.numpy as jnp, numpy as np\n"
         "x = jnp.arange(8.0)\n"
         "y = jax.jit(lambda a: (a * 2.0).sum())(x)\n"
@@ -985,10 +1005,26 @@ def _device_preflight(timeout_s: float = 10.0) -> tuple:
             timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        err = exc.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        wedged_at = ""
+        if err:
+            # the probe's faulthandler dump fired before the kill: name
+            # the innermost main-thread frame (dumps are most-recent-
+            # call-first) so the skip reason says WHERE it hung
+            lines = err.splitlines()
+            for i, ln in enumerate(lines):
+                if "most recent call first" in ln:
+                    for nxt in lines[i + 1 :]:
+                        if 'File "' in nxt:
+                            wedged_at = f"; wedged at {nxt.strip()[:160]}"
+                            break
+                    break
         return False, (
             f"device preflight timed out after {timeout_s:.0f}s "
-            "(accelerator transport wedged)"
+            f"(accelerator transport wedged{wedged_at})"
         )
     except OSError as exc:
         return False, f"device preflight could not launch: {exc!r}"
@@ -1023,6 +1059,7 @@ class _TpuTiers:
         self.failure = None
         self.skip_reason = None  # last device-preflight failure, if any
         self.tail = ""
+        self.bundle_paths: list = []  # crash bundles captured on wedges
         self.spent_s = 0.0
         # total wall-clock across ALL attempts: a backend that comes up
         # but wedges INSIDE the kernel/model stages would otherwise burn
@@ -1036,6 +1073,28 @@ class _TpuTiers:
         return payload is None or any(
             k in payload for k in ("error", "kernel_error", "model_error")
         )
+
+    def _wedge_bundle(self, label: str, reason: str, tail: str = "") -> None:
+        """Capture the wedge as a crash bundle (PR 15 flight recorder):
+        the preflight/stage failure, the child's stderr tail (with the
+        faulthandler stack dump of the wedged frame), and this process's
+        span timeline. Best-effort — forensics must never fail a bench."""
+        try:
+            from ray_tpu.util import flight_recorder
+
+            path = flight_recorder.dump_bundle(
+                "tpu_tier_wedge",
+                extra_meta={
+                    "attempt": label,
+                    "reason": reason,
+                    "stderr_tail": (tail or "")[-2000:],
+                },
+                force=True,
+            )
+            if path:
+                self.bundle_paths.append(path)
+        except Exception:  # noqa: BLE001 - forensics only
+            pass
 
     def kernel_ok(self) -> bool:
         return not self._stage_bad(self.marks.get("KERNEL"))
@@ -1079,6 +1138,7 @@ class _TpuTiers:
                     "outcome": f"skipped by preflight: {reason}",
                 }
             )
+            self._wedge_bundle(label, f"preflight: {reason}")
             return
         env = {}
         budgets = {
@@ -1110,6 +1170,7 @@ class _TpuTiers:
         if failure:
             self.failure = failure
             self.tail = tail or self.tail
+            self._wedge_bundle(label, failure, tail)
 
     def cpu_fallback_kernel(self) -> dict:
         """The identical kernel workload on XLA:CPU in a guarded child —
@@ -1146,6 +1207,8 @@ class _TpuTiers:
             out["tpu_tier_skipped_reason"] = self.skip_reason
         if not self.done() and self.tail:
             out["tpu_stderr_tail"] = self.tail[-800:]
+        if self.bundle_paths:
+            out["tpu_tier_wedge_bundles"] = self.bundle_paths
         if not self.kernel_ok():
             out["kernel_cpu_fallback"] = self.cpu_fallback_kernel()
         return out
@@ -1458,6 +1521,155 @@ def _make_block(n_elem: int):
     import numpy as np
 
     return np.arange(n_elem, dtype=np.float64)
+
+
+def _make_device_block(n_f32: int):
+    import jax.numpy as jnp
+
+    # stays device-resident: the worker's return seal exports it as a
+    # device frame when the plane is on (host-copy reducer when off)
+    return jnp.arange(n_f32, dtype=jnp.float32) * jnp.float32(0.5)
+
+
+def _pull_device_block(hex_id: str):
+    """Timed END-DEVICE pull: cross-node fetch + land back as jax.Array,
+    measured inside the destination worker (seconds)."""
+    import time as _time
+
+    import jax
+
+    from ray_tpu.cluster import worker as worker_mod
+
+    t0 = _time.perf_counter()
+    v = worker_mod.fetch_into_local_arena(hex_id, land="device")
+    if not isinstance(v, jax.Array):
+        # host-bounce baseline lands host-side; the H2D hop it pays here
+        # is part of what the device plane removes
+        import jax.numpy as jnp
+
+        v = jnp.asarray(v)
+    jax.block_until_ready(v)
+    return _time.perf_counter() - t0
+
+
+def device_xfer_bench() -> dict:
+    """Tier: end-device-to-end-device transfer throughput (device plane).
+
+    A 2-node cluster seals a device-resident ``jax.Array`` on the source
+    node and pulls it from a DESTINATION worker that lands it back as a
+    ``jax.Array`` — the clock runs inside that worker around the whole
+    fetch + device landing, so the number is genuinely end-device to
+    end-device. Measured for 32 MB and a striped 256 MB block (crosses
+    the net_stripe_bytes boundary), each with the device plane on
+    (device frames: zero-copy seal on host-aliasing backends, one
+    device_put landing) and off (host-bounce baseline: cloudpickle's
+    host-copy reducer both ways). The cached destination copy is
+    deleted between pulls so every sample crosses the node boundary.
+
+    Exports ``device_xfer_mb_per_s_{32mb,256mb}`` + the host-bounce
+    ratio. Gate: RAY_TPU_BENCH_DEVICE_XFER_FLOOR_MB_PER_S fails the run
+    loudly when the 32 MB device-plane number regresses below it."""
+    import numpy as _np
+
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.cluster.rpc import RpcClient
+    from ray_tpu.core.runtime import set_runtime
+
+    iters = int(os.environ.get("RAY_TPU_BENCH_DEVICE_XFER_ITERS", "5"))
+    big_mb = int(
+        os.environ.get("RAY_TPU_BENCH_DEVICE_XFER_BIG_MB", "256") or 0
+    )
+
+    def _measure(device_plane: bool) -> dict:
+        import ray_tpu
+
+        # set BEFORE the cluster spawns: the sealing/landing happens in
+        # the WORKERS, which inherit this environment
+        os.environ["RAY_TPU_DEVICE_PLANE"] = "1" if device_plane else "0"
+        cap = max(1 << 28, (big_mb << 20) * 3)
+        cluster = Cluster(use_device_scheduler=False)
+        try:
+            cluster.add_node(
+                {"CPU": 2.0, "srcres": 1.0},
+                num_workers=1,
+                store_capacity=cap,
+            )
+            dst = cluster.add_node(
+                {"CPU": 2.0, "dstres": 1.0},
+                num_workers=1,
+                store_capacity=cap,
+            )
+            rt = cluster.client()
+            set_runtime(rt)
+            try:
+                make = ray_tpu.remote(_make_device_block).options(
+                    resources={"srcres": 0.1}
+                )
+                pull = ray_tpu.remote(_pull_device_block).options(
+                    resources={"dstres": 0.1}
+                )
+                dst_agent = RpcClient(cluster.agent_address(dst))
+
+                def _mb_s(nbytes: int, n_iters: int) -> float:
+                    ref = make.remote(nbytes // 4)
+                    ray_tpu.wait([ref], timeout=300)
+                    samples = []
+                    for _ in range(n_iters + 1):
+                        dt = ray_tpu.get(
+                            pull.remote(ref.hex), timeout=600
+                        )
+                        samples.append(nbytes / dt / 2**20)
+                        # drop the landed copy so the next pull crosses
+                        # the node boundary again
+                        dst_agent.call(
+                            "DeleteObjects",
+                            {"object_ids": [ref.hex]},
+                            timeout=30.0,
+                        )
+                    del ref
+                    return float(_np.median(samples[1:]))
+
+                out = {"mb_s_32mb": round(_mb_s(32 << 20, iters), 1)}
+                if big_mb > 0:
+                    out["mb_s_big"] = round(
+                        _mb_s(big_mb << 20, max(2, iters // 2)), 1
+                    )
+                return out
+            finally:
+                set_runtime(None)
+                rt.shutdown()
+        finally:
+            cluster.shutdown()
+            os.environ.pop("RAY_TPU_DEVICE_PLANE", None)
+
+    out: dict = {}
+    try:
+        dev = _measure(device_plane=True)
+        bounce = _measure(device_plane=False)
+        out["device_xfer_mb_per_s_32mb"] = dev["mb_s_32mb"]
+        out["device_xfer_host_bounce_mb_per_s_32mb"] = bounce["mb_s_32mb"]
+        out["device_xfer_vs_host_bounce_32mb"] = round(
+            dev["mb_s_32mb"] / max(bounce["mb_s_32mb"], 1e-9), 2
+        )
+        if "mb_s_big" in dev:
+            out["device_xfer_mb_per_s_256mb"] = dev["mb_s_big"]
+            out["device_xfer_host_bounce_mb_per_s_256mb"] = bounce.get(
+                "mb_s_big"
+            )
+            out["device_xfer_striped_mb"] = big_mb
+    except Exception as exc:  # noqa: BLE001 - other tiers still publish
+        out["device_xfer_error"] = repr(exc)
+        return out
+    floor = float(
+        os.environ.get("RAY_TPU_BENCH_DEVICE_XFER_FLOOR_MB_PER_S", "0")
+        or 0.0
+    )
+    if floor > 0:
+        out["device_xfer_floor_mb_per_s"] = floor
+        out["device_xfer_floor_ok"] = bool(
+            out["device_xfer_mb_per_s_32mb"] >= floor
+        )
+    return out
 
 
 def shuffle_bench() -> dict:
@@ -2509,6 +2721,11 @@ def main():
             cluster.update(xnode_transfer_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["xnode_transfer_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_DEVICE_XFER", "1") != "0":
+        try:
+            cluster.update(device_xfer_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["device_xfer_error"] = repr(exc)
     if os.environ.get("RAY_TPU_BENCH_SHUFFLE", "1") != "0":
         try:
             cluster.update(shuffle_bench())
@@ -2591,6 +2808,7 @@ def main():
         or out.get("router_scale_ok") is False
         or out.get("router_failover_ok") is False
         or out.get("xnode_floor_ok") is False
+        or out.get("device_xfer_floor_ok") is False
         or out.get("shuffle_floor_ok") is False
         or out.get("failover_p95_ok") is False
         or out.get("elastic_retention_ok") is False

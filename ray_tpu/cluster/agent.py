@@ -2995,6 +2995,12 @@ class NodeAgent:
 
         return FETCH_GATE.snapshot()
 
+    @staticmethod
+    def _device_plane_block() -> dict:
+        from ray_tpu.cluster import device_plane
+
+        return device_plane.debug_block()
+
     def _object_plane_state(self) -> dict:
         from ray_tpu.native.spill import SHM_EVICTIONS
 
@@ -3007,7 +3013,14 @@ class NodeAgent:
             "chunked_pulls_inflight": int(CHUNKED_PULLS_INFLIGHT.value()),
             "transfer_bytes": {
                 path: int(OBJECT_TRANSFER_BYTES.value({"path": path}))
-                for path in ("shm", "shm_copy", "inline", "rpc", "socket")
+                for path in (
+                    "shm",
+                    "shm_copy",
+                    "inline",
+                    "rpc",
+                    "socket",
+                    "device",
+                )
             },
             "transfer_chunk_ms": TRANSFER_CHUNK_MS.summary(),
             "transfer_stripe_ms": TRANSFER_STRIPE_MS.summary(),
@@ -3017,6 +3030,10 @@ class NodeAgent:
             # space; nonzero after every reader released (or died and had
             # its pin log replayed) is a leak — the chaos soak asserts 0
             "arena_zombies": self.store.zombie_count(),
+            # device-direct data plane: seal/land counters + whether the
+            # plane is active in THIS process (workers land device-side;
+            # the agent itself only ever stages host frames)
+            "device": self._device_plane_block(),
             # cross-node data plane: this node's stripe server + its
             # cached peer links and the grant/reuse/revoke lifecycle
             # (process-wide counters, like every metric here)

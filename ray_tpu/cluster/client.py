@@ -3035,12 +3035,19 @@ class RemoteRuntime:
             src_node=self.client_id,
         )
 
-    def _socket_fetch(self, nid: str, h: str) -> "Optional[memoryview]":
+    def _socket_fetch(
+        self, nid: str, h: str, land: "Optional[str]" = "device"
+    ) -> "Optional[memoryview]":
         """Socket pull of one object from a node's data server. None =
         plane unavailable for this transfer (caller uses the FetchObject
         RPC); KeyError propagates (definite miss — the caller prunes the
         location). Returns a READ-ONLY view: numpy payloads deserialize
-        as immutable views exactly like the RPC path's bytes reply."""
+        as immutable views exactly like the RPC path's bytes reply.
+
+        ``land='device'`` (default) streams landed stripes device-side
+        in flight when the backend has a real H2D hop, so device frames
+        in the payload deserialize against warm pages — gets always
+        deserialize under device landing, so the overlap is free."""
         from ray_tpu.config import cfg
 
         if not cfg.native_net:
@@ -3052,7 +3059,9 @@ class RemoteRuntime:
         if link is None:
             return None
         try:
-            return memoryview(_fetch_bytes(link, h, purpose="get")).toreadonly()
+            return memoryview(
+                _fetch_bytes(link, h, purpose="get", land=land)
+            ).toreadonly()
         except KeyError:
             raise
         except LinkRejectedError:

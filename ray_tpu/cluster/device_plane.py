@@ -1,0 +1,685 @@
+"""Device-direct data plane: seal, ship, and land device-resident
+tensors without the host bounce.
+
+Every cross-node move of a ``jax.Array`` used to pay
+HBM→host-pickle-copy→arena→socket→arena→host-copy→HBM: cloudpickle's
+default jax reducer materializes a FULL host copy of the tensor inside
+the pickle pass, and the receive side reconstructs another host copy
+before ``device_put``. This module removes both copies by teaching the
+RTP5 wire format (``cluster/serialization.py``) about **device
+frames**:
+
+- **Seal side** — :class:`DeviceAwarePickler` intercepts sealable
+  ``jax.Array`` leaves in ``reducer_override`` and reduces them to
+  ``(_land_device_leaf, (meta, PickleBuffer(view)))`` where ``view`` is
+  a dlpack/``__array__`` export of the device buffer. On the CPU
+  backend the exported pointer IS the device buffer (zero-copy — the
+  tier-1-testable path); on accelerator backends the export is one
+  bounded D2H readout, chunked through :class:`DeviceChunkPump` so the
+  readout overlaps with the arena write / ``sendmsg`` stripes instead
+  of materializing the whole tensor first. The PickleBuffer rides the
+  existing out-of-band frame machinery, so arena puts scatter-gather
+  the device bytes with ONE copy and socket sends gather them straight
+  from the arena.
+- **Land side** — ``_land_device_leaf`` is an ordinary module function
+  referenced from the pickle stream, so every transport that carries
+  RTP5 frames (shm views, socket stripes, chunked RPC, spill files)
+  lands device frames with no format change and no version bump: the
+  degradation ladder device-frame → host-arena → chunked-RPC is the
+  ladder the object plane already has. Landing honours the process's
+  :func:`landing` mode: ``"device"`` (default) issues ``device_put``
+  straight from the arriving buffer (arena view / socket landing zone —
+  no intermediate host copy); ``"host"`` returns the read-only host
+  view for consumers that re-export (servers, spill).
+- **Overlap** — :class:`DeviceLandingZone` wraps a staged arena entry
+  on the socket receive path (``fetch_to_store(land="device")``): as
+  disjoint stripes land, completed chunks of the contiguous prefix are
+  ``device_put`` in flight, overlapping H2D with the remaining recv.
+  Aborts drop the partial device buffers AND the staged pages
+  (``abort_put``), and per-stripe retry/resume still works because the
+  zone only consumes contiguous-prefix bytes.
+
+Kill switch: ``RAY_TPU_DEVICE_PLANE=0`` disables frame interception and
+landing zones everywhere; sealed device frames remain loadable (the
+land function stays importable) and land host-side. The seam —
+descriptor here, D2H/H2D pump here + transport.py, landing in
+shm_store/net — is deliberately the shape a future RDMA/dmabuf backend
+swaps into: replace the export/landing pair, keep the frame format.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# hot-path counters (plain-int increments, wire.py contract: rate
+# indicators whose flat-vs-nonzero proof is race-safe)
+# ---------------------------------------------------------------------------
+_stats = {
+    "device_frame_seals_total": 0,  # jax leaves sealed as device frames
+    "device_frame_zero_copy_total": 0,  # of which the export aliased HBM
+    "device_frame_lands_total": 0,  # leaves landed (any mode)
+    "device_frame_lands_device_total": 0,  # of which landed on-device
+    "device_frame_bytes_total": 0,  # payload bytes moved as device frames
+    "device_pump_chunks_total": 0,  # chunked D2H pump chunks drained
+    "device_land_chunks_total": 0,  # landing-zone H2D chunks issued
+}
+
+
+def device_stats() -> dict:
+    return dict(_stats)
+
+
+def publish_device_metrics() -> dict:
+    """Sync the hot-path counters into the metrics registry (called from
+    observability surfaces, never the data path itself)."""
+    from ray_tpu.util.metrics import sync_counter
+
+    for name, v in _stats.items():
+        sync_counter(name, v, "Device-direct data plane frame events.")
+    return device_stats()
+
+
+def device_plane_enabled() -> bool:
+    """Kill switch (RAY_TPU_DEVICE_PLANE, read live) AND jax present."""
+    try:
+        from ray_tpu.config import cfg
+
+        if not cfg.device_plane:
+            return False
+    except Exception:  # noqa: BLE001 - config unavailable (bootstrap)
+        import os
+
+        if os.environ.get("RAY_TPU_DEVICE_PLANE", "1").lower() in (
+            "0",
+            "false",
+            "no",
+        ):
+            return False
+    return _jax() is not None
+
+
+def _jax():
+    """jax, or None — cached per process (import is the expensive bit)."""
+    global _JAX, _JAX_TRIED
+    if not _JAX_TRIED:
+        _JAX_TRIED = True
+        try:
+            import jax as _j
+
+            _JAX = _j
+        except ImportError:
+            _JAX = None
+    return _JAX
+
+
+_JAX = None
+_JAX_TRIED = False
+
+
+# ---------------------------------------------------------------------------
+# sealability + export
+# ---------------------------------------------------------------------------
+
+
+def is_device_array(value: Any) -> bool:
+    jax = _jax()
+    return jax is not None and isinstance(value, jax.Array)
+
+
+def is_sealable_device_array(value: Any) -> bool:
+    """True when ``value`` is a concrete single-shard ``jax.Array`` the
+    device plane can export as one frame. Tracers, multi-device-sharded
+    and non-addressable arrays fall through to jax's own reducer (which
+    understands shardings) — the plane never changes semantics, only
+    the copy count."""
+    jax = _jax()
+    if jax is None or not isinstance(value, jax.Array):
+        return False
+    if isinstance(value, jax.core.Tracer):
+        return False
+    try:
+        if not value.is_fully_addressable:
+            return False
+        if len(value.sharding.device_set) != 1:
+            return False
+        if value.size == 0:
+            return False  # jax's own path; nothing to win on 0 bytes
+    except Exception:  # noqa: BLE001 - deleted/donated buffer
+        return False
+    return True
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """dtype by NAME, resolving ml_dtypes extension types (bfloat16,
+    float8_*) that have no loadable numpy ``.str`` form."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def export_device_view(arr) -> Tuple[np.ndarray, bool]:
+    """``(host_ndarray, zero_copy)`` for a sealable device array.
+
+    dlpack first: on the CPU backend the exported pointer IS the device
+    buffer, so the seal is genuinely zero-copy. Extension dtypes
+    (bfloat16, float8) and backends whose buffers are not
+    host-addressable fall back to ``__array__`` (one D2H readout). The
+    returned ndarray keeps the device buffer alive (dlpack capsule /
+    jax's cached host value), which is exactly the lifetime the seal's
+    gather-copy needs."""
+    try:
+        host = np.from_dlpack(arr)
+        zero_copy = True
+    except Exception:  # noqa: BLE001 - dtype/backend without dlpack
+        host = np.asarray(arr)
+        zero_copy = False
+        try:
+            # jax CPU arrays alias through __array__ too — detect so the
+            # zero-copy counter reflects what actually happened
+            zero_copy = (
+                host.ctypes.data == arr.unsafe_buffer_pointer()
+            )
+        except Exception:  # noqa: BLE001 - backend without raw pointers
+            pass
+    if not host.flags.c_contiguous:
+        host = np.ascontiguousarray(host)
+        zero_copy = False
+    return host, zero_copy
+
+
+# ---------------------------------------------------------------------------
+# landing mode (thread-local: fetch paths scope it around deserialize)
+# ---------------------------------------------------------------------------
+
+_LANDING = threading.local()
+
+
+def landing_mode() -> str:
+    return getattr(_LANDING, "mode", "device")
+
+
+@contextlib.contextmanager
+def landing(mode: str):
+    """Scope the device-frame landing mode for deserialization on this
+    thread: ``"device"`` (default) lands leaves as ``jax.Array`` via
+    ``device_put`` straight from the arriving buffer; ``"host"`` returns
+    read-only host views (consumers that re-export or run jax-free)."""
+    if mode not in ("device", "host"):
+        raise ValueError(f"unknown landing mode {mode!r}")
+    prev = getattr(_LANDING, "mode", None)
+    _LANDING.mode = mode
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _LANDING.mode
+        else:
+            _LANDING.mode = prev
+
+
+def _land_device_leaf(meta: dict, buf) -> Any:
+    """Reconstruct one device frame. Referenced BY NAME from pickle
+    streams — its module path is wire format; do not move or rename.
+
+    ``buf`` arrives as a zero-copy memoryview slice of the incoming
+    frame (PEP 574), an arena view, or in-band bytes. Device landing is
+    ONE ``device_put`` from that buffer — the only host→device hop; no
+    intermediate host copy ever exists on this path."""
+    host = np.frombuffer(buf, dtype=resolve_dtype(meta["d"])).reshape(
+        meta["s"]
+    )
+    _stats["device_frame_lands_total"] += 1
+    _stats["device_frame_bytes_total"] += host.nbytes
+    try:
+        from ray_tpu.cluster.object_plane import OBJECT_TRANSFER_BYTES
+
+        OBJECT_TRANSFER_BYTES.inc(host.nbytes, labels={"path": "device"})
+    except Exception:  # noqa: BLE001 - metrics are optional at land time
+        pass
+    jax = _jax()
+    # the kill switch disables DEVICE behavior end to end: with the
+    # plane off, frames sealed earlier (or by a peer with it on) still
+    # load, but land host-side
+    if jax is None or landing_mode() == "host" or not device_plane_enabled():
+        return host  # read-only view over the backing buffer
+    _stats["device_frame_lands_device_total"] += 1
+    out = jax.device_put(host)
+    # jax's transfer machinery keeps the device_put SOURCE alive until
+    # the copy is marked complete and a later dispatch drains the
+    # keepalive; here that source is a view over the incoming frame
+    # (often an arena page), so without an explicit flush the pin
+    # outlives the deserialize and a concurrent delete zombies the page.
+    # Queue the landed array for flush_landing_keepalive (wire.loads
+    # calls it once per deserialize) — queuing, not blocking here, keeps
+    # H2D transfers of sibling leaves overlapped.
+    pending = getattr(_LANDING, "pending", None)
+    if pending is None:
+        pending = _LANDING.pending = []
+    pending.append(out)
+    return out
+
+
+_FLUSH_SRC = np.zeros(1, dtype=np.uint8)
+
+
+def flush_landing_keepalive() -> None:
+    """Release jax's keepalive refs on this deserialize's view-backed
+    ``device_put`` sources: block until every landed array's transfer is
+    marked complete, then issue one trivial dispatch to drain the
+    keepalive queue (entries only release on a dispatch AFTER their
+    transfer completes). Called by the wire layer after each
+    deserialize; no-op (one thread-local read) when nothing landed."""
+    pending = getattr(_LANDING, "pending", None)
+    if not pending:
+        return
+    _LANDING.pending = []
+    jax = _jax()
+    if jax is None:  # pragma: no cover - queue only fills after a land
+        return
+    try:
+        jax.block_until_ready(pending)
+        # the drain dispatch's own source is this module-level constant:
+        # it takes over the keepalive slot and pins nothing
+        jax.device_put(_FLUSH_SRC)
+    except Exception:  # noqa: BLE001 - backend torn down mid-shutdown
+        pass
+
+
+def landing_zone_worthwhile() -> bool:
+    """Whether a socket fetch should overlap H2D with recv via a
+    :class:`DeviceLandingZone`. True on non-host-aliasing backends
+    (there is a real H2D hop to hide); on the CPU backend the arena IS
+    host memory, so in-flight device_put of raw frame bytes would add a
+    copy instead of hiding one — gate it off unless
+    ``RAY_TPU_DEVICE_LAND_ALWAYS`` forces it (tests / A-B)."""
+    if not device_plane_enabled():
+        return False
+    try:
+        from ray_tpu.config import cfg
+
+        if cfg.device_land_always:
+            return True
+    except Exception:  # noqa: BLE001 - config unavailable
+        pass
+    jax = _jax()
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 - no devices
+        return False
+
+
+# ---------------------------------------------------------------------------
+# seal side: the device-aware pickler
+# ---------------------------------------------------------------------------
+
+
+def make_device_reducer(pump_threshold: Optional[int] = None):
+    """Reducer for sealable jax leaves, shaped for ``reducer_override``.
+
+    Leaves at or above ``pump_threshold`` bytes on a non-host-aliasing
+    backend read out through :class:`DeviceChunkPump` (chunked
+    ``copy_to_host_async``, overlapping readout with the consumer's
+    gather-copy); below it, one plain export."""
+    import pickle
+
+    from ray_tpu.config import cfg
+
+    threshold = (
+        int(cfg.device_pump_min_bytes)
+        if pump_threshold is None
+        else pump_threshold
+    )
+
+    def _reduce(arr):
+        meta = {"d": arr.dtype.name, "s": list(arr.shape)}
+        if arr.nbytes >= threshold:
+            host, zero_copy = _pumped_export(arr)
+        else:
+            host, zero_copy = export_device_view(arr)
+        _stats["device_frame_seals_total"] += 1
+        if zero_copy:
+            _stats["device_frame_zero_copy_total"] += 1
+        # frames travel as raw bytes: extension dtypes (bfloat16,
+        # float8) have no buffer-protocol format char, and meta already
+        # carries dtype by name — a uint8 view is always exportable and
+        # stays zero-copy (contiguity is guaranteed by the export)
+        raw = host.reshape(-1).view(np.uint8)
+        return _land_device_leaf, (meta, pickle.PickleBuffer(raw))
+
+    return _reduce
+
+
+def _pumped_export(arr) -> Tuple[np.ndarray, bool]:
+    """Export via the chunked D2H pump when the buffer does NOT alias
+    host memory; zero-copy exports skip the pump entirely (there is no
+    readout to overlap)."""
+    host, zero_copy = export_device_view(arr)
+    if zero_copy:
+        return host, True
+    pump = DeviceChunkPump(arr)
+    return pump.gather(), False
+
+
+class DeviceAwarePickler:
+    """Mixin factory: builds a CloudPickler subclass whose
+    ``reducer_override`` seals jax leaves as device frames. Constructed
+    lazily (cloudpickle import stays off the module import path)."""
+
+    _cls = None
+
+    @classmethod
+    def pickler_class(cls):
+        if cls._cls is None:
+            import cloudpickle
+
+            class _P(cloudpickle.CloudPickler):
+                _device_reduce: Optional[Callable] = None
+
+                def reducer_override(self, obj):
+                    red = self._device_reduce
+                    if red is not None and is_sealable_device_array(obj):
+                        return red(obj)
+                    return super().reducer_override(obj)
+
+            cls._cls = _P
+        return cls._cls
+
+
+def dumps_oob(obj: Any, protocol: int, buffer_callback) -> bytes:
+    """Device-aware drop-in for ``cloudpickle.dumps(obj, protocol,
+    buffer_callback=...)``: jax leaves seal as device frames when the
+    plane is enabled; everything else (and the disabled path) follows
+    cloudpickle exactly."""
+    import io
+
+    import cloudpickle
+
+    if not device_plane_enabled():
+        return cloudpickle.dumps(
+            obj, protocol=protocol, buffer_callback=buffer_callback
+        )
+    f = io.BytesIO()
+    p = DeviceAwarePickler.pickler_class()(
+        f, protocol=protocol, buffer_callback=buffer_callback
+    )
+    p._device_reduce = make_device_reducer()
+    p.dump(obj)
+    return f.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# chunked D2H pump (seal side, non-host-aliasing backends)
+# ---------------------------------------------------------------------------
+
+
+class DeviceChunkPump:
+    """Chunked ``copy_to_host_async`` readout of one device array.
+
+    Splits the flattened array into ``chunk_bytes`` windows, keeps up to
+    ``depth`` async D2H copies in flight, and yields host chunks in
+    order — the consumer (arena gather-copy / socket send loop) works on
+    chunk *k* while chunks *k+1..k+depth* read out. The whole tensor is
+    never materialized host-side ahead of its consumer; records one
+    ``d2h_overlap_ms`` span per drained pump."""
+
+    def __init__(
+        self,
+        arr,
+        chunk_bytes: Optional[int] = None,
+        depth: Optional[int] = None,
+    ):
+        from ray_tpu.config import cfg
+
+        self.arr = arr
+        self.chunk_bytes = max(
+            1 << 20,
+            int(cfg.device_pump_chunk_bytes)
+            if chunk_bytes is None
+            else chunk_bytes,
+        )
+        self.depth = max(
+            1, int(cfg.device_pump_depth) if depth is None else depth
+        )
+
+    def chunks(self) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield ``(byte_offset, host_chunk)`` in order with D2H
+        lookahead."""
+        arr = self.arr
+        itemsize = arr.dtype.itemsize
+        per_chunk = max(1, self.chunk_bytes // itemsize)
+        flat = arr.reshape(-1)
+        n = flat.shape[0]
+        t0 = time.time()
+        tp0 = time.perf_counter()
+        pending: List[Tuple[int, Any]] = []
+        issued = 0
+        while issued < n or pending:
+            while issued < n and len(pending) < self.depth:
+                part = flat[issued : issued + per_chunk]
+                try:
+                    part.copy_to_host_async()
+                except Exception:  # noqa: BLE001 - backend without async
+                    pass
+                pending.append((issued, part))
+                issued += min(per_chunk, n - issued)
+            off, part = pending.pop(0)
+            _stats["device_pump_chunks_total"] += 1
+            yield off * itemsize, np.asarray(part)
+        try:
+            from ray_tpu.util.tracing import SPANS
+
+            SPANS.record(
+                "d2h_overlap_ms",
+                "device_plane",
+                t0,
+                time.perf_counter() - tp0,
+                bytes=int(arr.nbytes),
+                chunks=int(-(-n // per_chunk)),
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+
+    def gather(self) -> np.ndarray:
+        """Drain the pump into one contiguous host ndarray (callers that
+        need the whole buffer; streamed consumers iterate chunks())."""
+        out = np.empty(self.arr.shape, dtype=self.arr.dtype)
+        flat = out.reshape(-1).view(np.uint8)
+        raw = flat if flat.nbytes == out.nbytes else out.reshape(-1)
+        dst = memoryview(out).cast("B") if out.nbytes else memoryview(b"")
+        del raw
+        for off, chunk in self.chunks():
+            cb = memoryview(np.ascontiguousarray(chunk)).cast("B")
+            dst[off : off + cb.nbytes] = cb
+        return out
+
+
+# ---------------------------------------------------------------------------
+# landing zone (receive side: H2D overlapped with recv)
+# ---------------------------------------------------------------------------
+
+
+class DeviceLandingZone:
+    """Overlaps H2D with an in-flight striped socket receive.
+
+    Wraps the staged host destination (an unsealed arena entry or a
+    bytearray view). ``note_stripe(off, n)`` is called from the fetch
+    loop as each disjoint stripe lands; whenever a full
+    ``chunk_bytes`` window of the CONTIGUOUS PREFIX has landed, the
+    zone issues an async ``device_put`` of that window so the H2D hop
+    rides under the remaining recv. ``finish()`` blocks until every
+    issued chunk is device-resident and records the ``h2d_overlap_ms``
+    span; ``abort()`` drops partial device buffers (their backing host
+    pages are freed separately via ``abort_put``).
+
+    The prefetched device chunks WARM the transfer (and are the whole
+    result for raw single-tensor pulls, ``chunks()``); pickled objects
+    still deserialize from the host staging view — their leaves'
+    ``device_put`` then reads pages that are hot."""
+
+    def __init__(self, dest, chunk_bytes: Optional[int] = None):
+        from ray_tpu.config import cfg
+
+        self.dest = dest
+        self.total = dest.nbytes
+        self.chunk_bytes = max(
+            1 << 20,
+            int(cfg.device_land_chunk_bytes)
+            if chunk_bytes is None
+            else chunk_bytes,
+        )
+        self._lock = threading.Lock()
+        self._landed: List[Tuple[int, int]] = []  # merged [off, end) spans
+        self._shipped = 0  # contiguous prefix bytes already device_put
+        self._chunks: List[Any] = []  # device chunks, in prefix order
+        self._aborted = False
+        self._t0 = time.time()
+        self._tp0 = time.perf_counter()
+        self._h2d_s = 0.0
+
+    # -- stripe accounting ---------------------------------------------
+    def note_stripe(self, off: int, n: int) -> None:
+        if n <= 0:
+            return
+        jax = _jax()
+        with self._lock:
+            if self._aborted:
+                return
+            self._merge(off, off + n)
+            prefix = self._prefix()
+            while (
+                jax is not None
+                and prefix - self._shipped >= self.chunk_bytes
+            ) or (prefix >= self.total and self._shipped < self.total):
+                a = self._shipped
+                b = min(a + self.chunk_bytes, prefix, self.total)
+                if b <= a:
+                    break
+                t0 = time.perf_counter()
+                if jax is not None:
+                    host = np.frombuffer(self.dest[a:b], dtype=np.uint8)
+                    # async: device_put returns immediately, the copy
+                    # overlaps with the next stripes' recv
+                    self._chunks.append(jax.device_put(host))
+                    _stats["device_land_chunks_total"] += 1
+                self._h2d_s += time.perf_counter() - t0
+                self._shipped = b
+
+    def _merge(self, a: int, b: int) -> None:
+        spans = self._landed
+        spans.append((a, b))
+        spans.sort()
+        merged = [spans[0]]
+        for s, e in spans[1:]:
+            ls, le = merged[-1]
+            if s <= le:
+                merged[-1] = (ls, max(le, e))
+            else:
+                merged.append((s, e))
+        self._landed = merged
+
+    def _prefix(self) -> int:
+        if not self._landed or self._landed[0][0] != 0:
+            return 0
+        return self._landed[0][1]
+
+    # -- completion ----------------------------------------------------
+    def finish(self) -> List[Any]:
+        """Block until every issued chunk is device-resident; returns
+        the ordered device chunks (uint8, covering the whole object for
+        a fully-landed transfer)."""
+        with self._lock:
+            # a transfer smaller than one chunk (or whose tail stripe
+            # was the last to land) ships its remainder here
+            jax = _jax()
+            if (
+                jax is not None
+                and not self._aborted
+                and self._shipped < self.total
+                and self._prefix() >= self.total
+            ):
+                t0 = time.perf_counter()
+                host = np.frombuffer(
+                    self.dest[self._shipped : self.total], dtype=np.uint8
+                )
+                self._chunks.append(jax.device_put(host))
+                _stats["device_land_chunks_total"] += 1
+                self._h2d_s += time.perf_counter() - t0
+                self._shipped = self.total
+            chunks = list(self._chunks)
+        jax = _jax()
+        if jax is not None and chunks:
+            t0 = time.perf_counter()
+            jax.block_until_ready(chunks)
+            self._h2d_s += time.perf_counter() - t0
+        try:
+            from ray_tpu.util.tracing import SPANS
+
+            SPANS.record(
+                "h2d_overlap_ms",
+                "device_plane",
+                self._t0,
+                time.perf_counter() - self._tp0,
+                bytes=int(self.total),
+                chunks=len(chunks),
+                h2d_ms=round(self._h2d_s * 1e3, 3),
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+        return chunks
+
+    def abort(self) -> None:
+        """Drop partial device buffers. The staged HOST pages are the
+        caller's to free (``store.abort_put`` — the zone never owns
+        them), so an aborted device landing leaks neither side."""
+        with self._lock:
+            self._aborted = True
+            chunks, self._chunks = self._chunks, []
+        for c in chunks:
+            try:
+                c.delete()
+            except Exception:  # noqa: BLE001 - already deleted/donated
+                pass
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "total": self.total,
+                "prefix": self._prefix(),
+                "shipped": self._shipped,
+                "chunks": len(self._chunks),
+                "aborted": self._aborted,
+            }
+
+
+def assemble_device_tensor(
+    chunks: Sequence[Any], dtype_name: str, shape: Sequence[int]
+):
+    """Reassemble a device tensor from a landing zone's ordered uint8
+    chunks — concatenate + bitcast + reshape run ON DEVICE, so the raw
+    single-tensor receive path (rdt) never builds a second host copy."""
+    jax = _jax()
+    import jax.numpy as jnp
+
+    assert jax is not None
+    flat = chunks[0] if len(chunks) == 1 else jnp.concatenate(list(chunks))
+    dt = resolve_dtype(dtype_name)
+    return jax.lax.bitcast_convert_type(
+        flat.reshape(-1, dt.itemsize), dt
+    ).reshape(tuple(shape)) if dt.itemsize > 1 else flat.view(dt).reshape(
+        tuple(shape)
+    )
+
+
+def debug_block() -> dict:
+    """DebugState ``object_plane.device`` block (agent/worker surfaces)."""
+    out = {"enabled": device_plane_enabled()}
+    out.update(device_stats())
+    return out

@@ -59,6 +59,12 @@ def publish_dark_plane() -> None:
 
     wire_mod.publish_wire_metrics()
     try:
+        from ray_tpu.cluster import device_plane
+
+        device_plane.publish_device_metrics()
+    except Exception:  # noqa: BLE001 - device plane is optional
+        pass
+    try:
         from ray_tpu.native import counters as dark
 
         dark.publish()

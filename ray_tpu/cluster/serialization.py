@@ -40,6 +40,8 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ray_tpu.cluster import device_plane
+
 MAGIC = b"RTP5"
 _HDR = struct.Struct("<HHQ")  # version, nbufs, pickle_len
 _LEN = struct.Struct("<Q")
@@ -194,7 +196,13 @@ def _pickle_oob(obj: Any) -> Tuple[bytes, List[memoryview]]:
         buffers.append(raw)
         return False  # carried out-of-band
 
-    pkl = cloudpickle.dumps(obj, protocol=5, buffer_callback=_cb)
+    # device-aware front half: sealable jax.Array leaves reduce to
+    # device frames (PickleBuffer exports of the device buffer) instead
+    # of cloudpickle's full host-copy reducer; the PickleBuffers flow
+    # through _cb like any other out-of-band buffer, so device frames
+    # ride RTP5 unchanged and every transport/degradation rung below
+    # this line (arena, socket, chunked RPC, spill) works untouched.
+    pkl = device_plane.dumps_oob(obj, protocol=5, buffer_callback=_cb)
     return pkl, buffers
 
 
@@ -323,7 +331,13 @@ def loads(data) -> Any:
     if mv.nbytes < 4 or bytes(mv[:4]) != MAGIC:
         return pickle.loads(mv)
     pkl, bufs = _parse_frame(mv)
-    return pickle.loads(pkl, buffers=bufs)
+    try:
+        return pickle.loads(pkl, buffers=bufs)
+    finally:
+        # device frames landed during this deserialize leave their
+        # view-backed source in jax's transfer keepalive — evict it so
+        # the arena pin dies with the views, not at the next dispatch
+        device_plane.flush_landing_keepalive()
 
 
 def frames_total(parts: Sequence[Any]) -> int:

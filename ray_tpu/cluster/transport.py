@@ -577,6 +577,7 @@ def _fetch(
     purpose: str,
     alloc: Callable[[int], memoryview],
     deadline: Optional[float] = None,
+    on_stripe: Optional[Callable[[int, int], None]] = None,
 ) -> int:
     """Striped pull of one object over ``link`` into ``alloc(total)``.
 
@@ -586,6 +587,12 @@ def _fetch(
     out over up to net_stripe_conns parallel connections; each failed
     stripe resumes ALONE on a fresh connection (bounded retries), and a
     byte-capped semaphore backpressures the fan-out into the arena.
+
+    ``on_stripe(off, n)`` fires after each stripe has FULLY landed in
+    the destination (never for a partial recv — a severed stripe
+    re-fetches before it is ever reported), so consumers like the
+    device landing zone can overlap H2D with the remaining recv. It is
+    called from the stripe worker threads and must be thread-safe.
 
     Raises KeyError (peer answered: object gone), LinkRejectedError
     (handshake refused: drop the cached link) or StripeFetchError
@@ -624,6 +631,8 @@ def _fetch(
                 dest = alloc(total)
             if plen:
                 conn.recv_exact_into(dest[:plen])
+                if on_stripe is not None:
+                    on_stripe(0, plen)
             break
         except KeyError:
             link.give_back(conn)  # healthy connection, definite miss
@@ -704,6 +713,8 @@ def _fetch(
                         f"stripe {off}: got {got} bytes, wanted {n}"
                     )
                 my_conn.recv_exact_into(dest[off : off + n])
+                if on_stripe is not None:
+                    on_stripe(off, n)
                 TRANSFER_STRIPE_MS.observe((time.perf_counter() - ts) * 1e3)
                 return my_conn
             except (KeyError, LinkRejectedError):
@@ -753,16 +764,34 @@ def _fetch(
     return total
 
 
+def _maybe_landing_zone(land: Optional[str], dest: memoryview):
+    """A DeviceLandingZone over ``dest`` when ``land='device'`` asks for
+    H2D/recv overlap AND the backend has a real H2D hop to hide (see
+    device_plane.landing_zone_worthwhile); None otherwise."""
+    if land != "device":
+        return None
+    from ray_tpu.cluster import device_plane
+
+    if not device_plane.landing_zone_worthwhile():
+        return None
+    return device_plane.DeviceLandingZone(dest)
+
+
 def fetch_bytes(
     link: PeerLink,
     object_id: str,
     purpose: str = "task_args",
     deadline: Optional[float] = None,
+    land: Optional[str] = None,
 ) -> bytearray:
     """Pull one object over the link into host memory (driver-side /
-    arena-less callers)."""
+    arena-less callers). ``land='device'`` additionally streams landed
+    stripes to the device in flight (device landing zone) so the
+    deserialize-time ``device_put`` of device frames reads warm pages —
+    a no-op on host-aliasing backends where no H2D hop exists."""
     out: List[bytearray] = []
     gated = [0]
+    zone: List[object] = [None]
 
     def alloc(total: int) -> memoryview:
         gated[0] = FETCH_GATE.acquire(
@@ -773,10 +802,23 @@ def fetch_bytes(
         )
         buf = bytearray(total)
         out.append(buf)
-        return memoryview(buf)
+        mv = memoryview(buf)
+        zone[0] = _maybe_landing_zone(land, mv)
+        return mv
+
+    def on_stripe(off: int, n: int) -> None:
+        z = zone[0]
+        if z is not None:
+            z.note_stripe(off, n)
 
     try:
-        _fetch(link, object_id, purpose, alloc, deadline)
+        _fetch(link, object_id, purpose, alloc, deadline, on_stripe)
+        if zone[0] is not None:
+            zone[0].finish()
+    except BaseException:
+        if zone[0] is not None:
+            zone[0].abort()
+        raise
     finally:
         FETCH_GATE.release(gated[0])
     return out[0]
@@ -788,6 +830,7 @@ def fetch_to_store(
     store,
     purpose: str = "task_args",
     deadline: Optional[float] = None,
+    land: Optional[str] = None,
 ) -> int:
     """Pull one object over the link and land it in the local store.
 
@@ -797,9 +840,17 @@ def fetch_to_store(
     aborted transfer frees its staged pages. When the arena cannot host
     the object even after eviction, stripes land in host memory and the
     joined bytes take ``put_bytes`` (which owns the spill fallback).
-    Returns the object's size."""
+
+    ``land='device'`` wraps the staged entry in a device landing zone:
+    completed stripes of the contiguous prefix are ``device_put`` in
+    flight so the consumer's deserialize-time H2D overlaps the recv. An
+    abort frees BOTH sides — partial device buffers (zone.abort) and
+    staged arena pages (abort_put) — and per-stripe resume is
+    unaffected because the zone only ever consumes fully-landed
+    disjoint stripes. Returns the object's size."""
     state: Dict[str, object] = {}
     gated = [0]
+    zone: List[object] = [None]
 
     def alloc(total: int) -> memoryview:
         # cross-fetch byte gate BEFORE staging arena pages: concurrent
@@ -825,13 +876,24 @@ def fetch_to_store(
         if staged is None:
             buf = bytearray(total)
             state["buf"] = buf
-            return memoryview(buf)
-        state["staged"] = True
+            staged = memoryview(buf)
+        else:
+            state["staged"] = True
+        zone[0] = _maybe_landing_zone(land, staged)
         return staged
 
+    def on_stripe(off: int, n: int) -> None:
+        z = zone[0]
+        if z is not None:
+            z.note_stripe(off, n)
+
     try:
-        total = _fetch(link, object_id, purpose, alloc, deadline)
+        total = _fetch(link, object_id, purpose, alloc, deadline, on_stripe)
+        if zone[0] is not None:
+            zone[0].finish()
     except BaseException:
+        if zone[0] is not None:
+            zone[0].abort()
         if state.get("staged"):
             store.abort_put(object_id)
         raise

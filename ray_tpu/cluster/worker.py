@@ -2037,16 +2037,27 @@ def seal_local_value(value: Any, owner: str = "") -> Optional[str]:
     return hex_id
 
 
-def fetch_into_local_arena(hex_id: str, timeout: float = 60.0) -> Any:
+def fetch_into_local_arena(
+    hex_id: str, timeout: float = 60.0, land: str = "device"
+) -> Any:
     """Pull ``hex_id`` through THIS worker's agent so a copy lands in
     the local arena and the head directory gains a second location
     (buddy replication for elastic state shards; the pull itself rides
     the socket plane / chunked fallback like any located fetch).
-    Returns the deserialized value. Raises when not inside a worker."""
+    Returns the deserialized value. Raises when not inside a worker.
+
+    ``land`` picks the device-frame landing mode for the deserialize:
+    ``"device"`` (default) lands jax leaves back on device with one
+    ``device_put`` straight from the arena view — no intermediate host
+    copy; ``"host"`` returns read-only host views (callers that only
+    re-export, e.g. buddy replication without consumption)."""
     w = _CURRENT_WORKER
     if w is None:
         raise RuntimeError("fetch_into_local_arena: not inside a worker")
-    return w.get_object(hex_id, timeout=timeout)
+    from ray_tpu.cluster.device_plane import landing
+
+    with landing(land):
+        return w.get_object(hex_id, timeout=timeout)
 
 
 def main() -> None:

@@ -619,6 +619,53 @@ define(
     "proceeds alone. 0 disables the gate.",
 )
 define(
+    "device_plane",
+    True,
+    "Device-direct data plane: jax.Array leaves seal as device frames "
+    "(dlpack/__array__ export riding RTP5 out-of-band buffers — on the "
+    "CPU backend the export aliases the device buffer, zero-copy) and "
+    "land via device_put straight from the arriving arena view / socket "
+    "landing zone, skipping the host-bounce copy on both sides. Off: "
+    "jax leaves ride cloudpickle's stock reducer (full host copy in the "
+    "pickle pass) and land host-side — the pre-device-plane behaviour. "
+    "Read live; sealed device frames remain loadable either way.",
+)
+define(
+    "device_pump_min_bytes",
+    8 << 20,
+    "Device arrays at or above this size on a non-host-aliasing backend "
+    "read out through the chunked copy_to_host_async D2H pump "
+    "(overlapping readout with the arena gather / socket send) instead "
+    "of one monolithic export.",
+)
+define(
+    "device_pump_chunk_bytes",
+    4 << 20,
+    "Chunk size of the D2H pump (device_pump_min_bytes); each chunk is "
+    "one copy_to_host_async window.",
+)
+define(
+    "device_pump_depth",
+    4,
+    "Max in-flight async D2H chunks the pump keeps ahead of its "
+    "consumer.",
+)
+define(
+    "device_land_chunk_bytes",
+    4 << 20,
+    "Device landing zone H2D chunk size: during a striped socket fetch "
+    "with land=device, each completed chunk of the contiguous prefix is "
+    "device_put in flight, overlapping H2D with the remaining recv.",
+)
+define(
+    "device_land_always",
+    False,
+    "Force the device landing zone even on host-aliasing backends (CPU) "
+    "where the overlap hides nothing — test / A-B hook; production "
+    "leaves this off and the zone activates only when a real H2D hop "
+    "exists.",
+)
+define(
     "peer_link_ttl_s",
     10.0,
     "Renewal horizon of a granted peer data link: agents piggyback "

@@ -587,14 +587,18 @@ class ContinuousBatchingEngine:
         t = len(req.prompt)
         hist_pages = hit.tokens // self.page
         dev_pages = jnp.asarray(pages[:hist_pages], dtype=jnp.int32)
-        # jnp.asarray may alias the pinned host view on the CPU backend;
+        # device-frame hits are ALREADY jax Arrays (landed straight from
+        # the arena page — the device plane removed the intermediate
+        # host copy); host-view hits keep the old path, where
+        # jnp.asarray may alias the pinned view on the CPU backend —
         # safe because every consumer below is synced before release()
-        self.pool.k = self.pool.k.at[:, :, dev_pages].set(
-            jnp.asarray(np.asarray(hit.k))
-        )
-        self.pool.v = self.pool.v.at[:, :, dev_pages].set(
-            jnp.asarray(np.asarray(hit.v))
-        )
+        k_src, v_src = hit.k, hit.v
+        if isinstance(k_src, np.ndarray):
+            k_src = jnp.asarray(np.asarray(k_src))
+        if isinstance(v_src, np.ndarray):
+            v_src = jnp.asarray(np.asarray(v_src))
+        self.pool.k = self.pool.k.at[:, :, dev_pages].set(k_src)
+        self.pool.v = self.pool.v.at[:, :, dev_pages].set(v_src)
         suffix = req.prompt[hit.tokens :]
         ts = len(suffix)
         t_pad = max(self.page, -(-ts // self.page) * self.page)
@@ -632,8 +636,20 @@ class ContinuousBatchingEngine:
             # gather entirely — it's a blocking sync on the admit path
             return
         dev = jnp.asarray(pages[:n_pages], dtype=jnp.int32)
-        k = np.asarray(self.pool.k[:, :, dev])
-        v = np.asarray(self.pool.v[:, :, dev])
+        from ray_tpu.cluster import device_plane as _dp
+
+        if _dp.device_plane_enabled():
+            # the gathered KV block stays a device buffer: the cache's
+            # seal exports it as a device frame (zero-copy where the
+            # backend aliases host memory, chunked D2H pump elsewhere) —
+            # the eager np.asarray device→host sync is gone from the
+            # admit path, and lookups on the other side land the pages
+            # back on device with one device_put
+            k = self.pool.k[:, :, dev]
+            v = self.pool.v[:, :, dev]
+        else:
+            k = np.asarray(self.pool.k[:, :, dev])
+            v = np.asarray(self.pool.v[:, :, dev])
         self.prefix_cache.insert(prompt[:ins], k, v)
 
     def _sample_first(self, req, last_logits, t: int) -> int:

@@ -103,7 +103,19 @@ def decode_tensor(data: bytes) -> Tuple[bool, Any]:
 def put_tensor(value: Any) -> "ray_tpu.ObjectRef":
     """Stage a device/host tensor into the object plane with the raw codec
     (no pickle). Plain ``ray_tpu.put`` works too — this path skips the
-    serializer and keeps dtype/shape as a 1-line header."""
+    serializer and keeps dtype/shape as a 1-line header.
+
+    Device-plane fast path: a sealable ``jax.Array`` skips this codec
+    entirely and seals as a DEVICE FRAME (cluster/device_plane) — the
+    encode here pays ``np.asarray`` + ``tobytes`` (a full host copy of
+    the payload) where the device frame exports the buffer zero-copy on
+    host-aliasing backends and lands back as a ``jax.Array`` with one
+    ``device_put`` straight from the arriving arena view. The codec
+    stays as the fallback for numpy arrays and a disabled plane."""
+    from ray_tpu.cluster import device_plane as _dp
+
+    if _dp.device_plane_enabled() and _dp.is_sealable_device_array(value):
+        return ray_tpu.put(value)
     data = encode_tensor(value)
     if data is None:
         raise TypeError(f"put_tensor expects a jax or numpy array, got {type(value)}")

@@ -73,10 +73,13 @@ def _chain_hashes(tokens: Sequence[int], page: int) -> List[bytes]:
 
 
 class PrefixHit:
-    """One pinned cache hit: ``k``/``v`` are READ-ONLY numpy views over
-    the arena (shape ``[L, KH, pages, page, hd]``) covering ``tokens``
-    prompt tokens. ``release()`` drops the views (and with them the
-    arena pin) once the caller has copied them out."""
+    """One pinned cache hit: ``k``/``v`` cover ``tokens`` prompt tokens
+    with shape ``[L, KH, pages, page, hd]``. For entries sealed as
+    device frames (device-plane inserts) they are already ``jax.Array``
+    — landed with ONE device_put straight from the arena page, no
+    intermediate host copy; host-sealed entries come back as READ-ONLY
+    numpy views over the arena. ``release()`` drops the views (and with
+    them the arena pin) once the caller has copied/consumed them."""
 
     __slots__ = ("tokens", "k", "v", "_view")
 
@@ -85,6 +88,27 @@ class PrefixHit:
         self.k = k
         self.v = v
         self._view = view
+
+    def on_device(self) -> bool:
+        """True when ``k``/``v`` landed as jax Arrays (device frames)."""
+        try:
+            import jax
+
+            return isinstance(self.k, jax.Array)
+        except ImportError:  # pragma: no cover
+            return False
+
+    def to_device(self):
+        """``(k, v)`` device-resident: device-frame hits return their
+        arrays as-is; host-view hits pay the one H2D hop here (after
+        which the caller may ``release()`` — device_put copies)."""
+        if self.on_device():
+            return self.k, self.v
+        import jax
+
+        k, v = jax.device_put(self.k), jax.device_put(self.v)
+        jax.block_until_ready((k, v))
+        return k, v
 
     def release(self) -> None:
         self.k = self.v = self._view = None
@@ -195,8 +219,16 @@ class SharedPrefixCache:
         except Exception:  # noqa: BLE001
             return False
         meta = {"tokens": n, "page": self.page}
+        # numpy blocks need the contiguity fix-up here; jax blocks go in
+        # as-is — the device-aware pickler seals them as device frames
+        # (zero-copy export on host-aliasing backends) and the export
+        # itself owns contiguity
         parts, total = wire.dumps_parts(
-            (meta, np.ascontiguousarray(k), np.ascontiguousarray(v))
+            (
+                meta,
+                np.ascontiguousarray(k) if isinstance(k, np.ndarray) else k,
+                np.ascontiguousarray(v) if isinstance(v, np.ndarray) else v,
+            )
         )
         with self._lock:
             self._evict_locked(self.max_bytes - total)

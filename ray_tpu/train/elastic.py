@@ -467,6 +467,25 @@ def _host_leaves(leaves: List[Any]) -> List[Any]:
     return out
 
 
+def _seal_leaves_device() -> bool:
+    """Whether seal_rank_state may keep jax leaves device-resident: the
+    device plane then seals each as a device frame (zero-copy export on
+    host-aliasing backends, chunked D2H pump elsewhere) instead of the
+    _host_leaves device_get bounce."""
+    from ray_tpu.cluster import device_plane
+
+    return device_plane.device_plane_enabled()
+
+
+def _split_sizes(n: int, parts: int) -> List[int]:
+    """np.array_split's split sizes, computed without materializing the
+    array host-side — the device path MUST cut the exact same
+    boundaries as the host path or regather would frankenstein shards
+    from mixed-format seal waves."""
+    q, r = divmod(n, parts)
+    return [q + 1] * r + [q] * (parts - r)
+
+
 def seal_rank_state(
     state: Any,
     step: int,
@@ -489,20 +508,47 @@ def seal_rank_state(
 
     t0 = time.perf_counter()
     paths, leaves, treedef = tree_paths_and_leaves(state)
-    leaves = _host_leaves(leaves)
+    # device plane on: jax leaves stay device-resident and seal as
+    # device frames — no device_get bounce, no host copy of the payload
+    # (shard slices cut on device along the SAME np.array_split
+    # boundaries, so mixed host/device seal waves regather identically)
+    device_seal = _seal_leaves_device()
+    if not device_seal:
+        leaves = _host_leaves(leaves)
     owned = [v for v in range(virtual_shards) if v % world == rank]
     full: Dict[int, Any] = {}
     sharded: Dict[int, Dict[int, Any]] = {}
     for i, (path, leaf) in enumerate(zip(paths, leaves)):
-        arr = np.asarray(leaf) if not isinstance(leaf, np.ndarray) else leaf
+        if isinstance(leaf, np.ndarray) or hasattr(leaf, "shape"):
+            arr = leaf
+        else:
+            arr = np.asarray(leaf)
         shardable = (
             _matches_any(path, elastic_shard_rules)
             and getattr(arr, "ndim", 0) >= 1
             and arr.shape[0] >= virtual_shards
         )
         if shardable:
-            slices = np.array_split(arr, virtual_shards, axis=0)
-            sharded[i] = {v: np.ascontiguousarray(slices[v]) for v in owned}
+            if device_seal and not isinstance(arr, np.ndarray):
+                # device-side cuts: each slice is its own device buffer
+                # the pickler exports as one frame
+                sizes = _split_sizes(arr.shape[0], virtual_shards)
+                offs = [0]
+                for s in sizes:
+                    offs.append(offs[-1] + s)
+                sharded[i] = {
+                    v: arr[offs[v] : offs[v + 1]] for v in owned
+                }
+            else:
+                host = (
+                    arr
+                    if isinstance(arr, np.ndarray)
+                    else np.asarray(arr)
+                )
+                slices = np.array_split(host, virtual_shards, axis=0)
+                sharded[i] = {
+                    v: np.ascontiguousarray(slices[v]) for v in owned
+                }
         else:
             full[i] = leaf
     payload = {
@@ -525,7 +571,10 @@ def seal_rank_state(
         _LOCAL_SEAL_REFS[ref.hex] = ref
         hex_id = ref.hex
     nbytes = sum(
-        getattr(np.asarray(x), "nbytes", 0) for x in full.values()
+        getattr(x, "nbytes", 0)
+        if hasattr(x, "nbytes")
+        else getattr(np.asarray(x), "nbytes", 0)
+        for x in full.values()
     ) + sum(
         s.nbytes for d in sharded.values() for s in d.values()
     )
@@ -534,17 +583,27 @@ def seal_rank_state(
     return hex_id, owned
 
 
-def fetch_sealed(hex_id: str, timeout: float = 60.0) -> Any:
+def fetch_sealed(
+    hex_id: str, timeout: float = 60.0, land: str = "device"
+) -> Any:
     """Fetch one sealed state payload: inside a worker the pull lands
     in the local arena (second directory location = replication);
-    driver-side it rides the client's located-get (socket plane)."""
+    driver-side it rides the client's located-get (socket plane).
+    ``land="device"`` (default) lands device-frame leaves back as
+    ``jax.Array`` with one device_put straight from the arena view —
+    the regather then concatenates on device; ``land="host"`` keeps the
+    pre-device-plane host views (pure replication pulls)."""
     from ray_tpu.cluster import worker as worker_mod
 
     if getattr(worker_mod, "_CURRENT_WORKER", None) is not None:
-        return worker_mod.fetch_into_local_arena(hex_id, timeout=timeout)
+        return worker_mod.fetch_into_local_arena(
+            hex_id, timeout=timeout, land=land
+        )
+    from ray_tpu.cluster.device_plane import landing
     from ray_tpu.core.object_store import ObjectRef
 
-    return ray_tpu.get(ObjectRef.weak(hex_id), timeout=timeout)
+    with landing(land):
+        return ray_tpu.get(ObjectRef.weak(hex_id), timeout=timeout)
 
 
 def regather_state(payloads: List[dict]) -> Tuple[Any, int]:
@@ -582,9 +641,17 @@ def regather_state(payloads: List[dict]) -> Tuple[Any, int]:
                 f"leaf {ref0['paths'][i]}: virtual shards "
                 f"{sorted(pieces)} of {vshards} available"
             )
-        leaves[i] = np.concatenate(
-            [pieces[v] for v in range(vshards)], axis=0
-        )
+        ordered = [pieces[v] for v in range(vshards)]
+        if any(isinstance(x, jax.Array) for x in ordered):
+            # device-landed shards: concatenate ON DEVICE — the only
+            # host hop the device plane leaves in the regather is gone
+            # (restore's device_put of a jax.Array is a device-side
+            # reshard). Bit-exact: concat moves raw buffers.
+            import jax.numpy as jnp
+
+            leaves[i] = jnp.concatenate(ordered, axis=0)
+        else:
+            leaves[i] = np.concatenate(ordered, axis=0)
     return jax.tree.unflatten(treedef, leaves), int(ref0["step"])
 
 

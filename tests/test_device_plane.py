@@ -1,0 +1,399 @@
+"""Device-direct data plane: seal, ship, and land device tensors.
+
+Covers the device-frame pipeline end to end on the tier-1 (CPU) backend:
+content-exact transfer across the full transport matrix
+(RAY_TPU_NATIVE_NET=0/1 x land=device/host — byte-identical all four
+ways), mid-stripe connection drops resuming without duplicated or
+dropped stripes, the landing zone's in-flight H2D chunks and abort
+cleanup (staged pages AND partial device buffers both freed — the
+zombie-sweep case), non-contiguous and >64-leaf device pytrees,
+extension dtypes (bfloat16), the RDT fast path's content equality, the
+RAY_TPU_DEVICE_PLANE=0 kill switch, elastic reshape regather bit-exact
+over the device plane vs a host-bounce run, and the transfer-keepalive
+regression (a landed value must release its arena pin without waiting
+for a gc cycle — the pin outliving the deserialize turns every
+delete-then-refetch into a zombie stall).
+"""
+import gc
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.cluster import device_plane as dp
+from ray_tpu.cluster import serialization as wire
+from ray_tpu.cluster import transport as tp
+from ray_tpu.native.shm_store import NativeObjectStore
+
+OID = "d" * 28
+
+
+@pytest.fixture()
+def arena():
+    store = NativeObjectStore(
+        path=os.path.join(
+            tempfile.gettempdir(), f"t_dev_{os.getpid()}_{time.time_ns()}.shm"
+        ),
+        capacity=1 << 27,
+    )
+    yield store
+    store.close(unlink=True)
+
+
+@pytest.fixture()
+def served(arena):
+    srv = tp.DataPlaneServer(arena, "nodesrv", "tok-secret", lambda: 100)
+    link = tp.PeerLink(
+        "lk0", "nodesrv", srv.endpoint, "tok-secret", 100, "nodecli"
+    )
+    yield arena, srv, link
+    link.close()
+    srv.close()
+
+
+@pytest.fixture()
+def rt():
+    ray_tpu.init(num_nodes=1, resources_per_node={"CPU": 8})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _device_pytree():
+    """jax leaves exercising the frame format corners: 2-D float32,
+    bfloat16 (no buffer-protocol format char), a transposed
+    non-contiguous view, a 0-d scalar, int8, plus non-tensor metadata."""
+    base = jnp.arange(64 * 48, dtype=jnp.float32).reshape(64, 48)
+    return {
+        "w": base,
+        "bf16": jnp.arange(1000, dtype=jnp.bfloat16),
+        "t": base.T,  # non-contiguous export path
+        "scalar": jnp.float32(3.25),
+        "i8": jnp.arange(256, dtype=jnp.int8) - 128,
+        "meta": {"step": 7, "name": "x"},
+    }
+
+
+def _assert_tree_equal(got, want, on_device):
+    for key in ("w", "bf16", "t", "scalar", "i8"):
+        g, w = got[key], want[key]
+        if on_device:
+            assert isinstance(g, jax.Array), f"{key}: {type(g)}"
+        else:
+            assert isinstance(g, np.ndarray), f"{key}: {type(g)}"
+        assert np.asarray(g).dtype == np.asarray(w).dtype, key
+        assert np.array_equal(
+            np.asarray(g), np.asarray(w), equal_nan=True
+        ), key
+    assert got["meta"] == want["meta"]
+
+
+# ---------------------------------------------------------------------------
+# the 4-way matrix: socket / chunked-rpc framing x device / host landing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("native", [True, False], ids=["socket", "chunked"])
+@pytest.mark.parametrize("land", ["device", "host"])
+def test_seal_land_roundtrip_matrix(served, monkeypatch, native, land):
+    """The same device pytree round-trips byte-identically over the C
+    socket path and the Python/chunked fallback, landing either as
+    ``jax.Array`` (device) or read-only host views (host)."""
+    if not native:
+        monkeypatch.setenv("RAY_TPU_NATIVE_NET", "0")
+    store, srv, link = served
+    tree = _device_pytree()
+    jax.block_until_ready([tree["w"], tree["bf16"], tree["i8"]])
+    seals_before = dp.device_stats()["device_frame_seals_total"]
+    parts, total = wire.dumps_parts(tree)
+    assert dp.device_stats()["device_frame_seals_total"] > seals_before
+    store.put_frames(OID, parts)
+    got = tp.fetch_bytes(link, OID, land=land)
+    assert len(got) == total
+    with dp.landing(land):
+        back = wire.loads(memoryview(got))
+    _assert_tree_equal(back, tree, on_device=(land == "device"))
+
+
+def test_mid_stripe_sever_resumes_device_frames(served, monkeypatch):
+    """Severing the data sockets mid-striped-transfer of a device-frame
+    object re-fetches only the lost stripes: the landed tensor is
+    content-exact (no duplicated or dropped stripes)."""
+    monkeypatch.setenv("RAY_TPU_NET_STRIPE_BYTES", str(1 << 20))
+    monkeypatch.setenv("RAY_TPU_NET_STRIPE_CONNS", "2")
+    store, srv, link = served
+    arr = jnp.asarray(
+        np.random.default_rng(3).standard_normal(3 << 20).astype(np.float32)
+    )
+    jax.block_until_ready(arr)
+    parts, _ = wire.dumps_parts({"arr": arr})
+    store.put_frames(OID, parts)
+    got = {}
+
+    def pull():
+        got["data"] = tp.fetch_bytes(link, OID, land="device")
+
+    t = threading.Thread(target=pull)
+    t.start()
+    for _ in range(3):
+        time.sleep(0.02)
+        srv.chaos_drop()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert srv.stats["chaos_drops"] >= 1
+    back = wire.loads(memoryview(got["data"]))
+    assert isinstance(back["arr"], jax.Array)
+    assert np.array_equal(np.asarray(back["arr"]), np.asarray(arr))
+
+
+def test_striped_fetch_to_store_lands_device(served, monkeypatch):
+    """``fetch_to_store(land='device')`` with the landing zone forced on
+    issues in-flight H2D chunks (counter grows) and still seals a
+    byte-exact arena object that deserializes on-device."""
+    monkeypatch.setenv("RAY_TPU_NET_STRIPE_BYTES", str(1 << 20))
+    monkeypatch.setenv("RAY_TPU_DEVICE_LAND_ALWAYS", "1")
+    store, srv, link = served
+    arr = jnp.arange((12 << 20) // 4, dtype=jnp.float32)
+    jax.block_until_ready(arr)
+    parts, total = wire.dumps_parts(arr)
+    store.put_frames(OID, parts)
+    dst = NativeObjectStore(
+        path=os.path.join(
+            tempfile.gettempdir(), f"t_devdst_{os.getpid()}.shm"
+        ),
+        capacity=1 << 26,
+    )
+    try:
+        chunks_before = dp.device_stats()["device_land_chunks_total"]
+        size = tp.fetch_to_store(link, OID, dst, land="device")
+        assert size == total
+        assert dp.device_stats()["device_land_chunks_total"] > chunks_before
+        back = wire.loads(dst.get_view(OID))
+        assert isinstance(back, jax.Array)
+        assert np.array_equal(np.asarray(back), np.asarray(arr))
+    finally:
+        dst.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# abort: staged pages AND partial device buffers both freed (zombie sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_aborted_device_landing_sweeps_clean(arena, monkeypatch):
+    """An aborted device landing leaves nothing behind: the zone drops
+    its partial device chunks, ``abort_put`` frees the staged pages, and
+    the arena reports zero zombies — the PR 3/5 pin-lifecycle contract
+    extended to device landings."""
+    monkeypatch.setenv("RAY_TPU_DEVICE_LAND_ALWAYS", "1")
+    total = 6 << 20
+    staged = arena.begin_put(OID, total)
+    zone = dp.DeviceLandingZone(staged, chunk_bytes=1 << 20)
+    # half the stripes land, then the transfer dies
+    zone.note_stripe(0, 1 << 20)
+    zone.note_stripe(1 << 20, 1 << 20)
+    zone.note_stripe(3 << 20, 1 << 20)  # disjoint: not in the prefix
+    snap = zone.snapshot()
+    assert snap["chunks"] >= 2
+    zone.abort()
+    del staged
+    arena.abort_put(OID)
+    assert not arena.contains(OID)
+    assert arena.zombie_count() == 0
+    after = zone.snapshot()
+    assert after["aborted"] and after["chunks"] == 0
+    # the arena is fully reusable after the abort
+    arena.put_bytes(OID, b"x" * 128)
+    assert bytes(arena.get_view(OID)[:1]) == b"x"
+    arena.delete(OID)
+
+
+def test_landing_zone_finish_matches_source(monkeypatch):
+    """Out-of-order disjoint stripes: ``finish()`` returns device chunks
+    that reassemble to exactly the source bytes."""
+    monkeypatch.setenv("RAY_TPU_DEVICE_LAND_ALWAYS", "1")
+    payload = np.random.default_rng(9).integers(
+        0, 255, size=5 << 20, dtype=np.uint8
+    ).tobytes()
+    dest = memoryview(bytearray(payload))
+    zone = dp.DeviceLandingZone(dest, chunk_bytes=1 << 20)
+    # stripes arrive out of order, sizes not chunk-aligned
+    spans = [(2 << 20, 1 << 20), (0, 1500000), (1500000, (2 << 20) - 1500000),
+             (3 << 20, (5 << 20) - (3 << 20))]
+    for off, n in spans:
+        zone.note_stripe(off, n)
+    chunks = zone.finish()
+    flat = np.concatenate([np.asarray(c) for c in chunks])
+    assert flat.tobytes() == payload
+
+
+# ---------------------------------------------------------------------------
+# frame format corners
+# ---------------------------------------------------------------------------
+
+
+def test_many_leaf_and_noncontiguous_pytree_roundtrip():
+    """An 80-leaf device pytree (>64 out-of-band buffers) with strided
+    members round-trips content-exact through the wire format."""
+    base = jnp.arange(128 * 64, dtype=jnp.float32).reshape(128, 64)
+    jax.block_until_ready(base)
+    tree = {f"leaf{i}": base[i : i + 2].T for i in range(78)}
+    tree["flat"] = jnp.arange(4096, dtype=jnp.int32)
+    tree["bf"] = jnp.ones((33,), dtype=jnp.bfloat16) * 1.5
+    parts, _ = wire.dumps_parts(tree)
+    back = wire.loads(memoryview(wire.join_parts(parts)))
+    assert len(back) == 80
+    for k, want in tree.items():
+        assert isinstance(back[k], jax.Array), k
+        assert np.array_equal(np.asarray(back[k]), np.asarray(want)), k
+
+
+def test_zero_copy_seal_on_cpu_backend():
+    """On the CPU backend the dlpack export aliases the buffer: sealing
+    a contiguous f32 array must count as zero-copy."""
+    arr = jnp.arange(1 << 18, dtype=jnp.float32)
+    jax.block_until_ready(arr)
+    zc_before = dp.device_stats()["device_frame_zero_copy_total"]
+    wire.dumps_parts(arr)
+    assert dp.device_stats()["device_frame_zero_copy_total"] > zc_before
+
+
+def test_kill_switch_disables_seal_but_keeps_frames_loadable(monkeypatch):
+    """RAY_TPU_DEVICE_PLANE=0: no new device frames seal (jax's own
+    reducer takes over), but frames sealed while the plane was ON still
+    load — landing host-side, content-exact."""
+    arr = jnp.arange(1 << 16, dtype=jnp.float32) * 2
+    jax.block_until_ready(arr)
+    parts, _ = wire.dumps_parts(arr)  # sealed with the plane ON
+    blob = wire.join_parts(parts)
+    monkeypatch.setenv("RAY_TPU_DEVICE_PLANE", "0")
+    seals_before = dp.device_stats()["device_frame_seals_total"]
+    off_parts, _ = wire.dumps_parts(arr)
+    assert dp.device_stats()["device_frame_seals_total"] == seals_before
+    # plane-off seal still round-trips (jax reducer path)
+    off_back = wire.loads(memoryview(wire.join_parts(off_parts)))
+    assert np.array_equal(np.asarray(off_back), np.asarray(arr))
+    # plane-ON frames remain loadable with the switch off: land host-side
+    back = wire.loads(memoryview(blob))
+    assert isinstance(back, np.ndarray)
+    assert np.array_equal(back, np.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# pin lifecycle: the transfer-keepalive regression
+# ---------------------------------------------------------------------------
+
+
+def test_landed_value_releases_arena_pin_without_gc(arena):
+    """Deleting a device-frame object right after a consumer landed it
+    must not leave a zombie: jax's transfer machinery keeps the
+    view-backed ``device_put`` source alive until a dispatch AFTER the
+    copy completes, so the wire layer flushes the keepalive as part of
+    the deserialize. Without the flush every delete-then-refetch cycle
+    (the bench loop, eager-free hot paths) stalls on zombie pages."""
+    arr = jnp.arange((8 << 20) // 4, dtype=jnp.float32)
+    jax.block_until_ready(arr)
+    parts, _ = wire.dumps_parts(arr)
+    arena.put_frames(OID, parts)
+    gc.collect()
+    gc.disable()
+    try:
+        view = arena.get_view(OID)
+        landed = wire.loads(view)
+        assert isinstance(landed, jax.Array)
+        del view
+        arena.delete(OID)
+        # landed value still alive — its buffer is a device copy, so the
+        # arena page must already be free (no deferred-gc pin)
+        assert arena.zombie_count() == 0
+        assert np.asarray(landed)[5] == 5.0
+    finally:
+        gc.enable()
+
+
+# ---------------------------------------------------------------------------
+# consumers: RDT fast path + elastic reshape regather
+# ---------------------------------------------------------------------------
+
+
+def test_rdt_put_get_device_fast_path(rt):
+    """``rdt.put_tensor`` routes sealable jax arrays through the device
+    plane: the consumer gets a ``jax.Array`` with identical content, and
+    numpy tensors keep the raw-codec path."""
+    from ray_tpu import rdt
+
+    arr = jnp.arange(300_000, dtype=jnp.float32) * 0.5
+    jax.block_until_ready(arr)
+    ref = rdt.put_tensor(arr)
+    out = rdt.get_tensor(ref)
+    assert isinstance(out, jax.Array)
+    assert np.array_equal(np.asarray(out), np.asarray(arr))
+    nref = rdt.put_tensor(np.arange(64, dtype=np.int64))
+    nout = rdt.get_tensor(nref)
+    assert type(nout) is np.ndarray and nout[-1] == 63
+
+
+def test_reshape_regather_device_bitexact(rt, monkeypatch):
+    """Elastic reshape regather over the device plane produces bitwise
+    the same state as a host-bounce (plane off) run, and device-plane
+    leaves come back as ``jax.Array``."""
+    from ray_tpu.train.elastic import (
+        fetch_sealed,
+        regather_state,
+        seal_rank_state,
+    )
+
+    rng = np.random.default_rng(11)
+    state = {
+        "w": jnp.asarray(rng.standard_normal((37, 8)).astype(np.float32)),
+        "opt": {
+            "m": jnp.asarray(rng.standard_normal(513).astype(np.float32)),
+            "count": 7,
+        },
+    }
+    jax.block_until_ready([state["w"], state["opt"]["m"]])
+
+    def run():
+        hexes = [
+            seal_rank_state(
+                state, 5, rank, 2, 4, elastic_shard_rules=(r"^opt/m$",)
+            )[0]
+            for rank in range(2)
+        ]
+        rebuilt, step = regather_state([fetch_sealed(h) for h in hexes])
+        assert step == 5
+        return rebuilt
+
+    dev = run()
+    assert isinstance(dev["opt"]["m"], jax.Array)
+    monkeypatch.setenv("RAY_TPU_DEVICE_PLANE", "0")
+    host = run()
+    for get in (lambda s: s["w"], lambda s: s["opt"]["m"]):
+        a, b = np.asarray(get(dev)), np.asarray(get(host))
+        assert a.dtype == b.dtype
+        assert a.tobytes() == b.tobytes()  # bit-exact, not just allclose
+    assert dev["opt"]["count"] == host["opt"]["count"] == 7
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_debug_block_and_metrics_publish():
+    before = dp.device_stats()
+    assert set(before) >= {
+        "device_frame_seals_total",
+        "device_frame_lands_total",
+        "device_frame_bytes_total",
+    }
+    block = dp.debug_block()
+    assert block["enabled"] is True
+    published = dp.publish_device_metrics()
+    assert published["device_frame_seals_total"] >= 0
