@@ -3036,7 +3036,7 @@ class RemoteRuntime:
         )
 
     def _socket_fetch(
-        self, nid: str, h: str, land: "Optional[str]" = "device"
+        self, nid: str, h: str, land: "Optional[str]" = None
     ) -> "Optional[memoryview]":
         """Socket pull of one object from a node's data server. None =
         plane unavailable for this transfer (caller uses the FetchObject
@@ -3044,17 +3044,24 @@ class RemoteRuntime:
         location). Returns a READ-ONLY view: numpy payloads deserialize
         as immutable views exactly like the RPC path's bytes reply.
 
-        ``land='device'`` (default) streams landed stripes device-side
-        in flight when the backend has a real H2D hop, so device frames
-        in the payload deserialize against warm pages — gets always
-        deserialize under device landing, so the overlap is free."""
+        ``land`` defaults to None: a generic get must not stage its raw
+        RTP5 byte stream in HBM (headers, pickle opcodes, and non-tensor
+        payloads would transiently consume device memory equal to the
+        whole object). Tensor-heavy consumers opt in by passing
+        ``land='device'`` or by fetching under an explicit
+        ``device_plane.landing("device")`` scope (rdt pulls, elastic
+        ``fetch_sealed``) — landed stripes then stream device-side in
+        flight so device frames deserialize against warm pages."""
         from ray_tpu.config import cfg
 
         if not cfg.native_net:
             return None
+        from .device_plane import landing_requested
         from .transport import LinkRejectedError, StripeFetchError
         from .transport import fetch_bytes as _fetch_bytes
 
+        if land is None and landing_requested():
+            land = "device"
         link = self._link_cache().get(nid)
         if link is None:
             return None

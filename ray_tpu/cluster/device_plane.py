@@ -206,6 +206,18 @@ def landing_mode() -> str:
     return getattr(_LANDING, "mode", "device")
 
 
+def landing_requested() -> bool:
+    """True only while an explicit ``landing("device")`` scope is active
+    on this thread — the scope-less default ("device") does NOT count.
+    Transport callers use this to decide whether a socket get should pay
+    for a :class:`DeviceLandingZone`: only consumers that declared the
+    payload tensor-heavy opt in (rdt pulls, elastic ``fetch_sealed``);
+    a generic get must not ``device_put`` an arbitrary pickled object's
+    raw byte stream — headers, pickle opcodes, multi-GB non-tensor
+    payloads — into HBM just to discard the chunks after ``finish()``."""
+    return getattr(_LANDING, "mode", None) == "device"
+
+
 @contextlib.contextmanager
 def landing(mode: str):
     """Scope the device-frame landing mode for deserialization on this
@@ -270,6 +282,17 @@ def _land_device_leaf(meta: dict, buf) -> Any:
 _FLUSH_SRC = np.zeros(1, dtype=np.uint8)
 
 
+def _drain_transfer_keepalive(jax) -> None:
+    """One trivial dispatch to drain jax's transfer-keepalive queue
+    (entries only release on a dispatch AFTER their transfer completes).
+    The dispatch's own source is the module-level constant, so it takes
+    over the keepalive slot and pins nothing."""
+    try:
+        jax.device_put(_FLUSH_SRC)
+    except Exception:  # noqa: BLE001 - backend torn down mid-shutdown
+        pass
+
+
 def flush_landing_keepalive() -> None:
     """Release jax's keepalive refs on this deserialize's view-backed
     ``device_put`` sources: block until every landed array's transfer is
@@ -286,11 +309,9 @@ def flush_landing_keepalive() -> None:
         return
     try:
         jax.block_until_ready(pending)
-        # the drain dispatch's own source is this module-level constant:
-        # it takes over the keepalive slot and pins nothing
-        jax.device_put(_FLUSH_SRC)
     except Exception:  # noqa: BLE001 - backend torn down mid-shutdown
-        pass
+        return
+    _drain_transfer_keepalive(jax)
 
 
 def landing_zone_worthwhile() -> bool:
@@ -357,13 +378,25 @@ def make_device_reducer(pump_threshold: Optional[int] = None):
     return _reduce
 
 
+def _host_aliasing(arr) -> bool:
+    """True when the array's buffer lives in host-addressable memory
+    (CPU backend): the plain export is zero-copy or one cheap move, and
+    the pump has no D2H readout to overlap."""
+    try:
+        return next(iter(arr.sharding.device_set)).platform == "cpu"
+    except Exception:  # noqa: BLE001 - backend without platform info
+        return False
+
+
 def _pumped_export(arr) -> Tuple[np.ndarray, bool]:
     """Export via the chunked D2H pump when the buffer does NOT alias
-    host memory; zero-copy exports skip the pump entirely (there is no
-    readout to overlap)."""
-    host, zero_copy = export_device_view(arr)
-    if zero_copy:
-        return host, True
+    host memory. The probe is the device platform, NOT a trial export:
+    ``export_device_view`` on a non-dlpack accelerator buffer performs a
+    full monolithic D2H readout, so probing with it would pay double
+    device bandwidth plus a discarded host materialization on exactly
+    the path the pump exists for."""
+    if _host_aliasing(arr):
+        return export_device_view(arr)
     pump = DeviceChunkPump(arr)
     return pump.gather(), False
 
@@ -491,13 +524,14 @@ class DeviceChunkPump:
         """Drain the pump into one contiguous host ndarray (callers that
         need the whole buffer; streamed consumers iterate chunks())."""
         out = np.empty(self.arr.shape, dtype=self.arr.dtype)
-        flat = out.reshape(-1).view(np.uint8)
-        raw = flat if flat.nbytes == out.nbytes else out.reshape(-1)
-        dst = memoryview(out).cast("B") if out.nbytes else memoryview(b"")
-        del raw
+        # uint8 VIEWS, not the buffer protocol: ml_dtypes extension
+        # types (bfloat16, float8) have no buffer-protocol format char,
+        # so memoryview(...).cast("B") raises on exactly the dtypes
+        # real weights and KV pages use
+        dst = out.reshape(-1).view(np.uint8)
         for off, chunk in self.chunks():
-            cb = memoryview(np.ascontiguousarray(chunk)).cast("B")
-            dst[off : off + cb.nbytes] = cb
+            src = np.ascontiguousarray(chunk).reshape(-1).view(np.uint8)
+            dst[off : off + src.nbytes] = src
         return out
 
 
@@ -617,7 +651,15 @@ class DeviceLandingZone:
         jax = _jax()
         if jax is not None and chunks:
             t0 = time.perf_counter()
-            jax.block_until_ready(chunks)
+            try:
+                jax.block_until_ready(chunks)
+            except Exception:  # noqa: BLE001 - backend torn down
+                pass
+            # the zone's device_put sources are np.frombuffer views over
+            # the staged arena entry: drain the keepalive NOW so the
+            # pages unpin with the fetch, not at some future dispatch —
+            # same zombie-page contract as flush_landing_keepalive
+            _drain_transfer_keepalive(jax)
             self._h2d_s += time.perf_counter() - t0
         try:
             from ray_tpu.util.tracing import SPANS
@@ -647,6 +689,12 @@ class DeviceLandingZone:
                 c.delete()
             except Exception:  # noqa: BLE001 - already deleted/donated
                 pass
+        if chunks:
+            jax = _jax()
+            if jax is not None:
+                # issued transfers may still hold keepalive refs on the
+                # staged pages the caller is about to abort_put
+                _drain_transfer_keepalive(jax)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -666,9 +714,12 @@ def assemble_device_tensor(
     chunks — concatenate + bitcast + reshape run ON DEVICE, so the raw
     single-tensor receive path (rdt) never builds a second host copy."""
     jax = _jax()
+    if jax is None:
+        raise RuntimeError(
+            "assemble_device_tensor requires jax: landing-zone chunks "
+            "are device-resident and cannot reassemble host-side"
+        )
     import jax.numpy as jnp
-
-    assert jax is not None
     flat = chunks[0] if len(chunks) == 1 else jnp.concatenate(list(chunks))
     dt = resolve_dtype(dtype_name)
     return jax.lax.bitcast_convert_type(

@@ -123,7 +123,13 @@ def put_tensor(value: Any) -> "ray_tpu.ObjectRef":
 
 
 def get_tensor(ref: "ray_tpu.ObjectRef", timeout: Optional[float] = None) -> Any:
-    out = ray_tpu.get(ref, timeout=timeout)
+    from ray_tpu.cluster.device_plane import landing
+
+    # explicit landing scope: rdt payloads are tensors by contract, so
+    # this pull opts the socket fetch into the device landing zone
+    # (stripes stream to HBM in flight) — generic gets don't
+    with landing("device"):
+        out = ray_tpu.get(ref, timeout=timeout)
     if isinstance(out, _RdtBlob):
         ok, value = decode_tensor(out.data)
         if ok:

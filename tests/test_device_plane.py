@@ -264,6 +264,69 @@ def test_zero_copy_seal_on_cpu_backend():
     assert dp.device_stats()["device_frame_zero_copy_total"] > zc_before
 
 
+def test_pump_gather_bf16_no_buffer_protocol(monkeypatch):
+    """``DeviceChunkPump.gather`` must not touch the buffer protocol:
+    ml_dtypes extension dtypes (bfloat16/float8) have no buffer-protocol
+    format char (``memoryview(...).cast('B')`` raises) and are exactly
+    the weight/KV dtypes that exceed the pump threshold on real chips.
+    Forced through the pump as on a non-host-aliasing backend, a bf16
+    seal must stay content-exact — both the direct gather and the
+    reducer's tiny-threshold pump path."""
+    monkeypatch.setattr(dp, "_host_aliasing", lambda arr: False)
+    arr = (jnp.arange(2_000_000, dtype=jnp.float32) % 251).astype(
+        jnp.bfloat16
+    )
+    jax.block_until_ready(arr)
+    chunks_before = dp.device_stats()["device_pump_chunks_total"]
+    out = dp.DeviceChunkPump(arr, chunk_bytes=1 << 20, depth=2).gather()
+    assert dp.device_stats()["device_pump_chunks_total"] >= chunks_before + 4
+    assert out.dtype == np.asarray(arr).dtype
+    assert np.array_equal(out, np.asarray(arr))
+    # end to end: reducer with a tiny pump_threshold seals via the pump,
+    # and the sealed frame lands back content-exact
+    land_fn, (meta, buf) = dp.make_device_reducer(pump_threshold=1)(arr)
+    back = land_fn(meta, buf.raw())
+    dp.flush_landing_keepalive()
+    assert np.array_equal(np.asarray(back), np.asarray(arr))
+
+
+def test_pumped_export_skips_monolithic_readout(monkeypatch):
+    """On a non-host-aliasing backend ``_pumped_export`` must go
+    straight to the pump: probing with ``export_device_view`` would read
+    the whole tensor out of the device once (monolithic D2H) just to
+    discard the host copy — double bandwidth on exactly the path the
+    pump exists for."""
+    monkeypatch.setattr(dp, "_host_aliasing", lambda arr: False)
+    calls = []
+    monkeypatch.setattr(
+        dp, "export_device_view", lambda a: calls.append(a)
+    )
+    arr = jnp.arange(1 << 18, dtype=jnp.float32)
+    jax.block_until_ready(arr)
+    host, zero_copy = dp._pumped_export(arr)
+    assert not calls and not zero_copy
+    assert np.array_equal(host, np.asarray(arr))
+    # the real CPU backend IS host-aliasing: plain zero-copy export
+    monkeypatch.undo()
+    host2, zc2 = dp._pumped_export(arr)
+    assert zc2
+    assert np.array_equal(host2, np.asarray(arr))
+
+
+def test_landing_requested_only_in_explicit_scope():
+    """The landing-zone opt-in signal: True only inside an explicit
+    ``landing("device")`` scope. The scope-less default (which also
+    lands device-side at deserialize) must NOT opt generic socket gets
+    into staging their raw byte stream in HBM."""
+    assert dp.landing_mode() == "device"  # scope-less default
+    assert not dp.landing_requested()
+    with dp.landing("device"):
+        assert dp.landing_requested()
+    with dp.landing("host"):
+        assert not dp.landing_requested()
+    assert not dp.landing_requested()
+
+
 def test_kill_switch_disables_seal_but_keeps_frames_loadable(monkeypatch):
     """RAY_TPU_DEVICE_PLANE=0: no new device frames seal (jax's own
     reducer takes over), but frames sealed while the plane was ON still
