@@ -2251,6 +2251,402 @@ def serve_bench() -> dict:
         cluster.shutdown()
 
 
+def serve_disagg_bench() -> dict:
+    """Tier: disaggregated multi-model serving (PR 18). A prefill tier
+    seals KV pages and hands them to decode replicas over the data
+    plane; 2 models multiplex on the decode fleet via arena-backed
+    hot-swap; tenants with WFQ weights share admission. Measures:
+
+    - ``disagg_ttft_p50_ms`` and ``disagg_decode_tokens_per_s`` at 1
+      and 2 decode replicas (prefill tier FIXED at 1 — decode must
+      scale independently),
+    - ``disagg_kv_handoff_mb_per_s`` (summed replica handoff counters),
+    - ``disagg_decode_full_prefills_steady`` (must be 0: every steady-
+      state stream adopted shipped pages instead of re-prefilling),
+    - noisy-neighbor isolation: a weight-1 victim tenant's client-side
+      p99 under a flooding tenant vs its unloaded baseline,
+    - hot-swap: zero stream errors across forced model swaps plus the
+      first-token-on-new-weights latency histogram.
+
+    Gates: RAY_TPU_BENCH_DISAGG_SCALE_FLOOR (decode tokens/s ratio
+    going 1 -> 2 replicas, with TTFT p50 no worse than +20%) and
+    RAY_TPU_BENCH_TENANT_P99_ISOLATION (victim p99 ratio ceiling)."""
+    import random as _random
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu as _rt
+    import ray_tpu.serve as serve
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.llm.serving import build_llm_deployment
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.serve.admission import Overloaded
+    from ray_tpu.serve.router import SERVE_TTFT_MS
+
+    max_new = int(os.environ.get("RAY_TPU_BENCH_DISAGG_TOKENS", "10"))
+    name = "bench-disagg"
+    mcfg = tfm.ModelConfig(
+        vocab_size=64, d_model=48, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=96, max_seq_len=128, dtype=jnp.float32,
+    )
+    base_params = tfm.init_params(mcfg, jax.random.PRNGKey(7))
+    alt_params = tfm.init_params(mcfg, jax.random.PRNGKey(11))
+    hot = [
+        "the quick brown fox jumps over it " * 2,
+        "in the beginning there was a tape " * 2,
+        "once upon a time in a cluster far " * 2,
+    ]
+    # zipf-ish tenant mix: one flooder dominates, a mid tenant hums,
+    # and the weight-1 victim sends rare requests whose p99 the WFQ
+    # gate must keep within RAY_TPU_BENCH_TENANT_P99_ISOLATION x of
+    # its unloaded baseline
+    tenant_mix = [("t-flood", 0.7), ("t-mid", 0.2), ("t-victim", 0.1)]
+    cluster = Cluster(use_device_scheduler=False)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    cluster.add_node({"CPU": 2.0}, num_workers=2)
+    rt = cluster.client()
+    set_runtime(rt)
+    t_start = time.perf_counter()
+    try:
+        serve.run(
+            build_llm_deployment(
+                mcfg,
+                base_params,
+                name=name,
+                num_replicas=1,
+                engine="continuous",
+                max_batch=4,
+                page_size=8,
+                n_pages=128,
+                prefill_replicas=1,
+                variants={"m1": alt_params},
+                base_model_id="m0",
+            )
+        )
+        router = serve.get_router(name)
+        router.admission.set_tenant_weights(
+            {t: 1.0 for t, _ in tenant_mix}
+        )
+        rng = _random.Random(7)
+        lat_lock = threading.Lock()
+
+        def one_request(
+            results, idx, tenant="t-flood", model="m0", lat=None
+        ):
+            prompt = (
+                rng.choice(hot)
+                if rng.random() < 0.8
+                else f"cold prompt number {idx} with some extra words"
+            )
+            stream = None
+            t_req = time.perf_counter()
+            try:
+                stream = router.stream(
+                    {
+                        "prompt": prompt,
+                        "max_new_tokens": max_new,
+                        "model": model,
+                    },
+                    tenant,
+                )
+                n = sum(1 for _ in stream)
+                results.append(n)
+                if lat is not None:
+                    with lat_lock:
+                        lat.append(time.perf_counter() - t_req)
+            except Overloaded:
+                pass
+            except Exception:  # noqa: BLE001
+                results.append(-1)
+            finally:
+                if stream is not None:
+                    stream.close()
+
+        def replica_counters():
+            """Summed decode-replica handoff/prefill counters, polled
+            straight from the replica actors (not the router's stats
+            cache, which lags a report period)."""
+            rs = router._rs
+            with rs.lock:
+                actors = [r.actor for r in rs.replicas]
+            agg = {
+                "handoff_bytes": 0, "handoff_s": 0.0, "handoffs": 0,
+                "handoff_fallbacks": 0, "full_prefill_count": 0,
+                "adopted_count": 0, "weight_swaps": 0,
+                "first_token_new_weights_count": 0,
+                "first_token_new_weights_ms_sum": 0.0,
+            }
+            for a in actors:
+                try:
+                    s = _rt.get(a.serve_stats.remote(), timeout=30)
+                except Exception:  # noqa: BLE001 - replica mid-swap
+                    continue
+                for k in agg:
+                    agg[k] += s.get(k) or 0
+            return agg
+
+        _lbl = {"deployment": name}
+
+        def _ttft_p50(base):
+            from ray_tpu.util.metrics import percentile_from_buckets
+
+            cur = SERVE_TTFT_MS.buckets_snapshot(_lbl)
+            window = [max(0, a - b) for a, b in zip(cur, base)]
+            return percentile_from_buckets(
+                SERVE_TTFT_MS.boundaries, window, 0.50
+            )
+
+        def _pick_tenant():
+            r = rng.random()
+            acc = 0.0
+            for t, w in tenant_mix:
+                acc += w
+                if r < acc:
+                    return t
+            return tenant_mix[-1][0]
+
+        def burst(total, conc):
+            """Closed-loop saturation: ``conc`` workers drain a shared
+            counter of ``total`` requests, so decode capacity — not the
+            arrival process — bounds throughput. This is the load shape
+            under which adding a decode replica must actually lift
+            tokens/s."""
+            results: list = []
+            counter = [0]
+
+            def worker():
+                while True:
+                    with lat_lock:
+                        if counter[0] >= total:
+                            return
+                        i = counter[0]
+                        counter[0] += 1
+                    one_request(results, i, _pick_tenant(), "m0")
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker) for _ in range(conc)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            wall = time.perf_counter() - t0
+            completed = sum(1 for r in results if r == max_new)
+            errored = sum(1 for r in results if r == -1)
+            return {
+                "wall": wall,
+                "launched": total,
+                "completed": completed,
+                "errored": errored,
+                "tokens_per_s": completed * max_new / wall,
+            }
+
+        def _p99(samples):
+            if not samples:
+                return None
+            s = sorted(samples)
+            return s[min(len(s) - 1, int(len(s) * 0.99))]
+
+        # -- warm: compile prefill+decode on both tiers, both models --
+        warm: list = []
+        one_request(warm, 0, "t-flood", "m0")
+        one_request(warm, 1, "t-flood", "m1")
+        one_request(warm, 2, "t-flood", "m0")
+
+        # -- victim baseline: unloaded sequential requests -------------
+        base_res: list = []
+        base_lat: list = []
+        for i in range(6):
+            one_request(base_res, i, "t-victim", "m0", base_lat)
+        victim_base_p99 = _p99(base_lat)
+
+        burst_n = int(os.environ.get("RAY_TPU_BENCH_DISAGG_BURST", "24"))
+        burst_conc = int(
+            os.environ.get("RAY_TPU_BENCH_DISAGG_CONC", "8")
+        )
+
+        # -- phase 1: saturation burst, 1 decode replica ---------------
+        ctr0 = replica_counters()
+        ttft_base = SERVE_TTFT_MS.buckets_snapshot(_lbl)
+        ph1 = burst(burst_n, burst_conc)
+        ttft_p50_1 = _ttft_p50(ttft_base)
+        ctr1 = replica_counters()
+
+        # -- noisy neighbor (still 1 replica): flooding tenants loop
+        # while the weight-1 victim sends sequential requests ----------
+        stop_flood = threading.Event()
+        flood_res: list = []
+
+        def flooder():
+            i = 0
+            while not stop_flood.is_set():
+                one_request(flood_res, i, "t-flood", "m0")
+                i += 1
+
+        flood_threads = [
+            threading.Thread(target=flooder) for _ in range(4)
+        ]
+        for t in flood_threads:
+            t.start()
+        vict_res: list = []
+        vict_lat: list = []
+        for i in range(8):
+            one_request(vict_res, i, "t-victim", "m0", vict_lat)
+        stop_flood.set()
+        for t in flood_threads:
+            t.join(timeout=300)
+        victim_load_p99 = _p99(vict_lat)
+
+        # -- phase 2: second decode replica, SAME prefill tier ---------
+        router._rs.add_replica()
+        warm2: list = []
+        warm_threads = [
+            threading.Thread(
+                target=one_request, args=(warm2, i, "t-flood", "m0")
+            )
+            for i in range(4)
+        ]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join(timeout=300)
+        ctr2 = replica_counters()
+        ttft_base2 = SERVE_TTFT_MS.buckets_snapshot(_lbl)
+        ph2 = burst(burst_n, burst_conc)
+        ttft_p50_2 = _ttft_p50(ttft_base2)
+        ctr3 = replica_counters()
+
+        # -- hot-swap row: forced model flips under live streams -------
+        swap_res: list = []
+        swap_threads = [
+            threading.Thread(
+                target=one_request,
+                args=(swap_res, i, "t-mid", "m0" if i % 2 else "m1"),
+            )
+            for i in range(6)
+        ]
+        for t in swap_threads:
+            t.start()
+            time.sleep(0.1)
+        for t in swap_threads:
+            t.join(timeout=300)
+        swap_errors = sum(1 for r in swap_res if r == -1)
+        # swap latency counters live in the replica processes; read
+        # them through serve_stats rather than this process's histograms
+        ctr4 = replica_counters()
+        ft_count = ctr4["first_token_new_weights_count"]
+        ft_sum = ctr4["first_token_new_weights_ms_sum"]
+
+        handoff_bytes = ctr3["handoff_bytes"] - ctr0["handoff_bytes"]
+        handoff_s = ctr3["handoff_s"] - ctr0["handoff_s"]
+        steady_full_prefills = (
+            ctr3["full_prefill_count"] - ctr2["full_prefill_count"]
+        ) + (ctr1["full_prefill_count"] - ctr0["full_prefill_count"])
+        scale = (
+            ph2["tokens_per_s"] / ph1["tokens_per_s"]
+            if ph1["tokens_per_s"] > 0
+            else 0.0
+        )
+        ttft_ratio = (
+            ttft_p50_2 / ttft_p50_1 if ttft_p50_1 > 0 else None
+        )
+        isolation_ratio = (
+            victim_load_p99 / victim_base_p99
+            if victim_load_p99 and victim_base_p99
+            else None
+        )
+        out = {
+            "disagg_burst_requests": burst_n,
+            "disagg_burst_concurrency": burst_conc,
+            "disagg_ttft_p50_ms": round(ttft_p50_1, 1),
+            "disagg_ttft_p50_ms_2rep": round(ttft_p50_2, 1),
+            "disagg_decode_tokens_per_s": round(ph1["tokens_per_s"], 2),
+            "disagg_decode_tokens_per_s_2rep": round(
+                ph2["tokens_per_s"], 2
+            ),
+            "disagg_decode_scale": round(scale, 3),
+            "disagg_ttft_scale_ratio": (
+                round(ttft_ratio, 3) if ttft_ratio is not None else None
+            ),
+            "disagg_requests_launched": ph1["launched"] + ph2["launched"],
+            "disagg_requests_errored": ph1["errored"] + ph2["errored"],
+            "disagg_kv_handoffs": ctr3["handoffs"] - ctr0["handoffs"],
+            "disagg_kv_handoff_fallbacks": (
+                ctr3["handoff_fallbacks"] - ctr0["handoff_fallbacks"]
+            ),
+            "disagg_kv_handoff_mb_per_s": (
+                round(handoff_bytes / handoff_s / (1 << 20), 2)
+                if handoff_s > 0
+                else None
+            ),
+            # every steady-state stream must ADOPT shipped pages — a
+            # nonzero count means decode re-ran prefill work the
+            # prefill tier already did
+            "disagg_decode_full_prefills_steady": steady_full_prefills,
+            "disagg_pages_adopted": (
+                ctr3["adopted_count"] - ctr0["adopted_count"]
+            ),
+            "disagg_victim_p99_base_ms": (
+                round(victim_base_p99 * 1000, 1)
+                if victim_base_p99
+                else None
+            ),
+            "disagg_victim_p99_loaded_ms": (
+                round(victim_load_p99 * 1000, 1)
+                if victim_load_p99
+                else None
+            ),
+            "disagg_victim_p99_ratio": (
+                round(isolation_ratio, 3)
+                if isolation_ratio is not None
+                else None
+            ),
+            "disagg_swap_stream_errors": swap_errors,
+            "disagg_first_token_new_weights_ms": (
+                round(ft_sum / ft_count, 1) if ft_count else None
+            ),
+            "disagg_weight_swaps": int(ctr4["weight_swaps"]),
+            "disagg_wall_s": round(time.perf_counter() - t_start, 1),
+        }
+        scale_floor = float(
+            os.environ.get("RAY_TPU_BENCH_DISAGG_SCALE_FLOOR", "0") or 0.0
+        )
+        if scale_floor > 0:
+            out["disagg_scale_floor"] = scale_floor
+            out["disagg_scale_ok"] = bool(
+                scale >= scale_floor
+                and (ttft_ratio is None or ttft_ratio <= 1.2)
+                and steady_full_prefills == 0
+                and swap_errors == 0
+            )
+        iso_ceiling = float(
+            os.environ.get("RAY_TPU_BENCH_TENANT_P99_ISOLATION", "0")
+            or 0.0
+        )
+        if iso_ceiling > 0:
+            out["tenant_p99_isolation_ceiling"] = iso_ceiling
+            out["tenant_p99_ok"] = bool(
+                isolation_ratio is not None
+                and isolation_ratio <= iso_ceiling
+            )
+        return out
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        set_runtime(None)
+        try:
+            rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
 class _BenchTokenServer:
     """Deterministic resumable token streamer for the router-scale
     tier: cheap enough that the ingress routers (not the replicas) are
@@ -2741,6 +3137,11 @@ def main():
             cluster.update(serve_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["serve_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_DISAGG", "1") != "0":
+        try:
+            cluster.update(serve_disagg_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["serve_disagg_error"] = repr(exc)
     if os.environ.get("RAY_TPU_BENCH_ROUTER_SCALE", "1") != "0":
         try:
             cluster.update(router_scale_bench())
@@ -2805,6 +3206,8 @@ def main():
         or out.get("wait_p99_ok") is False
         or out.get("serve_p99_ok") is False
         or out.get("serve_qps_ok") is False
+        or out.get("disagg_scale_ok") is False
+        or out.get("tenant_p99_ok") is False
         or out.get("router_scale_ok") is False
         or out.get("router_failover_ok") is False
         or out.get("xnode_floor_ok") is False

@@ -340,6 +340,25 @@ class InvariantChecker:
             f"{timeout:.0f}s"
         ]
 
+    def wait_prefill_backfilled(self, adapter, timeout: float) -> List[str]:
+        """After a prefill_kill the prefill tier must restore its desired
+        replica count with workers that actually answer calls. Adapters
+        without a prefill surface owe nothing (monolithic deployment)."""
+        if adapter is None or getattr(adapter, "prefill_rs", None) is None:
+            return []
+        deadline = time.monotonic() + timeout
+        live = 0
+        while time.monotonic() < deadline:
+            live = adapter.live_prefill()
+            if live >= adapter.target_prefill():
+                return []
+            time.sleep(0.3)
+        return [
+            f"prefill tier not backfilled: {live}/"
+            f"{adapter.target_prefill()} live prefill workers after "
+            f"{timeout:.0f}s"
+        ]
+
     def arena_zombies(self) -> int:
         """Sum of deleted-with-outstanding-pins entries across every live
         node's arena (agent DebugState ``object_plane.arena_zombies``)."""

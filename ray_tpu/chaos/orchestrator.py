@@ -33,6 +33,12 @@ injection. Fault kinds:
                        in-flight streams must fail over with no
                        duplicated/dropped acked tokens and the replica
                        set must backfill to its desired count.
+- ``prefill_kill``    — SIGKILL a PREFILL-tier worker of a disaggregated
+                       serving deployment mid-KV-handoff; decode
+                       replicas must fall back to local re-prefill
+                       (token-exact — generation is seed-deterministic),
+                       streams keep completing, and the prefill tier
+                       backfills to its desired count.
 - ``rank_node_kill``  — SIGKILL a node hosting elastic training gang
                        ranks (picked from the head's gang table); the
                        gang must fence its epoch, reshape to the
@@ -179,6 +185,7 @@ class ChaosOrchestrator:
         # victim selection + stream/replica invariants for replica_kill
         self.serve_adapter = serve_adapter
         self._killed_replica: Optional[int] = None
+        self._killed_prefill: Optional[int] = None
 
     # -- sacrificial owner ----------------------------------------------
     def _spawn_owner_proc(self) -> None:
@@ -330,6 +337,28 @@ class ChaosOrchestrator:
                 return f"skipped: replica pid {pid} already gone"
             self._killed_replica = pid
             return f"SIGKILLed serve replica worker pid {pid}"
+        if kind == "prefill_kill":
+            # SIGKILL a prefill-tier worker mid-KV-handoff: any handoff
+            # it was sealing dies with it, so decode replicas must fall
+            # back to local re-prefill (seed-deterministic, hence
+            # token-exact) and the router keeps admitting while the
+            # prefill tier backfills
+            if self.serve_adapter is None:
+                return "skipped: no serve workload registered"
+            pick = getattr(self.serve_adapter, "pick_prefill_pid", None)
+            if pick is None:
+                return "skipped: serve workload has no prefill tier"
+            pid = pick(self._rng)
+            if pid is None:
+                return "skipped: no live prefill worker to kill"
+            import signal as _signal
+
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                return f"skipped: prefill pid {pid} already gone"
+            self._killed_prefill = pid
+            return f"SIGKILLed prefill worker pid {pid} mid-handoff"
         if kind == "router_kill":
             # abruptly kill one ingress router of the fleet: its push
             # endpoint vanishes and its in-flight streams FAIL; the
@@ -446,6 +475,7 @@ class ChaosOrchestrator:
                 self._dropped_hex: Optional[str] = None
                 self._killed_owner = None
                 self._killed_replica = None
+                self._killed_prefill = None
                 self._killed_router: Optional[str] = None
                 self._killed_gang_nodes: Optional[Dict[str, int]] = None
                 self._head_killed = False
@@ -548,6 +578,22 @@ class ChaosOrchestrator:
                     if serve_fail:
                         check.ok = False
                         check.failures.extend(serve_fail)
+                if self._killed_prefill is not None:
+                    # disaggregated-serving invariants: streams keep
+                    # completing token-exact (decode falls back to local
+                    # re-prefill when the handoff producer died) and the
+                    # prefill tier backfills to its desired count
+                    pre_fail = self.checker.wait_streams_resume(
+                        self.serve_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    pre_fail += self.checker.wait_prefill_backfilled(
+                        self.serve_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if pre_fail:
+                        check.ok = False
+                        check.failures.extend(pre_fail)
                 if self._killed_router is not None:
                     # router-fleet invariant: every stream that was in
                     # flight on the corpse completes token-exact on a

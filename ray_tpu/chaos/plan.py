@@ -30,12 +30,17 @@ DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
 )
 
 # serving-plane mix: adds replica_kill (SIGKILL a serve replica's worker
-# mid-stream). Not in DEFAULT_MIX — the generic soak runs no serve
-# workload, and keeping the default mix stable preserves seed-for-seed
-# schedule reproducibility across versions. Plans that drive a serve
-# workload pass this mix (or an explicit allow list over it).
+# mid-stream) and prefill_kill (SIGKILL a PREFILL-tier worker of a
+# disaggregated deployment mid-handoff; decode replicas must fall back
+# to local re-prefill and every stream must stay token-exact). Not in
+# DEFAULT_MIX — the generic soak runs no serve workload, and keeping the
+# default mix stable preserves seed-for-seed schedule reproducibility
+# across versions. Plans that drive a serve workload pass this mix (or
+# an explicit allow list over it); monolithic serve workloads without a
+# prefill tier report prefill_kill faults as skipped.
 SERVE_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
     ("replica_kill", 2.0),
+    ("prefill_kill", 1.5),
 )
 
 # cross-node transport mix: adds peer_conn_drop (sever one node's data

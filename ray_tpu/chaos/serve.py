@@ -38,12 +38,17 @@ class ServeStreamWorkload:
         expected_tokens: List[str],
         concurrency: int = 2,
         tenants: Optional[List[str]] = None,
+        prefill_rs=None,
     ):
         self.router = router
         self.payload = dict(payload)
         self.expected = list(expected_tokens)
         self.concurrency = concurrency
         self.tenants = list(tenants or ["default"])
+        # disaggregated deployments: the prefill tier's replica set, so
+        # prefill_kill can pick victims and verify backfill. None for
+        # monolithic deployments (prefill faults then report skipped).
+        self.prefill_rs = prefill_rs
         self.completed = 0
         self.stream_errors = 0
         self.verify_failures: List[str] = []
@@ -165,6 +170,46 @@ class ServeStreamWorkload:
 
     def target_replicas(self) -> int:
         return self.router._rs.target
+
+    # -- prefill-tier adapter surface ------------------------------------
+    def pick_prefill_pid(self, rng) -> Optional[int]:
+        """A live PREFILL worker's pid (prefill_kill victim selection);
+        None when the deployment is monolithic or no prefill replica
+        answers."""
+        rs = self.prefill_rs
+        if rs is None:
+            return None
+        with rs.lock:
+            replicas = [r for r in rs.replicas if not r.draining]
+        if not replicas:
+            return None
+        for r in rng.sample(replicas, len(replicas)):
+            try:
+                return int(
+                    ray_tpu.get(r.actor.pid.remote(), timeout=10.0)
+                )
+            except Exception:  # noqa: BLE001 - already dead: next
+                continue
+        return None
+
+    def live_prefill(self) -> int:
+        """Prefill replicas that actually answer a call right now."""
+        rs = self.prefill_rs
+        if rs is None:
+            return 0
+        with rs.lock:
+            replicas = [r for r in rs.replicas if not r.draining]
+        alive = 0
+        for r in replicas:
+            try:
+                ray_tpu.get(r.actor.pid.remote(), timeout=10.0)
+                alive += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return alive
+
+    def target_prefill(self) -> int:
+        return self.prefill_rs.target if self.prefill_rs else 0
 
     # -- router-fleet adapter surface ------------------------------------
     def kill_router(self, rng) -> Optional[str]:

@@ -458,6 +458,9 @@ class HeadServer:
         # per-deployment router budget reports (ephemeral — one
         # reconcile window repopulates): dep -> rid -> report
         self._serve_budget: Dict[str, dict] = {}
+        # last serve-pressure capacity verdict per deployment (PR 18):
+        # dep -> {"hint": {...}|None, "ts"} — advisory, ephemeral
+        self._serve_capacity_hints: Dict[str, dict] = {}
         # elastic-training gang membership: gang_id -> {"epoch", "owner",
         # "members" {rank -> node_id}, "min_size", "dead_ranks", "updated"}.
         # The epoch is the fence for every gang collective — stragglers
@@ -5610,6 +5613,7 @@ class HeadServer:
                 "usage": dict(req.get("usage") or {}),
                 "waiting": dict(req.get("waiting") or {}),
                 "weights": dict(req.get("weights") or {}),
+                "pressure": dict(req.get("pressure") or {}),
                 "ts": time.monotonic(),
             }
             now = time.monotonic()
@@ -5629,7 +5633,37 @@ class HeadServer:
                 "burst": float(cfg.serve_admission_burst),
                 "headroom": True,
             }
-            return {**share, "window_s": window}
+            # serve pressure → scheduler demand rows (PR 18): fold the
+            # fleet's queued prefill tokens through the autoscaler
+            # kernel against the alive nodes' residual CPU rows; the
+            # hint rides the reply back to the fleet's SLO autoscaler
+            avail = [
+                float((n.resources or {}).get("CPU", 0.0))
+                for n in self.nodes.values()
+                if getattr(n, "alive", True)
+            ]
+            snapshot = {r: dict(rep) for r, rep in reports.items()}
+        hint = None
+        try:
+            from ray_tpu.scheduler.serve_demand import (
+                capacity_plan,
+                pressure_rollup,
+            )
+
+            pressure = pressure_rollup(snapshot)
+            if pressure:
+                hint = capacity_plan(avail, pressure)
+        except Exception:  # noqa: BLE001 - hint is advisory
+            hint = None
+        with self._lock:
+            self._serve_capacity_hints[dep] = {
+                "hint": hint,
+                "ts": time.monotonic(),
+            }
+        reply = {**share, "window_s": window}
+        if hint is not None:
+            reply["capacity_hint"] = hint
+        return reply
 
     def _h_query_state(self, req: dict) -> Any:
         kind = req.get("kind", "summary")
@@ -5851,6 +5885,25 @@ class HeadServer:
                         for dep, f in self._serve_fleets.items()
                     },
                     "stream_leases": len(self._serve_streams),
+                    # per-tenant serve pressure (queued prefill tokens)
+                    # as last reported through the budget RPCs, plus the
+                    # scheduler kernel's capacity verdict on it
+                    "pressure": {
+                        dep: {
+                            rid: dict(rep.get("pressure") or {})
+                            for rid, rep in reports.items()
+                        }
+                        for dep, reports in self._serve_budget.items()
+                    },
+                    # hint timestamps are monotonic (stored at budget
+                    # reconcile time), so age against the same clock
+                    "capacity_hints": {
+                        dep: entry.get("hint")
+                        for dep, entry in (
+                            self._serve_capacity_hints.items()
+                        )
+                        if time.monotonic() - entry.get("ts", 0) < 30.0
+                    },
                 }
             if kind == "dispatch":
                 # the task-lease dispatch plane (lease-cached direct

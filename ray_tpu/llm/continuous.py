@@ -74,6 +74,7 @@ class PagedKVPool:
         # there so their no-op writes can never collide with a live slot's
         # page in the same scatter (duplicate-index order is unspecified)
         self._free = list(range(1, n_pages))
+        self._free_set = set(self._free)
 
     @property
     def free_pages(self) -> int:
@@ -88,10 +89,35 @@ class PagedKVPool:
             return None
         out = self._free[:n]
         del self._free[:n]
+        self._free_set.difference_update(out)
         return out
 
     def free(self, pages: List[int]) -> None:
+        """Return pages to the free-list. Raises on a double free (a
+        page id already free, the scratch page, out-of-range, or a
+        duplicate within ``pages``): silently re-adding a freed page
+        would let ``alloc`` hand the same page to two slots and their
+        KV scatters would corrupt each other."""
+        seen = set()
+        for p in pages:
+            if p in seen:
+                raise ValueError(
+                    f"double free: page {p} appears twice in free({pages})"
+                )
+            if not 0 < p < self.n_pages:
+                raise ValueError(
+                    f"free of invalid page {p} "
+                    f"(scratch page 0 / out of range, n_pages={self.n_pages})"
+                )
+            if p in self._free_set:
+                raise ValueError(
+                    f"double free: page {p} is already on the free-list "
+                    "(one page allocated to two slots corrupts both "
+                    "slots' KV)"
+                )
+            seen.add(p)
         self._free.extend(pages)
+        self._free_set.update(pages)
 
 
 class ContinuousBatchingEngine:
@@ -110,6 +136,7 @@ class ContinuousBatchingEngine:
         use_pallas_attention: bool = False,
         pallas_interpret: bool = False,
         prefix_cache: Optional[Any] = None,
+        model_id: str = "base",
     ):
         if cfg.n_experts > 0:
             raise NotImplementedError(
@@ -145,6 +172,16 @@ class ContinuousBatchingEngine:
         self.queue: deque = deque()
         self.results: Dict[int, List[int]] = {}
         self._next_req = 0
+        # disaggregated serving (PR 18): which weights this engine runs,
+        # bumped by swap_params; manifests stamp both so a decode engine
+        # never grafts KV computed under different weights
+        self.model_id = model_id
+        self.weights_epoch = 0
+        self._swapping = False
+        # full-prefill vs page-adoption accounting: the disagg bench's
+        # zero-re-prefill gate reads these off the decode replicas
+        self.full_prefill_count = 0
+        self.adopted_count = 0
         # device-side slot state
         self.block_tables = jnp.full(
             (self.B, self.max_pages_per_seq), 0, dtype=jnp.int32
@@ -506,6 +543,11 @@ class ContinuousBatchingEngine:
 
     def _admit(self) -> None:
         """Fill free slots from the queue while pages are available."""
+        if self._swapping:
+            # weights hot-swap drain: active slots finish on the OLD
+            # weights-epoch, the queue stays parked until the new
+            # weights are installed — no request ever mixes epochs
+            return
         for si, slot in enumerate(self.slots):
             if slot.active or not self.queue:
                 continue
@@ -543,6 +585,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(pages[:prompt_pages], dtype=jnp.int32),
                 )
                 last_logits = logits[t - 1]
+                self.full_prefill_count += 1
             if self.prefix_cache is not None:
                 # publish this prompt's full pages for other replicas
                 # (reads the pool AFTER prefill wrote it — the np gather
@@ -550,7 +593,7 @@ class ContinuousBatchingEngine:
                 self._prefix_insert(
                     prompt, pages, hit.tokens if hit is not None else 0
                 )
-            first = self._sample_first(req, last_logits, t)
+            first = self._sample_first(req.gen, last_logits, t)
             if hit is not None:
                 # np conversions above synced every consumer of the
                 # pinned views; dropping them releases the arena pin
@@ -652,22 +695,192 @@ class ContinuousBatchingEngine:
             v = np.asarray(self.pool.v[:, :, dev])
         self.prefix_cache.insert(prompt[:ins], k, v)
 
-    def _sample_first(self, req, last_logits, t: int) -> int:
-        if req.gen.temperature > 0.0:
+    def _sample_first(self, gen: GenerationConfig, last_logits, t: int) -> int:
+        if gen.temperature > 0.0:
             # same uint32 normalization as the decode path — one key
             # stream per request across prefill and decode
             kk = jax.random.fold_in(
-                jax.random.PRNGKey(np.uint32(req.gen.seed & 0xFFFFFFFF)),
+                jax.random.PRNGKey(np.uint32(gen.seed & 0xFFFFFFFF)),
                 t,
             )
             return int(
                 jax.random.categorical(
                     kk,
                     jnp.asarray(last_logits)
-                    / max(req.gen.temperature, 1e-6),
+                    / max(gen.temperature, 1e-6),
                 )
             )
         return int(np.asarray(last_logits).argmax())
+
+    # ------------------------------------------------------------------
+    # disaggregated serving: prefill/decode split (PR 18)
+    # ------------------------------------------------------------------
+    def prefill_extract(self, prompt: List[int], gen: GenerationConfig):
+        """Prefill-worker half of the KV handoff: run the bucketed
+        prefill program for ``prompt``, sample the first token
+        (host-side, per-request deterministic — the same
+        ``fold_in(seed, t)`` stream a monolithic admit uses), gather the
+        prompt pages out of the pool, and free them. Returns
+        ``(manifest, k, v)`` where ``k``/``v`` are
+        ``[L, KH, prompt_pages, page, hd]`` blocks — device buffers when
+        the device plane is on (the wire layer seals them as device
+        frames, so the ship to a decode replica rides the striped
+        peer-socket plane and lands with one ``device_put``), host
+        copies otherwise (the host-bounce fallback)."""
+        t = len(prompt)
+        if t < 1:
+            raise ValueError("prefill_extract needs a non-empty prompt")
+        t_pad = max(self.page, -(-t // self.page) * self.page)
+        prompt_pages = t_pad // self.page
+        if prompt_pages > self.max_pages_per_seq:
+            raise ValueError(
+                f"prompt of {t} tokens needs {prompt_pages} pages but "
+                f"max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        pages = self.pool.alloc(prompt_pages)
+        if pages is None:
+            raise MemoryError(
+                "prefill pool exhausted "
+                f"(free={self.pool.free_pages}, need={prompt_pages})"
+            )
+        try:
+            tokens = np.zeros(t_pad, np.int32)
+            tokens[:t] = prompt
+            logits, self.pool.k, self.pool.v = self._prefill(
+                self.params,
+                self.pool.k,
+                self.pool.v,
+                jnp.asarray(tokens),
+                t_pad,
+                jnp.asarray(pages, dtype=jnp.int32),
+            )
+            self.full_prefill_count += 1
+            first = self._sample_first(gen, logits[t - 1], t)
+            dev = jnp.asarray(pages, dtype=jnp.int32)
+            from ray_tpu.cluster import device_plane as _dp
+
+            if _dp.device_plane_enabled():
+                # functional jax arrays: these gathers are new buffers,
+                # so freeing the pool pages below cannot alias them
+                k = self.pool.k[:, :, dev]
+                v = self.pool.v[:, :, dev]
+            else:
+                k = np.asarray(self.pool.k[:, :, dev])
+                v = np.asarray(self.pool.v[:, :, dev])
+        finally:
+            self.pool.free(pages)
+        manifest = {
+            "prompt": list(prompt),
+            "t": t,
+            "first": int(first),
+            "pages": prompt_pages,
+            "page": self.page,
+            "gen": {
+                "max_new_tokens": int(gen.max_new_tokens),
+                "temperature": float(gen.temperature),
+                "seed": int(gen.seed),
+                "eos_token": gen.eos_token,
+            },
+            "model": self.model_id,
+            "weights_epoch": self.weights_epoch,
+        }
+        return manifest, k, v
+
+    def adopt_pages(self, manifest: dict, k, v) -> Optional[int]:
+        """Decode-engine half of the KV handoff: graft prefilled KV
+        pages straight into this engine's pool and admit the request
+        mid-batch — no prefill program runs here (the zero-re-prefill
+        property the disagg bench gates on). Returns the new req_id, or
+        None when the handoff cannot be adopted (mismatched page
+        geometry or model, no free slot, pool backpressure) — the
+        caller falls back to ``submit()``, i.e. a local re-prefill,
+        which is token-exact because generation is seed-deterministic."""
+        if manifest.get("page") != self.page:
+            return None
+        if manifest.get("model", self.model_id) != self.model_id:
+            # KV computed under different weights: grafting it would mix
+            # weights-epochs inside one batch — refuse, re-prefill
+            return None
+        gen = GenerationConfig(**manifest["gen"])
+        prompt = list(manifest["prompt"])
+        t = int(manifest["t"])
+        ship_pages = int(manifest["pages"])
+        si = next(
+            (i for i, s in enumerate(self.slots) if not s.active), None
+        )
+        if si is None:
+            return None
+        need = min(
+            -(-(t + gen.max_new_tokens) // self.page),
+            self.max_pages_per_seq,
+        )
+        need = max(need, ship_pages)
+        if need > self.max_pages_per_seq:
+            return None
+        pages = self.pool.alloc(need)
+        if pages is None:
+            return None  # pool backpressure: the POOL is the capacity
+        rid = self._next_req
+        self._next_req += 1
+        dev = jnp.asarray(pages[:ship_pages], dtype=jnp.int32)
+        if isinstance(k, np.ndarray):
+            k = jnp.asarray(k)
+        if isinstance(v, np.ndarray):
+            v = jnp.asarray(v)
+        self.pool.k = self.pool.k.at[:, :, dev].set(
+            k.astype(self.pool.k.dtype)
+        )
+        self.pool.v = self.pool.v.at[:, :, dev].set(
+            v.astype(self.pool.v.dtype)
+        )
+        table = np.zeros(self.max_pages_per_seq, np.int32)
+        table[: len(pages)] = pages
+        first = int(manifest["first"])
+        slot = self.slots[si]
+        slot.active = True
+        slot.req_id = rid
+        slot.pos = t
+        slot.max_pos = min(
+            t + gen.max_new_tokens - 1, len(pages) * self.page
+        )
+        slot.pages = pages
+        slot.eos = gen.eos_token
+        slot.out = [first]
+        self.block_tables = self.block_tables.at[si].set(
+            jnp.asarray(table)
+        )
+        self.positions = self.positions.at[si].set(t)
+        self.cur_tokens = self.cur_tokens.at[si].set(first)
+        self.active_mask = self.active_mask.at[si].set(True)
+        self.temps = self.temps.at[si].set(float(gen.temperature))
+        self.seeds = self.seeds.at[si].set(
+            np.uint32(gen.seed & 0xFFFFFFFF)
+        )
+        self.adopted_count += 1
+        self._maybe_finish(si)
+        return rid
+
+    # ------------------------------------------------------------------
+    # weights hot-swap (PR 18 model multiplexing)
+    # ------------------------------------------------------------------
+    def swap_params(self, params: Any, model_id: Optional[str] = None) -> int:
+        """Install new weights with epoch-fenced drain semantics (the
+        gang-epoch pattern applied to a replica's weights): admission
+        parks, every ACTIVE slot finishes its generation on the old
+        weights-epoch, then the swap lands and the epoch bumps — no
+        in-flight stream ever crosses weights. Queued requests stay
+        queued and admit on the NEW weights. Returns the new epoch."""
+        self._swapping = True
+        try:
+            while any(s.active for s in self.slots):
+                self.step()
+            self.params = params
+            if model_id is not None:
+                self.model_id = model_id
+            self.weights_epoch += 1
+        finally:
+            self._swapping = False
+        return self.weights_epoch
 
     def _maybe_finish(self, si: int) -> None:
         slot = self.slots[si]
@@ -736,6 +949,13 @@ class ContinuousBatchingEngine:
         same steps). The serving tier pipes this through a
         ray_tpu.experimental Channel for cross-process token streaming."""
         rid = self.submit(prompt, gen)
+        yield from self.stream_rid(rid)
+
+    def stream_rid(self, rid: int):
+        """Stream tokens for an already-registered request id — either
+        one queued via ``submit()`` or one grafted mid-batch via
+        ``adopt_pages()`` (the disaggregated handoff path, where no
+        local prefill ever runs)."""
         yielded = 0
         try:
             while rid not in self.results:
@@ -795,6 +1015,10 @@ class ContinuousBatchingEngine:
             "total_pages": self.pool.n_pages,
             "active_slots": sum(s.active for s in self.slots),
             "queued": len(self.queue),
+            "model_id": self.model_id,
+            "weights_epoch": self.weights_epoch,
+            "full_prefill_count": self.full_prefill_count,
+            "adopted_count": self.adopted_count,
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()

@@ -32,8 +32,10 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
-from typing import Any, Optional
+import time
+from typing import Any, Dict, Optional
 
+import ray_tpu
 import ray_tpu.serve as serve
 from .engine import GenerationConfig, LLMEngine
 
@@ -80,12 +82,52 @@ def build_llm_deployment(
     n_pages: int = 256,
     prefix_cache: bool = True,
     slo: Optional[Any] = None,
+    # disaggregated serving (PR 18): >0 stands up a companion
+    # "<name>-prefill" deployment — the router runs the prefill phase
+    # there, KV pages ship to these (now decode-only) replicas as
+    # sealed device frames, and decode scales independently
+    prefill_replicas: int = 0,
+    # model multiplexing: extra weight pytrees replicas hot-swap
+    # between ({model_id: params}); the base weights are model id
+    # ``base_model_id``
+    variants: Optional[Dict[str, Any]] = None,
+    base_model_id: str = "base",
 ):
     if engine not in ("dense", "continuous"):
         raise ValueError(
             f"unknown engine {engine!r}; expected 'dense' or 'continuous'"
         )
+    if (prefill_replicas or variants) and engine != "continuous":
+        raise ValueError(
+            "prefill/decode disaggregation and model multiplexing "
+            "require engine='continuous' (paged KV)"
+        )
     model_sig = _params_sig(model_config, params, name)
+    models = (
+        [base_model_id, *variants] if variants else None
+    )
+
+    def _make_engine(model_id: str):
+        from .continuous import ContinuousBatchingEngine
+
+        cache = None
+        if prefix_cache:
+            from ray_tpu.serve.prefix_cache import cache_from_cfg
+
+            cache = cache_from_cfg(
+                page_size=page_size, model_sig=model_sig
+            )
+        return ContinuousBatchingEngine(
+            model_config,
+            params,
+            max_batch=max_batch,
+            page_size=page_size,
+            n_pages=n_pages,
+            prefix_cache=cache,
+            model_id=model_id,
+        )
+
+    prefill_dep_name = f"{name}-prefill" if prefill_replicas else None
 
     @serve.deployment(
         name=name,
@@ -95,37 +137,160 @@ def build_llm_deployment(
         resumable_streams=(engine == "continuous"),
         stats_method="serve_stats",
         slo=slo,
+        prefill_deployment=prefill_dep_name,
+        models=models,
     )
     class LLMServer:
         def __init__(self):
             if engine == "continuous":
-                from .continuous import ContinuousBatchingEngine
-
-                cache = None
-                if prefix_cache:
-                    from ray_tpu.serve.prefix_cache import cache_from_cfg
-
-                    cache = cache_from_cfg(
-                        page_size=page_size, model_sig=model_sig
-                    )
-                self.engine = ContinuousBatchingEngine(
-                    model_config,
-                    params,
-                    max_batch=max_batch,
-                    page_size=page_size,
-                    n_pages=n_pages,
-                    prefix_cache=cache,
-                )
+                self.engine = _make_engine(base_model_id)
             else:
                 self.engine = LLMEngine(model_config, params, max_len=max_len)
             self._tokens_out = 0
+            # hot-swap plane: base + variant weights by model id; the
+            # node WeightsHub (shm arena) is probed first so same-node
+            # siblings pull sealed device frames instead of re-reading
+            # the closure capture
+            self._variants = dict(variants or {})
+            self._variants[base_model_id] = getattr(
+                self.engine, "params", params
+            )
+            self._hub = None
+            if variants:
+                from ray_tpu.serve.model_store import hub_from_node
+
+                self._hub = hub_from_node(name)
+            self._swap_lock = threading.Lock()
+            self._swap_done_t: Optional[float] = None
+            self._swaps = 0
+            self._ft_new_count = 0
+            self._ft_new_ms_sum = 0.0
+            # KV handoff accounting (disagg bench kv_handoff_mb_per_s)
+            self._handoff_bytes = 0
+            self._handoff_s = 0.0
+            self._handoffs = 0
+            self._handoff_fallbacks = 0
             self._start_agent_reporter()
+
+        # -- model multiplexing ------------------------------------------
+        def _ensure_model(self, request) -> None:
+            model = (
+                request.get("model") if isinstance(request, dict) else None
+            )
+            if (
+                model
+                and hasattr(self.engine, "swap_params")
+                and model != self.engine.model_id
+            ):
+                self.swap_weights({"model": model})
+
+        def swap_weights(self, request) -> dict:
+            """Admin/routing-triggered weights hot-swap: drain in-flight
+            generation on the old weights-epoch, install the new model's
+            params (WeightsHub device-frame pull when published, closure
+            variant fallback), bump the epoch. Zero stream errors by
+            construction — active slots finish before the swap lands."""
+            from ray_tpu.serve import model_store as ms
+
+            model = request["model"]
+            version = int(request.get("version", 0))
+            with self._swap_lock:
+                if model == self.engine.model_id:
+                    return {
+                        "model": model,
+                        "epoch": self.engine.weights_epoch,
+                        "swapped": False,
+                    }
+                labels = {"deployment": name, "model": str(model)}
+                t0 = time.monotonic()
+                new_params = None
+                if self._hub is not None:
+                    new_params = self._hub.pull(model, version)
+                if new_params is None:
+                    if model not in self._variants:
+                        ms.WEIGHT_SWAP_FAILURES.inc(labels=labels)
+                        raise ValueError(
+                            f"unknown model {model!r} for deployment "
+                            f"{name!r} (known: {sorted(self._variants)})"
+                        )
+                    new_params = self._variants[model]
+                    if self._hub is not None:
+                        # publish for same-node siblings: their pull
+                        # lands device frames straight from the arena
+                        self._hub.publish(model, version, new_params)
+                t_drain = time.monotonic()
+                epoch = self.engine.swap_params(new_params, model_id=model)
+                now = time.monotonic()
+                ms.WEIGHT_SWAP_DRAIN_MS.observe(
+                    (now - t_drain) * 1000.0, labels=labels
+                )
+                ms.WEIGHT_SWAP_MS.observe(
+                    (now - t0) * 1000.0, labels=labels
+                )
+                ms.WEIGHT_SWAPS.inc(labels=labels)
+                self._swap_done_t = now
+                self._swaps += 1
+                return {"model": model, "epoch": epoch, "swapped": True}
+
+        def _note_first_token(self) -> None:
+            """First token generated after a swap: export the
+            first-token-on-new-weights latency exactly once."""
+            if self._swap_done_t is None:
+                return
+            from ray_tpu.serve import model_store as ms
+
+            t, self._swap_done_t = self._swap_done_t, None
+            ft_ms = (time.monotonic() - t) * 1000.0
+            ms.FIRST_TOKEN_NEW_WEIGHTS_MS.observe(
+                ft_ms,
+                labels={
+                    "deployment": name,
+                    "model": str(self.engine.model_id),
+                },
+            )
+            # instance-level mirror of the histogram: metrics are
+            # per-process, so the bench driver (another process) reads
+            # these through serve_stats instead
+            self._ft_new_count += 1
+            self._ft_new_ms_sum += ft_ms
+
+        # -- KV handoff (decode side) ------------------------------------
+        def _adopt_handoff(self, handoff) -> Optional[int]:
+            """Pull the prefill worker's sealed KV pages over the data
+            plane (device landing when the plane is on) and graft them
+            into the engine. Returns the adopted req_id, or None on ANY
+            failure — prefill death mid-handoff, model mismatch, pool
+            backpressure — in which case the caller re-prefills locally
+            (token-exact: generation is seed-deterministic)."""
+            from ray_tpu.cluster import device_plane as _dp
+
+            t0 = time.monotonic()
+            try:
+                ref = handoff[0]
+                if _dp.device_plane_enabled():
+                    with _dp.landing("device"):
+                        manifest, k, v = ray_tpu.get(ref, timeout=30.0)
+                else:
+                    manifest, k, v = ray_tpu.get(ref, timeout=30.0)
+                rid = self.engine.adopt_pages(manifest, k, v)
+            except Exception:  # noqa: BLE001
+                self._handoff_fallbacks += 1
+                return None
+            if rid is None:
+                self._handoff_fallbacks += 1
+                return None
+            self._handoff_bytes += int(k.nbytes) + int(v.nbytes)
+            self._handoff_s += time.monotonic() - t0
+            self._handoffs += 1
+            return rid
 
         # -- request surface ---------------------------------------------
         def __call__(self, request):
+            self._ensure_model(request)
             prompt = request["prompt"]
             gen = _gen_from_request(request)
             text = self.engine.generate([prompt], gen)[0]
+            self._note_first_token()
             return {"prompt": prompt, "generated_text": text}
 
         def stream_tokens(self, request):
@@ -137,9 +302,11 @@ def build_llm_deployment(
                 raise TypeError(
                     "token streaming requires engine='continuous'"
                 )
+            self._ensure_model(request)
             gen = _gen_from_request(request)
             prompt = self.engine.tokenizer.encode(request["prompt"])
             for tok in self.engine.stream_ids(prompt, gen):
+                self._note_first_token()
                 yield self.engine.tokenizer.decode([int(tok)])
 
         def stream_to(self, writer, request):
@@ -152,11 +319,30 @@ def build_llm_deployment(
                 writer.write("streaming requires engine='continuous'")
                 writer.close_channel()
                 return 0
+            self._ensure_model(request)
             gen = _gen_from_request(request)
             skip = max(0, int(request.get("resume_from", 0)))
             prompt = self.engine.tokenizer.encode(request["prompt"])
+            # disaggregated handoff: graft the prefill worker's KV pages
+            # and stream from the adopted slot — no local prefill. Any
+            # handoff failure falls through to stream_ids (local
+            # re-prefill), the same path a resume_from failover takes.
+            rid = None
+            handoff = (
+                request.get("handoff")
+                if isinstance(request, dict)
+                else None
+            )
+            if handoff and not skip and hasattr(self.engine, "adopt_pages"):
+                rid = self._adopt_handoff(handoff)
+            tokens = (
+                self.engine.stream_rid(rid)
+                if rid is not None
+                else self.engine.stream_ids(prompt, gen)
+            )
             n = 0
-            for tok in self.engine.stream_ids(prompt, gen):
+            for tok in tokens:
+                self._note_first_token()
                 if n >= skip:
                     writer.write(self.engine.tokenizer.decode([int(tok)]))
                 n += 1
@@ -177,6 +363,22 @@ def build_llm_deployment(
             return {
                 "pid": os.getpid(),
                 "tokens_out": self._tokens_out,
+                "weight_swaps": self._swaps,
+                "first_token_new_weights_count": self._ft_new_count,
+                "first_token_new_weights_ms_sum": round(
+                    self._ft_new_ms_sum, 3
+                ),
+                "handoffs": self._handoffs,
+                "handoff_fallbacks": self._handoff_fallbacks,
+                "handoff_bytes": self._handoff_bytes,
+                "handoff_s": round(self._handoff_s, 6),
+                "kv_handoff_mb_per_s": (
+                    round(
+                        self._handoff_bytes / self._handoff_s / (1 << 20), 2
+                    )
+                    if self._handoff_s > 0
+                    else None
+                ),
                 **stats,
             }
 
@@ -224,5 +426,62 @@ def build_llm_deployment(
             threading.Thread(
                 target=loop, name="serve-stats-report", daemon=True
             ).start()
+
+    if prefill_replicas:
+        # the companion prefill fleet: runs the bucketed prefill
+        # program, seals the KV pages + manifest as its task result
+        # (device frames when the plane is on), never decodes. Deployed
+        # EAGERLY here so the decode router's prefill orchestration
+        # finds it registered the moment the decode app runs.
+        @serve.deployment(
+            name=prefill_dep_name,
+            num_replicas=prefill_replicas,
+            stats_method="serve_stats",
+            models=models,
+        )
+        class PrefillServer:
+            def __init__(self):
+                self.engine = _make_engine(base_model_id)
+                self._variants = dict(variants or {})
+                self._variants[base_model_id] = self.engine.params
+                self._swap_lock = threading.Lock()
+
+            def prefill(self, request):
+                """One prefill phase: returns ``(manifest, k, v)`` — the
+                sealed KV pages for the prompt plus the page-table
+                manifest (first token included; it is sampled from the
+                same deterministic per-request key stream decode uses)."""
+                model = (
+                    request.get("model")
+                    if isinstance(request, dict)
+                    else None
+                )
+                if model and model != self.engine.model_id:
+                    with self._swap_lock:
+                        if model != self.engine.model_id:
+                            new_params = self._variants.get(model)
+                            if new_params is None:
+                                raise ValueError(
+                                    f"unknown model {model!r} for "
+                                    f"prefill fleet {prefill_dep_name!r}"
+                                )
+                            self.engine.swap_params(
+                                new_params, model_id=model
+                            )
+                gen = _gen_from_request(request)
+                prompt = self.engine.tokenizer.encode(request["prompt"])
+                return self.engine.prefill_extract(prompt, gen)
+
+            def pid(self) -> int:
+                return os.getpid()
+
+            def serve_stats(self) -> dict:
+                return {
+                    "pid": os.getpid(),
+                    "role": "prefill",
+                    **self.engine.stats(),
+                }
+
+        serve.run(PrefillServer.bind())
 
     return LLMServer.bind()

@@ -15,6 +15,7 @@ from .deployment import (  # noqa: F401
     Application,
     Deployment,
     DeploymentHandle,
+    NoReplicasForModel,
     deployment,
     get_deployment_handle,
     get_router,

@@ -118,14 +118,17 @@ class Ticket:
 
 
 class _Waiter:
-    __slots__ = ("tenant", "vft", "seq", "granted", "abandoned")
+    __slots__ = ("tenant", "vft", "seq", "granted", "abandoned", "cost")
 
-    def __init__(self, tenant: str, vft: float, seq: int):
+    def __init__(self, tenant: str, vft: float, seq: int, cost: int = 0):
         self.tenant = tenant
         self.vft = vft  # WFQ virtual finish time
         self.seq = seq
         self.granted = False
         self.abandoned = False
+        # approximate prefill cost (prompt tokens): aggregated per
+        # tenant into the scheduler-facing demand pressure export
+        self.cost = int(cost)
 
 
 class AdmissionController:
@@ -169,12 +172,17 @@ class AdmissionController:
 
     # -- the one public gate -------------------------------------------
     def admit(
-        self, tenant: str = "default", timeout_s: Optional[float] = None
+        self,
+        tenant: str = "default",
+        timeout_s: Optional[float] = None,
+        cost: int = 0,
     ) -> Ticket:
         """Admit one request or raise :class:`Overloaded`. Blocks up to
         ``timeout_s`` in the WFQ waiting room when the fast path is
         contended; a granted admission returns a :class:`Ticket` whose
-        ``done()`` releases the in-flight slot."""
+        ``done()`` releases the in-flight slot. ``cost`` is the
+        request's approximate prefill cost in prompt tokens — it does
+        not change WFQ ordering, only the per-tenant pressure export."""
         timeout_s = (
             self.wait_timeout_s if timeout_s is None else float(timeout_s)
         )
@@ -192,7 +200,7 @@ class AdmissionController:
                 return self._grant_locked(tenant)
             if self._waiting >= self.wait_cap:
                 return self._shed_locked("queue_full")
-            waiter = self._park_locked(tenant)
+            waiter = self._park_locked(tenant, cost)
             deadline = time.monotonic() + timeout_s
             try:
                 while True:
@@ -229,8 +237,10 @@ class AdmissionController:
         self._tenant_vft[tenant] = vft
         return vft
 
-    def _park_locked(self, tenant: str) -> _Waiter:
-        waiter = _Waiter(tenant, self._account_locked(tenant), next(self._seq))
+    def _park_locked(self, tenant: str, cost: int = 0) -> _Waiter:
+        waiter = _Waiter(
+            tenant, self._account_locked(tenant), next(self._seq), cost
+        )
         self._queues.setdefault(tenant, deque()).append(waiter)
         self._waiting += 1
         SERVE_WAITING.set(self._waiting)
@@ -354,6 +364,23 @@ class AdmissionController:
                 for t, q in self._queues.items()
                 if q
             }
+
+    def pressure_by_tenant(self) -> Dict[str, Dict[str, int]]:
+        """Scheduler-facing serve pressure per tenant: parked request
+        count AND their queued prefill tokens (the ``cost`` each admit
+        carried). The fleet reconcile ships this to the head, which
+        feeds it as demand rows to the multi-objective capacity
+        kernel — capacity follows serve pressure, not just counts."""
+        with self._cv:
+            out: Dict[str, Dict[str, int]] = {}
+            for t, q in self._queues.items():
+                live = [w for w in q if not w.abandoned]
+                if live:
+                    out[t] = {
+                        "waiting": len(live),
+                        "waiting_tokens": sum(w.cost for w in live),
+                    }
+            return out
 
     def set_tenant_weights(self, weights: Dict[str, float]) -> None:
         with self._cv:

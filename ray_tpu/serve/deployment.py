@@ -43,6 +43,13 @@ class Deployment:
     # fleet's budget reconciliation (a weight-3 tenant drains ~3x a
     # weight-1 tenant even when their streams land on different routers)
     tenant_weights: Optional[Dict[str, float]] = None
+    # disaggregated serving (PR 18): the companion prefill deployment's
+    # name — the router runs the prefill phase there and ships sealed KV
+    # pages to this deployment's decode replicas; None = monolithic
+    prefill_deployment: Optional[str] = None
+    # model ids this deployment can multiplex (hot-swap targets); None
+    # means single-model, any request "model" is accepted as-is
+    models: Optional[List[str]] = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -59,6 +66,8 @@ class Deployment:
             self.stats_method,
             self.slo,
             dict(self.tenant_weights) if self.tenant_weights else None,
+            self.prefill_deployment,
+            list(self.models) if self.models else None,
         )
         for k, v in overrides.items():
             setattr(d, k, v)
@@ -103,11 +112,29 @@ class NoPreferredReplica(RuntimeError):
     satisfies the caller's predicate (e.g. same-host for shm streaming)."""
 
 
+class NoReplicasForModel(RuntimeError):
+    """Retryable per-*model* empty set: the deployment has live replicas
+    but none can serve the requested model id (unknown model, or every
+    swap candidate is draining). Distinct from the all-replicas-dead
+    RuntimeError so per-model SLO signals don't cross-contaminate."""
+
+    def __init__(self, deployment: str, model: str, reason: str):
+        super().__init__(
+            f"no replicas for model {model!r} in deployment "
+            f"{deployment!r} ({reason})"
+        )
+        self.deployment = deployment
+        self.model = model
+
+
 @dataclass
 class _Replica:
     actor: Any
     ongoing: int = 0
     draining: bool = False
+    # which weights this replica currently holds (model multiplexing):
+    # None until the first model-tagged request lands on it
+    model: Optional[str] = None
 
 
 class _ReplicaSet:
@@ -247,7 +274,8 @@ class _ReplicaSet:
             ray_tpu.kill(victim.actor)
 
     # power-of-two-choices routing (pow_2_router.py:27)
-    def _pick_replica(self, prefer=None, strict_prefer=False) -> _Replica:
+    def _pick_replica(self, prefer=None, strict_prefer=False,
+                      model: Optional[str] = None) -> _Replica:
         # caller holds self.lock
         cands = [r for r in self.replicas if not r.draining]
         if not cands:
@@ -272,6 +300,36 @@ class _ReplicaSet:
                 cands = preferred
             elif strict_prefer:
                 raise NoPreferredReplica(self.dep.name)
+        if model is not None:
+            # model multiplexing: p2c compares queue depth only WITHIN a
+            # model's replica set — depths across different weights are
+            # not comparable (a hot 70B variant's 3 ≠ a LoRA's 3)
+            if self.dep.models is not None and model not in self.dep.models:
+                raise NoReplicasForModel(
+                    self.dep.name, model, "unknown model id"
+                )
+            same = [r for r in cands if r.model == model]
+            if same:
+                cands = same
+            else:
+                # cold model: swap on the least-loaded compatible
+                # replica, preferring one that never took a variant.
+                # Marked optimistically here (under self.lock) so a
+                # concurrent second request for the same model routes to
+                # this replica's queue instead of triggering a second
+                # swap; the replica installs the weights on arrival.
+                swappable = [r for r in cands if not r.draining]
+                if not swappable:
+                    raise NoReplicasForModel(
+                        self.dep.name, model,
+                        "all swap candidates draining",
+                    )
+                fresh = [r for r in swappable if r.model is None]
+                victim = min(
+                    fresh or swappable, key=lambda r: r.ongoing
+                )
+                victim.model = model
+                return victim
         if len(cands) == 1:
             return cands[0]
         a, b = random.sample(cands, 2)
@@ -285,12 +343,12 @@ class _ReplicaSet:
         return ref
 
     def submit_traced(self, method: str, args, kwargs, prefer=None,
-                      strict_prefer=False):
+                      strict_prefer=False, model: Optional[str] = None):
         """Like ``submit`` but also returns the chosen replica — the
         serving router needs it for failover bookkeeping and
         lease-channel accounting."""
         with self.lock:
-            replica = self._pick_replica(prefer, strict_prefer)
+            replica = self._pick_replica(prefer, strict_prefer, model)
             replica.ongoing += 1
             self.total_requests += 1
             actor = replica.actor

@@ -293,6 +293,7 @@ class _LocalFleetCoordinator:
         usage: Dict[str, int],
         waiting: Dict[str, int],
         weights: Dict[str, float],
+        pressure: Optional[Dict[str, dict]] = None,
     ) -> dict:
         from ray_tpu.config import cfg
 
@@ -307,6 +308,7 @@ class _LocalFleetCoordinator:
                 "usage": dict(usage),
                 "waiting": dict(waiting),
                 "weights": dict(weights or {}),
+                "pressure": dict(pressure or {}),
                 "ts": time.monotonic(),
             }
             now = time.monotonic()
@@ -326,7 +328,32 @@ class _LocalFleetCoordinator:
                 "burst": float(cfg.serve_admission_burst),
                 "headroom": True,
             }
-            return {**share, "window_s": window}
+            hint = _capacity_hint_local(fresh)
+        reply = {**share, "window_s": window}
+        if hint is not None:
+            reply["capacity_hint"] = hint
+        return reply
+
+
+def _capacity_hint_local(fresh: Dict[str, dict]) -> Optional[dict]:
+    """Serve pressure → capacity hint for the OFF-cluster coordinator:
+    the same demand-row kernel path the head runs, against this
+    process's CPU count as the lone avail row — so unit tests exercise
+    the full pressure→kernel→hint loop without a cluster."""
+    try:
+        from ray_tpu.scheduler.serve_demand import (
+            capacity_plan,
+            pressure_rollup,
+        )
+
+        pressure = pressure_rollup(fresh)
+        if not pressure:
+            return None
+        import os
+
+        return capacity_plan([float(os.cpu_count() or 1)], pressure)
+    except Exception:  # noqa: BLE001 - hint is advisory, never fatal
+        return None
 
 
 class _HeadFleetCoordinator:
@@ -397,7 +424,8 @@ class _HeadFleetCoordinator:
         return reply.get("row")
 
     def budget(
-        self, deployment, router_id, epoch, usage, waiting, weights
+        self, deployment, router_id, epoch, usage, waiting, weights,
+        pressure=None,
     ) -> dict:
         return self._call(
             "ServeBudget",
@@ -408,6 +436,7 @@ class _HeadFleetCoordinator:
                 "usage": dict(usage),
                 "waiting": dict(waiting),
                 "weights": dict(weights or {}),
+                "pressure": dict(pressure or {}),
             },
         )
 
@@ -631,6 +660,10 @@ class RouterFleet:
         self._closed = False
         self._reconciler: Optional[threading.Thread] = None
         self._reporter: Optional[threading.Thread] = None
+        # last scheduler capacity hint from the budget reply (serve
+        # pressure fed through the autoscaler kernel); advisory
+        self._capacity_hint: Optional[dict] = None
+        self._capacity_hint_ts = 0.0
         qps = float(cfg.serve_admission_qps)
         burst = float(cfg.serve_admission_burst)
         for i in range(n):
@@ -844,9 +877,20 @@ class RouterFleet:
             adm = router.admission
             usage = adm.take_usage()
             waiting = adm.waiting_by_tenant()
+            # serve pressure export (PR 18): queued prefill tokens +
+            # parked requests per tenant ride the budget RPC to the
+            # coordinator, which feeds them as demand rows to the
+            # autoscaler kernel — the reply's capacity_hint closes the
+            # loop back into the SLO autoscaler
+            pressure = (
+                adm.pressure_by_tenant()
+                if hasattr(adm, "pressure_by_tenant")
+                else {}
+            )
             try:
                 reply = self._coord.budget(
-                    self._dep, rid, epoch, usage, waiting, self._weights
+                    self._dep, rid, epoch, usage, waiting, self._weights,
+                    pressure=pressure,
                 )
             except RouterDeposedError:
                 self._refresh_assignment()
@@ -865,6 +909,10 @@ class RouterFleet:
             adm.note_global_budget(
                 bool(reply.get("headroom")), window
             )
+            if reply.get("capacity_hint") is not None:
+                with self._lock:
+                    self._capacity_hint = dict(reply["capacity_hint"])
+                    self._capacity_hint_ts = time.monotonic()
 
     # -- chaos -----------------------------------------------------------
     def chaos_kill_router(self, rid: Optional[str] = None, rng=None):
@@ -943,8 +991,23 @@ class RouterFleet:
                 self._labels
             ),
             "failover_s": SERVE_ROUTER_FAILOVER_S.summary(self._labels),
+            "capacity_hint": self.capacity_hint(),
         }
         return base
+
+    def capacity_hint(self, max_age_s: float = 10.0) -> Optional[dict]:
+        """The scheduler's last serve-pressure capacity verdict (how
+        many replica-equivalents the queued demand justifies and
+        whether the cluster could place them), or None when stale or
+        never reported. SLO autoscalers read it as an upscale
+        corroboration signal."""
+        with self._lock:
+            if (
+                self._capacity_hint is None
+                or time.monotonic() - self._capacity_hint_ts > max_age_s
+            ):
+                return None
+            return dict(self._capacity_hint)
 
     def note_ttft_sample(self, ttft_ms: float) -> None:
         for _, router in self.live_routers():

@@ -1,0 +1,187 @@
+"""Model-weights hub in the shared-memory arena (hot-swap plane).
+
+Variant/LoRA weights a deployment multiplexes are published ONCE per
+node into the shm arena (the PR 3 object plane) under a deterministic
+object id derived from ``(deployment, model_id, version)`` — the arena
+itself is the index, first writer wins, concurrent publishes of the
+same version are benign no-ops (same idiom as the prefix cache).
+
+A replica swapping onto a cold model pulls the pytree back through the
+zero-copy wire format: with the device plane on, every ``jax.Array``
+leaf was sealed as a device frame at publish time, so ``pull`` lands
+them with one ``device_put`` each straight from the arena pages — no
+intermediate host materialisation, no pickle of device memory. Host
+mode falls back to read-only numpy views; ``jnp.asarray`` in the model
+forward pays the single H2D hop lazily.
+
+Swap observability lives here too: every hot-swap's wall-clock, drain
+time, and the first-token-on-new-weights latency are exported so the
+bench's zero-stream-errors swap row has numbers to gate on.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Optional
+
+from ray_tpu.cluster import serialization as wire
+from ray_tpu.util.metrics import Counter, Histogram
+
+WEIGHT_SWAPS = Counter(
+    "serve_weight_swaps_total",
+    "Completed replica weight hot-swaps.",
+    label_names=("deployment", "model"),
+)
+WEIGHT_SWAP_FAILURES = Counter(
+    "serve_weight_swap_failures_total",
+    "Weight hot-swaps that failed (pull miss, bad version, error).",
+    label_names=("deployment", "model"),
+)
+WEIGHT_SWAP_MS = Histogram(
+    "serve_weight_swap_ms",
+    "End-to-end hot-swap wall time: drain + pull + install (ms).",
+    boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+    label_names=("deployment", "model"),
+)
+WEIGHT_SWAP_DRAIN_MS = Histogram(
+    "serve_weight_swap_drain_ms",
+    "Time draining in-flight generation on the old weights-epoch (ms).",
+    boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+    label_names=("deployment", "model"),
+)
+FIRST_TOKEN_NEW_WEIGHTS_MS = Histogram(
+    "serve_first_token_new_weights_ms",
+    "Latency from swap completion to the first token generated on the "
+    "new weights-epoch (ms).",
+    boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+    label_names=("deployment", "model"),
+)
+
+
+def weights_oid(deployment: str, model_id: str, version: int) -> str:
+    """Deterministic arena object id for one published weights pytree —
+    any process on the node derives the same id, so there is no side
+    table to reconcile."""
+    return hashlib.sha256(
+        b"wts\0"
+        + deployment.encode()
+        + b"\0"
+        + model_id.encode()
+        + b"\0"
+        + str(int(version)).encode()
+    ).hexdigest()[:32]
+
+
+class WeightsHub:
+    """Publish/pull weights pytrees through a ``NativeObjectStore``-like
+    object (needs ``put_frames``/``get_view``/``contains``/``delete``).
+
+    Best-effort by design: a failed publish or a pull miss just means
+    the caller falls back to its closure-captured variant params (the
+    cold-start path) — correctness never depends on the arena.
+    """
+
+    def __init__(self, store, deployment: str):
+        self.store = store
+        self.deployment = deployment
+        self._lock = threading.Lock()
+        self._mine: dict = {}  # oid -> size, for best-effort cleanup
+
+    # -- publish -------------------------------------------------------
+    def publish(self, model_id: str, version: int, params: Any) -> bool:
+        """Seal ``params`` into the arena under its deterministic oid.
+        jax.Array leaves go in as device frames when the device plane is
+        on (zero-copy export on host-aliasing backends). Returns False
+        when the entry already exists or the arena cannot take it."""
+        oid = weights_oid(self.deployment, model_id, version)
+        try:
+            if self.store.contains(oid):
+                return False
+        except Exception:  # noqa: BLE001
+            return False
+        meta = {
+            "deployment": self.deployment,
+            "model": model_id,
+            "version": int(version),
+        }
+        try:
+            parts, total = wire.dumps_parts((meta, params))
+        except Exception:  # noqa: BLE001 - unsealable leaf
+            return False
+        for attempt in (0, 1):
+            try:
+                self.store.put_frames(oid, parts)
+                break
+            except KeyError:
+                return False  # concurrent publisher won the race
+            except MemoryError:
+                if attempt == 1:
+                    return False
+                with self._lock:
+                    # arena pressure: drop our own older versions first
+                    self._evict_locked()
+            except Exception:  # noqa: BLE001 - store gone
+                return False
+        with self._lock:
+            self._mine[oid] = total
+        return True
+
+    def _evict_locked(self) -> None:
+        while self._mine:
+            oid, _size = self._mine.popitem()
+            try:
+                self.store.delete(oid)
+            except Exception:  # noqa: BLE001 - already gone
+                pass
+
+    # -- pull ----------------------------------------------------------
+    def pull(self, model_id: str, version: int) -> Optional[Any]:
+        """The published pytree for ``(model_id, version)``, or None on
+        a miss. Device-frame leaves come back as ``jax.Array`` (one
+        device_put each, straight from the arena page — request the
+        device landing explicitly so the wire layer knows the frames
+        should not bounce through host staging); host-sealed leaves are
+        READ-ONLY numpy views that alias the arena until the returned
+        tree is garbage collected."""
+        oid = weights_oid(self.deployment, model_id, version)
+        try:
+            view = self.store.get_view(oid)
+        except KeyError:
+            return None
+        except Exception:  # noqa: BLE001
+            return None
+        try:
+            from ray_tpu.cluster import device_plane as _dp
+
+            if _dp.device_plane_enabled():
+                with _dp.landing("device"):
+                    meta, params = wire.loads(view)
+            else:
+                meta, params = wire.loads(view)
+        except Exception:  # noqa: BLE001 - corrupt entry
+            return None
+        if meta.get("model") != model_id or meta.get("version") != int(
+            version
+        ):
+            return None
+        return params
+
+    def contains(self, model_id: str, version: int) -> bool:
+        try:
+            return self.store.contains(
+                weights_oid(self.deployment, model_id, version)
+            )
+        except Exception:  # noqa: BLE001
+            return False
+
+
+def hub_from_node(deployment: str) -> Optional[WeightsHub]:
+    """A :class:`WeightsHub` over this node's shm arena (the worker's
+    open handle, or the process-local fallback arena the prefix cache
+    also uses); None when no native store is reachable."""
+    from ray_tpu.serve.prefix_cache import node_store
+
+    store = node_store()
+    if store is None:
+        return None
+    return WeightsHub(store, deployment)

@@ -43,23 +43,29 @@ from .admission import AdmissionController, Overloaded, controller_from_cfg
 _MS_BOUNDS = (1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000)
 
 # every instrument is labeled by deployment: two deployments in one
-# process must not contaminate each other's SLO signals or stats
+# process must not contaminate each other's SLO signals or stats.
+# serve_requests_total / TTFT / TPOT additionally carry a "model" label
+# (multiplexed deployments must not cross-contaminate per-model SLO
+# signals). DUAL-OBSERVE convention: the aggregate series (model="") is
+# ALWAYS observed — existing readers that pass only {"deployment": d}
+# match exactly that series — and a per-model series is observed in
+# addition whenever the request carries a model id.
 SERVE_REQUESTS = Counter(
     "serve_requests_total",
     "Serving-plane requests by final status code.",
-    label_names=("code", "deployment"),
+    label_names=("code", "deployment", "model"),
 )
 SERVE_TTFT_MS = Histogram(
     "serve_ttft_ms",
     "Time to first streamed delta (ms).",
     boundaries=_MS_BOUNDS,
-    label_names=("deployment",),
+    label_names=("deployment", "model"),
 )
 SERVE_TPOT_MS = Histogram(
     "serve_tpot_ms",
     "Mean time per output delta after the first (ms), per stream.",
     boundaries=_MS_BOUNDS,
-    label_names=("deployment",),
+    label_names=("deployment", "model"),
 )
 SERVE_E2E_MS = Histogram(
     "serve_e2e_ms",
@@ -113,6 +119,18 @@ class RouterKilled(RuntimeError):
     not fire; recovery is fleet-level: the sibling inheriting the
     tenant's hash range re-dispatches with ``resume_from`` taken from
     the replicated stream-lease table."""
+
+
+def _request_cost(payload) -> int:
+    """Approximate prefill cost of a request in tokens (prompt length):
+    the admission controller aggregates it per tenant so the fleet's
+    budget reconcile can export QUEUED PREFILL TOKENS — not just request
+    counts — as scheduler demand pressure."""
+    if isinstance(payload, dict):
+        prompt = payload.get("prompt")
+        if isinstance(prompt, (str, list)):
+            return len(prompt)
+    return 0
 
 
 def _is_closed_exc(exc: BaseException) -> bool:
@@ -500,6 +518,14 @@ class RoutedStream:
         self._reader = self._ref = self._replica = None
         self._cleanup = lambda cancelled=False: None
         self._labels = {"deployment": router._rs.dep.name}
+        self.model = (
+            payload.get("model") if isinstance(payload, dict) else None
+        )
+        # dual-observe: model-tagged requests additionally land on the
+        # per-model series of the model-labeled instruments
+        self._mlabels = (
+            {**self._labels, "model": str(self.model)} if self.model else None
+        )
         SERVE_STREAMS.inc(labels=self._labels)
         try:
             self._attach(router._dispatch_stream(payload, self.resume_base))
@@ -549,9 +575,10 @@ class RoutedStream:
             now = time.monotonic()
             if self._t_first is None:
                 self._t_first = now
-                SERVE_TTFT_MS.observe(
-                    (now - self._t0) * 1000.0, labels=self._labels
-                )
+                ttft = (now - self._t0) * 1000.0
+                SERVE_TTFT_MS.observe(ttft, labels=self._labels)
+                if self._mlabels:
+                    SERVE_TTFT_MS.observe(ttft, labels=self._mlabels)
             self._t_last = now
             self.delivered += 1
             return value
@@ -595,9 +622,10 @@ class RoutedStream:
         now = time.monotonic()
         if self._t_first is None:
             self._t_first = now
-            SERVE_TTFT_MS.observe(
-                (now - self._t0) * 1000.0, labels=self._labels
-            )
+            ttft = (now - self._t0) * 1000.0
+            SERVE_TTFT_MS.observe(ttft, labels=self._labels)
+            if self._mlabels:
+                SERVE_TTFT_MS.observe(ttft, labels=self._mlabels)
         self._t_last = now
         self.delivered += 1
         return value
@@ -656,6 +684,8 @@ class RoutedStream:
             pass
         SERVE_STREAMS.dec(labels=self._labels)
         SERVE_REQUESTS.inc(labels={"code": code, **self._labels})
+        if self._mlabels:
+            SERVE_REQUESTS.inc(labels={"code": code, **self._mlabels})
         SERVE_E2E_MS.observe(
             (time.monotonic() - self._t0) * 1000.0, labels=self._labels
         )
@@ -664,12 +694,14 @@ class RoutedStream:
             and self._t_last is not None
             and self.delivered > 1
         ):
-            SERVE_TPOT_MS.observe(
+            tpot = (
                 (self._t_last - self._t_first)
                 / (self.delivered - 1)
-                * 1000.0,
-                labels=self._labels,
+                * 1000.0
             )
+            SERVE_TPOT_MS.observe(tpot, labels=self._labels)
+            if self._mlabels:
+                SERVE_TPOT_MS.observe(tpot, labels=self._mlabels)
         try:
             # request-lifecycle span (ISSUE 15): one slice per stream in
             # the Chrome-trace export, beside the task slices it caused
@@ -709,7 +741,7 @@ class RoutedStream:
 # the router
 # ---------------------------------------------------------------------------
 class _UnaryRequest:
-    def __init__(self, router, ref, ticket, t0):
+    def __init__(self, router, ref, ticket, t0, model=None):
         self._router = router
         self.ref = ref
         self._ticket = ticket
@@ -717,6 +749,9 @@ class _UnaryRequest:
         self._t0_wall = time.time()
         self._done = False
         self._labels = {"deployment": router._rs.dep.name}
+        self._mlabels = (
+            {**self._labels, "model": str(model)} if model else None
+        )
 
     def result(self, timeout: float = 60.0):
         try:
@@ -741,6 +776,8 @@ class _UnaryRequest:
         if not self._done:
             self._done = True
             SERVE_REQUESTS.inc(labels={"code": code, **self._labels})
+            if self._mlabels:
+                SERVE_REQUESTS.inc(labels={"code": code, **self._mlabels})
             SERVE_E2E_MS.observe(
                 (time.monotonic() - self._t0) * 1000.0,
                 labels=self._labels,
@@ -799,21 +836,40 @@ class ServeRouter:
     def submit(
         self, payload, tenant: str = "default", method: str = "__call__"
     ) -> _UnaryRequest:
-        ticket = self.admission.admit(tenant)
+        from .deployment import NoReplicasForModel
+
+        model = (
+            payload.get("model") if isinstance(payload, dict) else None
+        )
+        ticket = self.admission.admit(
+            tenant, cost=_request_cost(payload)
+        )
         t0 = time.monotonic()
         hit = None
         try:
-            ref, replica = self._rs.submit_traced(method, (payload,), {})
+            ref, replica = self._rs.submit_traced(
+                method, (payload,), {}, model=model
+            )
             hit = self._lease_hit(replica)
-        except BaseException:
+        except BaseException as exc:
             ticket.done()
-            SERVE_REQUESTS.inc(labels={"code": "500", **self._labels})
-            self._note_finished("500")
+            # per-model empty set is retryable (503), not a server error
+            code = "503" if isinstance(exc, NoReplicasForModel) else "500"
+            SERVE_REQUESTS.inc(labels={"code": code, **self._labels})
+            if model:
+                SERVE_REQUESTS.inc(
+                    labels={
+                        "code": code,
+                        **self._labels,
+                        "model": str(model),
+                    }
+                )
+            self._note_finished(code)
             raise
         (SERVE_LEASE_HITS if hit else SERVE_LEASE_MISSES).inc(
             labels=self._labels
         )
-        return _UnaryRequest(self, ref, ticket, t0)
+        return _UnaryRequest(self, ref, ticket, t0, model=model)
 
     def call(
         self,
@@ -828,7 +884,9 @@ class ServeRouter:
     def stream(
         self, payload, tenant: str = "default", resume_base: int = 0
     ) -> RoutedStream:
-        ticket = self.admission.admit(tenant)
+        ticket = self.admission.admit(
+            tenant, cost=_request_cost(payload)
+        )
         try:
             return RoutedStream(
                 self, payload, tenant, ticket, resume_base=resume_base
@@ -844,12 +902,24 @@ class ServeRouter:
         ``(reader, ref, replica, cleanup(cancelled=...))``."""
         from ray_tpu.config import cfg
 
+        model = (
+            payload.get("model") if isinstance(payload, dict) else None
+        )
         req = payload
         if resume_from:
             req = dict(payload or {})
             req["resume_from"] = int(resume_from)
+        pref_ref = self._maybe_prefill(payload, resume_from, model)
+        if pref_ref is not None:
+            # ship the prefill result BY REFERENCE nested under a list:
+            # only top-level ObjectRef args resolve at dispatch, so the
+            # decode replica receives the ref itself and pulls the
+            # sealed KV pages over the data plane (land="device") —
+            # never through this router
+            req = dict(req if resume_from else (payload or {}))
+            req["handoff"] = [pref_ref]
         if cfg.serve_shm_streams:
-            dispatched = self._try_shm_stream(req)
+            dispatched = self._try_shm_stream(req, model)
             if dispatched is not None:
                 return dispatched
         if cfg.serve_push_streams:
@@ -858,7 +928,7 @@ class ServeRouter:
             writer = PushWriter(sink.address, sid)
             try:
                 ref, replica = self._rs.submit_traced(
-                    "stream_to", (writer, req), {}
+                    "stream_to", (writer, req), {}, model=model
                 )
             except BaseException:
                 sink.discard(sid)
@@ -898,7 +968,39 @@ class ServeRouter:
 
         return reader, ref, None, cleanup
 
-    def _try_shm_stream(self, req):
+    def _maybe_prefill(self, payload, resume_from: int, model):
+        """Disaggregated split: when this deployment has a companion
+        prefill fleet, run the prefill phase there and return the
+        (unresolved) result ref — ``(manifest, k, v)`` with the KV pages
+        sealed as device frames. Returns None when disaggregation does
+        not apply: monolithic deployment, non-prompt payload, or a
+        FAILOVER re-dispatch (``resume_from > 0`` re-prefills locally on
+        the sibling — deterministic generation keeps it token-exact,
+        and the dead prefill node is out of the path)."""
+        pref_name = getattr(self._rs.dep, "prefill_deployment", None)
+        if (
+            not pref_name
+            or resume_from
+            or not isinstance(payload, dict)
+            or "prompt" not in payload
+        ):
+            return None
+        from .deployment import _apps
+
+        pref_rs = _apps.get(pref_name)
+        if pref_rs is None:
+            return None
+        try:
+            ref, _replica = pref_rs.submit_traced(
+                "prefill", (dict(payload),), {}, model=model
+            )
+            return ref
+        except Exception:  # noqa: BLE001
+            # prefill fleet unavailable (backfill window, dead node):
+            # monolithic fallback — the decode replica prefills locally
+            return None
+
+    def _try_shm_stream(self, req, model=None):
         """Same-host shm ring (strictly pinned); None when no same-host
         replica exists."""
         from ray_tpu.experimental import Channel
@@ -920,6 +1022,7 @@ class ServeRouter:
                 {},
                 prefer=pred,
                 strict_prefer=True,
+                model=model,
             )
         except NoPreferredReplica:
             ch.destroy()
@@ -1001,6 +1104,7 @@ class ServeRouter:
                     "actor_id": getattr(r.actor, "_actor_id", None),
                     "ongoing": r.ongoing,
                     "draining": r.draining,
+                    "model": r.model,
                 }
                 for r in self._rs.replicas
             ]

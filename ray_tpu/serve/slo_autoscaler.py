@@ -89,6 +89,7 @@ class SLOAutoscaler:
         self.last_decision = "init"
         self.scale_ups = 0
         self.scale_downs = 0
+        self.capacity_blocks = 0
 
     # -- metrics --------------------------------------------------------
     def _default_metrics(self) -> dict:
@@ -139,11 +140,23 @@ class SLOAutoscaler:
                 now - self._over_since >= slo.upscale_delay_s
                 and m["replicas"] < slo.max_replicas
             ):
-                self.router._rs.add_replica()
-                self._over_since = None
-                self.scale_ups += 1
-                SERVE_AUTOSCALE_EVENTS.inc(labels={"direction": "up"})
-                decision = "up"
+                # Corroborate against the scheduler kernel's serve-
+                # pressure verdict when the fleet reconcile has a fresh
+                # one: if bin-packing found zero residual room for
+                # another replica-shaped row, adding a replica would
+                # only oversubscribe the same nodes — hold the window
+                # armed and retry next tick instead.
+                if self._capacity_blocked():
+                    self.capacity_blocks += 1
+                    decision = "hold-capacity"
+                else:
+                    self.router._rs.add_replica()
+                    self._over_since = None
+                    self.scale_ups += 1
+                    SERVE_AUTOSCALE_EVENTS.inc(
+                        labels={"direction": "up"}
+                    )
+                    decision = "up"
         elif under:
             self._over_since = None
             if self._under_since is None:
@@ -162,6 +175,25 @@ class SLOAutoscaler:
             self._under_since = None
         self.last_decision = decision
         return decision
+
+    def _capacity_blocked(self) -> bool:
+        """True when a fresh fleet capacity hint (PR 18: per-tenant serve
+        pressure pushed through the bin-pack kernel) reports zero
+        placeable replica rows. Routers without a fleet — or with a
+        stale hint — never block."""
+        hint_fn = getattr(self.router, "capacity_hint", None)
+        if not callable(hint_fn):
+            return False
+        try:
+            hint = hint_fn()
+        except Exception:  # noqa: BLE001 - advisory signal only
+            return False
+        if not isinstance(hint, dict):
+            return False
+        try:
+            return int(hint.get("replicas_placeable", 1)) <= 0
+        except (TypeError, ValueError):
+            return False
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -194,6 +226,7 @@ class SLOAutoscaler:
             "last_decision": self.last_decision,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
+            "capacity_blocks": self.capacity_blocks,
             "min_replicas": self.slo.min_replicas,
             "max_replicas": self.slo.max_replicas,
             "target_ttft_ms": self.slo.target_ttft_ms,
