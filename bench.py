@@ -1060,6 +1060,7 @@ class _TpuTiers:
         self.skip_reason = None  # last device-preflight failure, if any
         self.tail = ""
         self.bundle_paths: list = []  # crash bundles captured on wedges
+        self.wedge_strikes = 0  # consecutive children that marked NO stage
         self.spent_s = 0.0
         # total wall-clock across ALL attempts: a backend that comes up
         # but wedges INSIDE the kernel/model stages would otherwise burn
@@ -1114,6 +1115,21 @@ class _TpuTiers:
         attempt immediately instead of timing out three full stage
         budgets."""
         if self.done():
+            return
+        if self.wedge_strikes >= 2:
+            # r4/r5 wedge signature (diagnosed from the PR 15 crash
+            # bundles): the preflight probe passes, but the child then
+            # hangs inside backend bring-up until the BACKEND stage
+            # budget expires, marking NOTHING. Two of those in a row
+            # mean the tunnel is wedged for this run — further attempts
+            # are pure budget burn, so strike out explicitly.
+            self.attempts.append(
+                {
+                    "label": label,
+                    "outcome": "skipped: backend wedge strike-out (2 "
+                    "consecutive children marked no stage)",
+                }
+            )
             return
         if self.spent_s >= self.total_budget_s:
             self.attempts.append(
@@ -1171,6 +1187,19 @@ class _TpuTiers:
             self.failure = failure
             self.tail = tail or self.tail
             self._wedge_bundle(label, failure, tail)
+        if marks:
+            self.wedge_strikes = 0
+        else:
+            self.wedge_strikes += 1
+            if self.wedge_strikes >= 2:
+                self.skip_reason = (
+                    "backend wedge strike-out: 2 consecutive child runs "
+                    "exceeded the BACKEND budget without marking any "
+                    "stage (device preflight passed, child wedged in "
+                    "backend bring-up); remaining attempts skipped — see "
+                    "tpu_tier_wedge_bundles for the faulthandler stack "
+                    "of the wedged frame"
+                )
 
     def cpu_fallback_kernel(self) -> dict:
         """The identical kernel workload on XLA:CPU in a guarded child —
@@ -2052,6 +2081,328 @@ def elastic_train_bench() -> dict:
         except Exception:  # noqa: BLE001
             pass
         cluster.shutdown()
+
+
+def elasticity_bench() -> dict:
+    """Tier: unified elasticity plane (PR 19). Two parts. (a) Mixed
+    fleet: a 2-node cluster runs a serve deployment and an elastic
+    training gang side by side with the elasticity controller ON;
+    offered QPS walks a diurnal trough -> peak -> trough while the gang
+    keeps stepping. Exports mixed_fleet_retention_pct (final-trough
+    step rate vs first-trough), mixed_fleet_serve_p99_ms (e2e p99 over
+    the whole diurnal window), the gang-world extremes, and the disk
+    restore count (must stay 0: reshapes are object-plane only).
+    (b) Scale: run_elasticity_sim at 10k nodes times the single-solve
+    controller tick, exporting elastic_controller_tick_p99_ms. Gates:
+    RAY_TPU_BENCH_ELASTICITY_RETENTION_FLOOR,
+    RAY_TPU_BENCH_ELASTICITY_SERVE_P99_CEILING_MS,
+    RAY_TPU_BENCH_ELASTICITY_TICK_P99_MS."""
+    import random as _random
+    import threading
+
+    import jax.numpy as jnp
+
+    import ray_tpu.serve as serve
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.core.runtime import set_runtime
+    from ray_tpu.llm.serving import build_llm_deployment
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.scheduler.sim import run_elasticity_sim
+    from ray_tpu.serve.admission import Overloaded
+    from ray_tpu.serve.router import SERVE_E2E_MS
+    from ray_tpu.train import ElasticConfig, ElasticTrainer
+    from ray_tpu.util.metrics import percentile_from_buckets
+
+    out: dict = {}
+    # part (b) first: the 10k-node tick solve wants a quiet host, and it
+    # must publish even if the mixed-fleet half dies
+    try:
+        sim_nodes = int(
+            os.environ.get("RAY_TPU_BENCH_ELASTICITY_SIM_NODES", 10_000)
+        )
+        sim_ticks = int(
+            os.environ.get("RAY_TPU_BENCH_ELASTICITY_SIM_TICKS", 8)
+        )
+        # parked-shape count dominates tick cost (demand rows x nodes in
+        # the solve); 200 keeps the 10k-node tick ~4s on a 2-core CPU
+        # host while the row mix still exercises all three classes
+        sim_shapes = int(
+            os.environ.get("RAY_TPU_BENCH_ELASTICITY_SIM_SHAPES", 200)
+        )
+        sim = run_elasticity_sim(
+            num_nodes=sim_nodes, ticks=sim_ticks, task_shapes=sim_shapes
+        )
+        out.update(
+            {
+                "elastic_controller_sim_nodes": sim_nodes,
+                "elastic_controller_tick_p50_ms": sim["tick_p50_ms"],
+                "elastic_controller_tick_p99_ms": sim["tick_p99_ms"],
+                "elastic_controller_demand_rows": sim["demand_rows"],
+                "elastic_controller_solve_path": sim["solve_path"],
+            }
+        )
+        ceiling = float(
+            os.environ.get("RAY_TPU_BENCH_ELASTICITY_TICK_P99_MS", "0")
+            or 0.0
+        )
+        if ceiling > 0:
+            out["elastic_tick_p99_budget_ms"] = ceiling
+            out["elastic_tick_p99_ok"] = bool(
+                sim["tick_p99_ms"] <= ceiling
+            )
+    except Exception as exc:  # noqa: BLE001 - mixed fleet still publishes
+        out["elastic_controller_sim_error"] = repr(exc)
+
+    trough_s = float(
+        os.environ.get("RAY_TPU_BENCH_ELASTICITY_TROUGH_S", "8")
+    )
+    peak_s = float(os.environ.get("RAY_TPU_BENCH_ELASTICITY_PEAK_S", "10"))
+    qps_low = float(os.environ.get("RAY_TPU_BENCH_ELASTICITY_QPS_LOW", "1.5"))
+    qps_high = float(
+        os.environ.get("RAY_TPU_BENCH_ELASTICITY_QPS_HIGH", "10")
+    )
+    max_new = int(os.environ.get("RAY_TPU_BENCH_ELASTICITY_TOKENS", "8"))
+    total_steps = int(os.environ.get("RAY_TPU_BENCH_ELASTICITY_STEPS", 800))
+    saved = {
+        k: os.environ.get(k)
+        for k in (
+            "RAY_TPU_ELASTIC_CONTROLLER",
+            "RAY_TPU_ELASTIC_TICK_S",
+            "RAY_TPU_ELASTIC_RETIRE_MAX",
+            "RAY_TPU_ELASTIC_PROVISION_MAX",
+        )
+    }
+    os.environ["RAY_TPU_ELASTIC_CONTROLLER"] = "1"
+    os.environ["RAY_TPU_ELASTIC_TICK_S"] = "0.5"
+    # the bench fleet is fixed-size: the controller steers capacity
+    # hints and gang worlds, it must not churn the two real nodes
+    os.environ["RAY_TPU_ELASTIC_RETIRE_MAX"] = "0"
+    os.environ["RAY_TPU_ELASTIC_PROVISION_MAX"] = "0"
+    os.environ.setdefault("RAY_TPU_HEALTH_TIMEOUT_S", "2.0")
+    mcfg = tfm.ModelConfig(
+        vocab_size=64, d_model=48, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=96, max_seq_len=128, dtype=jnp.float32,
+    )
+    hot = [
+        "the quick brown fox jumps over it " * 2,
+        "in the beginning there was a tape " * 2,
+        "once upon a time in a cluster far " * 2,
+    ]
+    cluster = Cluster(use_device_scheduler=False)
+    cluster.add_node({"CPU": 4.0}, num_workers=4)
+    cluster.add_node({"CPU": 4.0}, num_workers=4)
+    rt = cluster.client()
+    set_runtime(rt)
+    t_start = time.perf_counter()
+    try:
+        serve.run(
+            build_llm_deployment(
+                mcfg,
+                name="mix-llm",
+                num_replicas=2,
+                engine="continuous",
+                max_batch=4,
+                page_size=8,
+                n_pages=128,
+            )
+        )
+        router = serve.get_router("mix-llm")
+        rng = _random.Random(11)
+        results: list = []
+        req_threads: list = []
+
+        def one_request(idx):
+            prompt = (
+                rng.choice(hot)
+                if rng.random() < 0.8
+                else f"cold prompt number {idx} with some extra words"
+            )
+            stream = None
+            try:
+                stream = router.stream(
+                    {"prompt": prompt, "max_new_tokens": max_new}
+                )
+                results.append(sum(1 for _ in stream))
+            except Overloaded:
+                pass
+            except Exception:  # noqa: BLE001
+                results.append(-1)
+            finally:
+                if stream is not None:
+                    stream.close()
+
+        def drive(qps: float, seconds: float) -> None:
+            t0 = time.perf_counter()
+            launched = 0
+            while time.perf_counter() - t0 < seconds:
+                th = threading.Thread(target=one_request, args=(launched,))
+                th.start()
+                req_threads.append(th)
+                launched += 1
+                delay = t0 + launched / qps - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+
+        # warm both replicas (compile prefill/decode) BEFORE the trainer
+        # starts: the warm-up takes tens of seconds and the step-rate
+        # windows below must overlap live stepping, not post-completion
+        warm = [
+            threading.Thread(target=one_request, args=(i,)) for i in range(4)
+        ]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=300)
+        trainer = ElasticTrainer(
+            _elastic_bench_init,
+            _elastic_bench_step,
+            total_steps=total_steps,
+            train_loop_config={"dim": 2048, "work": 32, "step_sleep": 0.04},
+            elastic_config=ElasticConfig(
+                min_workers=1,
+                max_workers=2,
+                virtual_shards=4,
+                seal_interval_steps=2,
+                grow=True,
+                placement_strategy="SPREAD",
+                resources_per_worker={"CPU": 1.0},
+            ),
+        )
+        fit_box: dict = {}
+
+        def _fit():
+            try:
+                fit_box["res"] = trainer.fit()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                fit_box["exc"] = exc
+
+        fit_th = threading.Thread(target=_fit)
+        fit_th.start()
+        deadline = time.monotonic() + 120
+        while (
+            trainer.progress()["step"] < 5
+            and time.monotonic() < deadline
+            and fit_th.is_alive()
+        ):
+            time.sleep(0.1)
+        if "exc" in fit_box:
+            raise fit_box["exc"]
+
+        worlds: list = []
+        stop_evt = threading.Event()
+
+        def _sample_worlds():
+            while not stop_evt.is_set():
+                try:
+                    gangs = rt.head.call("QueryState", {"kind": "gangs"})
+                    info = gangs.get(trainer.gang_id)
+                    if info:
+                        worlds.append(len(info.get("members") or {}))
+                except Exception:  # noqa: BLE001
+                    pass
+                stop_evt.wait(0.5)
+
+        sampler = threading.Thread(target=_sample_worlds, daemon=True)
+        sampler.start()
+        _lbl = {"deployment": "mix-llm"}
+        e2e_base = SERVE_E2E_MS.buckets_snapshot(_lbl)
+        # trough A: light serve load, the gang should hold full world
+        sA, tA = trainer.progress()["step"], time.monotonic()
+        drive(qps_low, trough_s)
+        rate_a = (trainer.progress()["step"] - sA) / (time.monotonic() - tA)
+        world_trough_a = max(worlds[-4:] or [0])
+        peak_idx = len(worlds)
+        # peak: serve pressure outbids the gang's weight class; any cede
+        # the controller orders shows up as a dip in the world timeline
+        drive(qps_high, peak_s)
+        world_peak_min = min(worlds[peak_idx:] or [0])
+        # trough B: pressure drains, the gang grows back; retention is
+        # this window's step rate against trough A's
+        sB, tB = trainer.progress()["step"], time.monotonic()
+        drive(qps_low, trough_s)
+        rate_b = (trainer.progress()["step"] - sB) / (time.monotonic() - tB)
+        world_trough_b = max(worlds[-4:] or [0])
+        serve_p99 = percentile_from_buckets(
+            SERVE_E2E_MS.boundaries,
+            [
+                max(0, a - b)
+                for a, b in zip(SERVE_E2E_MS.buckets_snapshot(_lbl), e2e_base)
+            ],
+            0.99,
+        )
+        for t in req_threads:
+            t.join(timeout=300)
+        fit_th.join(timeout=300)
+        stop_evt.set()
+        if "exc" in fit_box:
+            raise fit_box["exc"]
+        res = fit_box.get("res")
+        if fit_th.is_alive() or res is None:
+            raise TimeoutError("elasticity bench fit() did not finish")
+        if res.error is not None:
+            raise res.error
+        el = res.metrics["elastic"]
+        retention = 100.0 * rate_b / rate_a if rate_a > 0 else 0.0
+        out.update(
+            {
+                "mixed_fleet_retention_pct": round(retention, 1),
+                "mixed_fleet_step_rate_trough_a_per_s": round(rate_a, 2),
+                "mixed_fleet_step_rate_trough_b_per_s": round(rate_b, 2),
+                "mixed_fleet_serve_p99_ms": round(serve_p99, 1),
+                "mixed_fleet_requests_completed": sum(
+                    1 for r in results if r == max_new
+                ),
+                "mixed_fleet_requests_errored": sum(
+                    1 for r in results if r == -1
+                ),
+                "mixed_fleet_gang_world_trough_a": world_trough_a,
+                "mixed_fleet_gang_world_peak_min": world_peak_min,
+                "mixed_fleet_gang_world_trough_b": world_trough_b,
+                "mixed_fleet_reshapes": [
+                    (r["direction"], r["from_world"], r["to_world"])
+                    for r in el["reshapes"]
+                ],
+                "mixed_fleet_disk_restores": el["disk_restores"],
+                "mixed_fleet_wall_s": round(time.perf_counter() - t_start, 1),
+            }
+        )
+        floor = float(
+            os.environ.get("RAY_TPU_BENCH_ELASTICITY_RETENTION_FLOOR", "0")
+            or 0.0
+        )
+        if floor > 0:
+            out["mixed_fleet_retention_floor_pct"] = floor
+            out["mixed_fleet_retention_ok"] = bool(
+                retention >= floor and el["disk_restores"] == 0
+            )
+        p99_budget = float(
+            os.environ.get(
+                "RAY_TPU_BENCH_ELASTICITY_SERVE_P99_CEILING_MS", "0"
+            )
+            or 0.0
+        )
+        if p99_budget > 0:
+            out["mixed_fleet_serve_p99_budget_ms"] = p99_budget
+            out["mixed_fleet_serve_p99_ok"] = bool(
+                out["mixed_fleet_serve_p99_ms"] <= p99_budget
+            )
+        return out
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        set_runtime(None)
+        try:
+            rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def serve_bench() -> dict:
@@ -3147,6 +3498,11 @@ def main():
             cluster.update(router_scale_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["router_scale_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_ELASTICITY", "1") != "0":
+        try:
+            cluster.update(elasticity_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["elasticity_error"] = repr(exc)
     if tiers is not None:
         # TPU attempt 2: ~10 minutes of e2e tiers later the tunnel may
         # have recovered; attempt 3 at the very end with a raised
@@ -3215,6 +3571,9 @@ def main():
         or out.get("shuffle_floor_ok") is False
         or out.get("failover_p95_ok") is False
         or out.get("elastic_retention_ok") is False
+        or out.get("mixed_fleet_retention_ok") is False
+        or out.get("mixed_fleet_serve_p99_ok") is False
+        or out.get("elastic_tick_p99_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
@@ -3231,7 +3590,10 @@ def main():
         # RAY_TPU_BENCH_XNODE_FLOOR_MB_PER_S /
         # RAY_TPU_BENCH_SHUFFLE_FLOOR_MB_PER_S /
         # RAY_TPU_BENCH_FAILOVER_P95_S /
-        # RAY_TPU_BENCH_ELASTIC_RETENTION_FLOOR):
+        # RAY_TPU_BENCH_ELASTIC_RETENTION_FLOOR /
+        # RAY_TPU_BENCH_ELASTICITY_RETENTION_FLOOR /
+        # RAY_TPU_BENCH_ELASTICITY_SERVE_P99_CEILING_MS /
+        # RAY_TPU_BENCH_ELASTICITY_TICK_P99_MS):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

@@ -119,6 +119,15 @@ class Autoscaler:
 
     # -- one reconcile pass --------------------------------------------
     def tick(self) -> ScalingDecision:
+        from ray_tpu.config import cfg
+
+        if cfg.elastic_controller:
+            # unified elasticity plane (PR 19): the head controller's
+            # single solve owns provision/retire — a second loop sizing
+            # the same fleet would race it (the exact thrash the
+            # controller exists to end). No-op decision; flipping
+            # RAY_TPU_ELASTIC_CONTROLLER=0 restores this loop untouched.
+            return ScalingDecision()
         # v2 reconciler: retry lost launches, promote REQUESTED->RUNNING
         if hasattr(self.provider, "reconcile"):
             self.provider.reconcile()

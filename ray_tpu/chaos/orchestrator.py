@@ -440,6 +440,31 @@ class ChaosOrchestrator:
                 f"SIGKILLed rank node {nid} "
                 f"({len(self._killed_gang_nodes)} gang(s) fencing)"
             )
+        if kind == "node_drain":
+            # cooperative drain-ahead retire (PR 19): head zeroes the
+            # node's advertised capacity, preemptively migrates leased
+            # work off it before the deadline, then terminates the agent.
+            # Unlike kill_node the work is moved, not lost — retryable
+            # leases must land elsewhere with zero attempts burned.
+            live = self._live_nodes()
+            if len(live) < 2:
+                return "skipped: need >=2 live nodes to drain one"
+            nid = live[spec.target % len(live)]
+            drain = getattr(self.cluster, "drain_node", None)
+            if drain is None:
+                return "skipped: cluster has no drain support"
+            deadline = 5.0 + 10.0 * spec.magnitude
+            drained = drain(nid, deadline_s=deadline)
+            # backfill so capacity survives the soak
+            self.cluster.add_node(
+                dict(self.node_resources),
+                num_workers=self.workers_per_node,
+                wait=False,
+            )
+            return (
+                f"drained {nid} ({'clean' if drained else 'deadline'}) "
+                f"within {deadline:.1f}s, replacement joining"
+            )
         if kind == "zygote_kill":
             nid = self._pick_node(spec)
             if nid is None:

@@ -70,6 +70,16 @@ TRAIN_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
     ("rank_node_kill", 2.0),
 )
 
+# elasticity-plane mix: adds node_drain (cooperative drain-ahead of a
+# live node — the head zeroes its advertised capacity, preemptively
+# migrates leased work off it BEFORE the deadline, then retires it; all
+# retryable work must land elsewhere with zero attempts burned). Not in
+# DEFAULT_MIX for the same seed-stability reason — plans that exercise
+# the unified elasticity controller (PR 19) pass this mix.
+ELASTIC_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
+    ("node_drain", 2.0),
+)
+
 # router-fleet mix: adds router_kill on top of the serve mix (abruptly
 # kill one ingress router of a fleet mid-stream; the sibling inheriting
 # the hash range must resume every in-flight stream token-exact from
@@ -84,6 +94,7 @@ KINDS = tuple(k for k, _ in ROUTER_MIX) + (
     "peer_conn_drop",
     "head_kill_promote",
     "rank_node_kill",
+    "node_drain",
 )
 
 

@@ -424,6 +424,10 @@ class NodeAgent:
             "cold_spawned": 0,
         }
         self._prestart_inflight = 0
+        # head-signalled drain-ahead (PR 19): while retiring, don't warm
+        # the pool — new work is steered elsewhere and any prestarted
+        # worker would die with the node
+        self._draining = False
         # ALL spawns not yet registered (prestarted or not): the backfill
         # and prestart sizing both count these as future-free capacity, so
         # N concurrent creations cannot each trigger their own spawn for
@@ -626,7 +630,7 @@ class NodeAgent:
         Bounded by prestart_max_workers above the steady pool size, and
         discounted by workers already idle or warming."""
         want = int(req.get("count", 0))
-        if want <= 0 or self._shutdown:
+        if want <= 0 or self._shutdown or self._draining:
             return {"spawned": 0}
         with self._idle_cv:
             free = len(self._idle) + self._spawns_pending
@@ -2536,6 +2540,7 @@ class NodeAgent:
                     epoch=self._head_epoch,
                 )
                 last_head_contact = time.monotonic()
+                self._draining = bool(reply.get("draining"))
                 if not reply.get("alive", True):
                     # a transient heartbeat gap (or a head restart) got us
                     # declared dead/unknown — rejoin with our live actors.

@@ -3105,6 +3105,9 @@ class RemoteRuntime:
         members: Dict[int, str],
         min_size: int = 1,
         epoch_floor: int = 0,
+        want_world: int = 0,
+        resources_per_rank: Optional[Dict[str, float]] = None,
+        grow: bool = False,
     ) -> int:
         # re-registration is the designed recovery path (monotone epoch
         # + epoch_floor), so retrying through a head blip/failover is
@@ -3118,11 +3121,21 @@ class RemoteRuntime:
                 "members": {str(r): n for r, n in members.items()},
                 "min_size": min_size,
                 "epoch_floor": epoch_floor,
+                # elasticity plane (PR 19): the driver's grow-back want
+                # and per-rank shape feed the unified demand matrix
+                "want_world": int(want_world),
+                "resources_per_rank": dict(resources_per_rank or {}),
+                "grow": bool(grow),
             },
             retries=8,
             retry_interval=0.25,
         )
         return int(reply["epoch"])
+
+    def gang_hint(self, gang_id: str) -> dict:
+        """Poll the elasticity controller's sustainable-world verdict
+        for one gang (``{"world_hint": int|None, "epoch": int}``)."""
+        return self._read("GangHint", {"gang_id": gang_id})
 
     def gang_sync(
         self, gang_id: str, epoch: int, timeout: float = 0.0

@@ -192,6 +192,59 @@ class Cluster:
             # for the dead ones to come back)
             self._agents.pop(node_id, None)
 
+    def drain_node(
+        self, node_id: str, deadline_s: Optional[float] = None
+    ) -> bool:
+        """Graceful retirement (PR 19 drain-ahead): mark the node
+        draining at the head (zero advertised capacity, drain-ahead
+        migration moves its leased work), then terminate the agent
+        process once drained or at the deadline. Returns False for
+        unknown nodes."""
+        from ray_tpu.config import cfg
+
+        if node_id not in self._agents:
+            return False
+        if deadline_s is None:
+            deadline_s = float(cfg.elastic_drain_deadline_s)
+        if not self.head.begin_node_drain(node_id, deadline_s=deadline_s):
+            return False
+        try:
+            self.head.migrate_node_leases(node_id)
+        except Exception:  # noqa: BLE001 - best-effort ahead of the kill
+            pass
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if self.head.node_drained(node_id):
+                break
+            time.sleep(0.05)
+        proc = self._agents.pop(node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.head.finish_node_drain(node_id, retire=True)
+        return True
+
+    def attach_elasticity_provider(
+        self,
+        resources: Optional[Dict[str, float]] = None,
+        num_workers: int = 1,
+        max_nodes: int = 8,
+    ) -> "ClusterProvider":
+        """Wire this harness in as the elasticity controller's agent
+        lifecycle: provisions become real ``add_node`` subprocesses,
+        retirements real drains. Returns the provider."""
+        provider = ClusterProvider(
+            self,
+            resources=resources,
+            num_workers=num_workers,
+            max_nodes=max_nodes,
+        )
+        self.head._elasticity.attach_provider(provider)
+        return provider
+
     # ------------------------------------------------------------------
     # chaos fault surface (ray_tpu.chaos rides these)
     # ------------------------------------------------------------------
@@ -259,3 +312,52 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 proc.kill()
         self._agents.clear()
+
+
+class ClusterProvider:
+    """The elasticity controller's node lifecycle against a local
+    :class:`Cluster` — the in-process analog of a cloud provider's
+    instance API. ``create_node`` launches a real agent subprocess;
+    ``drain_node``/``terminate_node`` retire one. ``max_nodes`` bounds
+    runaway provisioning the way a cloud quota would."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        resources: Optional[Dict[str, float]] = None,
+        num_workers: int = 1,
+        max_nodes: int = 8,
+    ):
+        self.cluster = cluster
+        self.resources = dict(resources or {"CPU": 2.0})
+        self.num_workers = num_workers
+        self.max_nodes = max_nodes
+        self.created: List[str] = []
+        self.terminated: List[str] = []
+
+    def node_template(self) -> Dict[str, float]:
+        return dict(self.resources)
+
+    def create_node(self) -> Optional[str]:
+        if len(self.cluster._agents) >= self.max_nodes:
+            return None
+        node_id = self.cluster.add_node(
+            resources=dict(self.resources),
+            num_workers=self.num_workers,
+            wait=False,
+        )
+        self.created.append(node_id)
+        return node_id
+
+    def drain_node(self, node_id: str, deadline_s: float) -> bool:
+        ok = self.cluster.drain_node(node_id, deadline_s=deadline_s)
+        if ok:
+            self.terminated.append(node_id)
+        return ok
+
+    def terminate_node(self, node_id: str) -> bool:
+        if node_id not in self.cluster._agents:
+            return False
+        self.cluster.kill_node(node_id)
+        self.terminated.append(node_id)
+        return True

@@ -470,6 +470,11 @@ class HeadServer:
         # floor) after a head failover, and re-registration itself bumps
         # the epoch, so a pre-failover straggler can never pass the fence.
         self._gangs: Dict[str, dict] = {}
+        # nodes mid drain-ahead (PR 19): node_id -> monotonic deadline.
+        # While a node drains, NodeReport's advertised availability is
+        # clamped to zero so no loop — legacy or unified — schedules new
+        # work onto a machine the provider is about to reclaim.
+        self._draining_nodes: Dict[str, float] = {}
         # metrics federation (ISSUE 15): typed registry deltas shipped by
         # agents (their workers' relayed through them) merge here,
         # namespaced by node/role labels; the dashboard /metrics scrape
@@ -544,6 +549,7 @@ class HeadServer:
             "GangSync": self._h_gang_sync,
             "GangFence": self._h_gang_fence,
             "GangUnregister": self._h_gang_unregister,
+            "GangHint": self._h_gang_hint,
             "ReportServeState": self._h_report_serve_state,
             "ServeFleetJoin": self._h_serve_fleet_join,
             "ServeFleetLeave": self._h_serve_fleet_leave,
@@ -603,6 +609,17 @@ class HeadServer:
             from .dashboard import Dashboard
 
             self.dashboard = Dashboard(self, host=host, port=dashboard_port)
+
+        # unified elasticity plane (PR 19): constructed always (so a
+        # provider can attach and QueryState can introspect), ticking
+        # only when cfg.elastic_controller is on — OFF leaves the three
+        # legacy loops (autoscaler, serve SLO, gang grow probe) as the
+        # sole capacity authorities, bit-for-bit.
+        from ray_tpu.scheduler.elasticity import ElasticityController
+
+        self._elasticity = ElasticityController(self)
+        if cfg.elastic_controller:
+            self._elasticity.start()
 
         self._sched_thread = threading.Thread(
             target=self._scheduler_loop, name="head-scheduler", daemon=True
@@ -1257,16 +1274,23 @@ class HeadServer:
             self._last_report[report.node_id] = time.monotonic()
             node = self.nodes.get(report.node_id)
             alive = node is not None and node.alive
+            draining = report.node_id in self._draining_nodes
             if alive:
-                self.view.update_available(report.node_id, report.available)
+                avail = report.available
+                if draining:
+                    # drain-ahead: a retiring node advertises zero so no
+                    # scheduling path lands new work on it mid-drain
+                    avail = {k: 0.0 for k in (avail or {})}
+                self.view.update_available(report.node_id, avail)
                 self._pgs_dirty = True
         if report.seals:
             self._apply_seals(report.seals)
         if report.finished_leases:
             self._finish_leases(report.finished_leases)
         # alive=False tells an agent that was (transiently) declared dead to
-        # re-register — nodes can rejoin after a heartbeat gap.
-        return {"alive": alive}
+        # re-register — nodes can rejoin after a heartbeat gap. draining=True
+        # tells the agent to stop warming its pool (PR 19 drain-ahead).
+        return {"alive": alive, "draining": draining}
 
     def _health_loop(self) -> None:
         """Strike-based liveness (gcs_health_check_manager.h analog:
@@ -4103,6 +4127,22 @@ class HeadServer:
         lease_victims, task_victims = self._pick_preemption_victims(
             node_id, need
         )
+        self._evict_victims(node_id, lease_victims, task_victims, shape_key)
+
+    def _evict_victims(
+        self,
+        node_id: str,
+        lease_victims: List[str],
+        task_victims: List[Tuple[LeaseRequest, bool]],
+        shape_key: tuple,
+    ) -> None:
+        """The execution half of a preemption/migration: revoke worker
+        leases (spill, nothing re-executes), CancelLease(force=False)
+        queued task leases (requeue, no attempt burned), and force-kill
+        running RETRYABLE tasks via the ``_preempted_leases`` attempt-free
+        requeue path. Shared by shape-starvation preemption (PR 7, victims
+        strictly cheaper than the starving shape) and drain-ahead
+        migration (PR 19, every movable lease on a retiring node)."""
         for lid in lease_victims:
             with self._cond:
                 if self._drop_task_lease_locked(lid) is None:
@@ -4178,6 +4218,84 @@ class HeadServer:
             except RpcError:
                 with self._cond:
                     self._preempted_leases.discard(lid)
+
+    # ------------------------------------------------------------------
+    # drain-ahead retirement (PR 19 unified elasticity plane)
+    # ------------------------------------------------------------------
+    def begin_node_drain(
+        self, node_id: str, deadline_s: Optional[float] = None
+    ) -> bool:
+        """Mark ``node_id`` draining: its NodeReport availability is
+        clamped to zero (no new placements) and its ClusterView row is
+        zeroed immediately so in-flight scheduling rounds stop choosing
+        it. Returns False for unknown/dead nodes."""
+        if deadline_s is None:
+            deadline_s = float(cfg.elastic_drain_deadline_s)
+        with self._cond:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return False
+            if node_id in self._draining_nodes:
+                return True
+            self._draining_nodes[node_id] = time.monotonic() + deadline_s
+            self.view.update_available(
+                node_id, {k: 0.0 for k in node.resources}
+            )
+            self._pgs_dirty = True
+            self._cond.notify_all()
+        logger.info(
+            "node %s draining (deadline %.1fs)", node_id, deadline_s
+        )
+        return True
+
+    def migrate_node_leases(self, node_id: str) -> int:
+        """Drain-ahead migration: move every movable lease off a node
+        selected for retirement BEFORE the drain deadline. Unlike
+        starvation preemption there is no strictly-cheaper constraint —
+        the node is going away, so everything that can be relocated
+        without losing completed work is: worker leases spill, queued
+        tasks requeue, running retryable tasks kill-and-requeue with no
+        attempt burned. Running max_retries=0 work is left to finish
+        inside the deadline (forcing it would turn a planned retirement
+        into a task failure). Returns the victim count."""
+        lease_victims: List[str] = []
+        task_victims: List[Tuple[LeaseRequest, bool]] = []
+        with self._cond:
+            for lid, e in self._task_leases.items():
+                if e.get("node_id") == node_id and e["state"] == "active":
+                    lease_victims.append(lid)
+            for lid, (spec, nid) in self._in_flight.items():
+                if nid != node_id or spec.kind != "task":
+                    continue
+                task_victims.append(
+                    (spec, spec.attempt < spec.max_retries)
+                )
+        if lease_victims or task_victims:
+            self._evict_victims(
+                node_id, lease_victims, task_victims, ("drain", node_id)
+            )
+        return len(lease_victims) + len(task_victims)
+
+    def node_drained(self, node_id: str) -> bool:
+        """True once nothing leased remains on a draining node."""
+        with self._cond:
+            for e in self._task_leases.values():
+                if e.get("node_id") == node_id and e["state"] == "active":
+                    return False
+            for _, (spec, nid) in self._in_flight.items():
+                if nid == node_id:
+                    return False
+        return True
+
+    def finish_node_drain(self, node_id: str, retire: bool) -> None:
+        """Close a drain: either the provider terminated the node
+        (``retire=True`` — declare it dead so leases/gangs/objects run
+        their death paths) or the drain was cancelled (``retire=False``
+        — the next NodeReport restores its advertised availability)."""
+        with self._cond:
+            self._draining_nodes.pop(node_id, None)
+        if retire:
+            self._on_node_death(node_id)
 
     def _dispatch_batch_blocking(
         self, specs: List[LeaseRequest], node_id: str, client: RpcClient
@@ -4705,31 +4823,49 @@ class HeadServer:
         autoscaler's demand source (GcsAutoscalerStateManager
         ClusterResourceState analog)."""
         with self._cond:
-            out = [dict(s.resources) for s in self._pending if s.resources]
-            out += [
-                dict(s.resources) for s in self._infeasible if s.resources
-            ]
+            parked: Dict[tuple, int] = {}
+            deferred: Dict[tuple, int] = {}
             # mid-schedule leases count too, but a round can move a spec
             # into _infeasible/_pending before its finally clears the
             # batch — dedupe by identity or the autoscaler sees 2x demand
-            seen = {id(s) for s in self._pending}
-            seen |= {id(s) for s in self._infeasible}
-            out += [
-                dict(s.resources)
-                for s in self._scheduling_batch
-                if s.resources and id(s) not in seen
-            ]
-            seen |= {id(s) for s in self._scheduling_batch}
+            seen: set = set()
+            for q in (self._pending, self._infeasible, self._scheduling_batch):
+                for s in q:
+                    if not s.resources or id(s) in seen:
+                        continue
+                    seen.add(id(s))
+                    k = _shape_key_of(s)
+                    parked[k] = parked.get(k, 0) + 1
             # specs in dispatched-but-unread pipelined rounds are demand too
             for specs in self._deferred_rounds.values():
-                out += [
-                    dict(s.resources)
-                    for s in specs
-                    if s.resources and id(s) not in seen
-                ]
-            for pg in self._pending_pgs:
-                if not pg.ready.is_set() and not pg.removed:
-                    out.extend(dict(b) for b in pg.bundles)
+                for s in specs:
+                    if not s.resources or id(s) in seen:
+                        continue
+                    seen.add(id(s))
+                    k = _shape_key_of(s)
+                    deferred[k] = deferred.get(k, 0) + 1
+            device_state = self._lazy_device._result
+            ring_keys = (
+                list(device_state.ring_keys())
+                if device_state is not None
+                else []
+            )
+            pg_bundles = [
+                dict(b)
+                for pg in self._pending_pgs
+                if not pg.ready.is_set() and not pg.removed
+                for b in pg.bundles
+            ]
+        # a shape both ring-parked and riding a deferred retry round is
+        # ONE logical backlog seen from two tables — max() it instead of
+        # summing, or the autoscaler provisions for phantom demand
+        from ray_tpu.scheduler.elasticity import dedupe_task_shapes
+
+        merged = dedupe_task_shapes(parked, deferred, ring_keys)
+        out: List[Dict[str, float]] = []
+        for key, n in merged.items():
+            out.extend(dict(key) for _ in range(n))
+        out.extend(pg_bundles)
         return out
 
     def _h_wait_actor(self, req: dict) -> ActorInfo:
@@ -5340,10 +5476,36 @@ class HeadServer:
                 "min_size": int(req.get("min_size", 1)),
                 "dead_ranks": [],
                 "updated": time.monotonic(),
+                # unified elasticity plane (PR 19): the driver declares
+                # its grow-back want so the controller can put the
+                # gang's deficit into the demand matrix; world_hint is
+                # the controller's last solver verdict (sustainable
+                # world size), polled by the driver via GangHint. A
+                # re-register (new generation) keeps no stale hint.
+                "want_world": int(req.get("want_world", 0)),
+                "resources_per_rank": dict(
+                    req.get("resources_per_rank") or {}
+                ),
+                "grow": bool(req.get("grow", False)),
+                "world_hint": None,
             }
             self._cond.notify_all()
         GANG_EPOCH_BUMPS.inc(labels={"reason": "register"})
         return {"epoch": epoch}
+
+    def _h_gang_hint(self, req: dict) -> dict:
+        """Driver poll of the elasticity controller's world-size verdict
+        for one gang: ``{"world_hint": int|None, "epoch": int}``. None
+        means the controller has not judged this gang (or is off) — the
+        driver falls back to its legacy capacity probe."""
+        with self._cond:
+            g = self._gangs.get(req["gang_id"])
+            if g is None:
+                return {"world_hint": None, "epoch": 0}
+            return {
+                "world_hint": g.get("world_hint"),
+                "epoch": g["epoch"],
+            }
 
     def _h_gang_sync(self, req: dict) -> dict:
         """Long-poll the gang's membership epoch: returns immediately
@@ -5644,25 +5806,45 @@ class HeadServer:
             ]
             snapshot = {r: dict(rep) for r, rep in reports.items()}
         hint = None
-        try:
-            from ray_tpu.scheduler.serve_demand import (
-                capacity_plan,
-                pressure_rollup,
-            )
+        # unified elasticity plane (PR 19): when the controller is on
+        # and has a fresh solver verdict for this deployment, it IS the
+        # capacity hint — one solve sized serve, gangs, and tasks
+        # together, so the one-shot plan below would just disagree with
+        # what the fleet was actually granted.
+        if cfg.elastic_controller:
+            with self._lock:
+                row = self._serve_capacity_hints.get(dep)
+            if (
+                row is not None
+                and (row.get("hint") or {}).get("source")
+                == "elastic_controller"
+                and time.monotonic() - row.get("ts", 0.0)
+                <= max(3.0, 4 * float(cfg.elastic_tick_s))
+            ):
+                hint = dict(row["hint"])
+        if hint is None:
+            try:
+                from ray_tpu.scheduler.serve_demand import (
+                    capacity_plan,
+                    pressure_rollup,
+                )
 
-            pressure = pressure_rollup(snapshot)
-            if pressure:
-                hint = capacity_plan(avail, pressure)
-        except Exception:  # noqa: BLE001 - hint is advisory
-            hint = None
-        with self._lock:
-            self._serve_capacity_hints[dep] = {
-                "hint": hint,
-                "ts": time.monotonic(),
-            }
+                pressure = pressure_rollup(snapshot)
+                if pressure:
+                    hint = capacity_plan(avail, pressure)
+            except Exception:  # noqa: BLE001 - hint is advisory
+                hint = None
+            with self._lock:
+                self._serve_capacity_hints[dep] = {
+                    "hint": hint,
+                    "ts": time.monotonic(),
+                }
+        # the hint key is ALWAYS present — a None is the positive
+        # "demand drained" signal that lets the fleet clear its
+        # hold-capacity latch immediately instead of waiting out the
+        # staleness window (hold-capacity latch fix)
         reply = {**share, "window_s": window}
-        if hint is not None:
-            reply["capacity_hint"] = hint
+        reply["capacity_hint"] = hint
         return reply
 
     def _h_query_state(self, req: dict) -> Any:
@@ -5719,9 +5901,26 @@ class HeadServer:
                         },
                         "min_size": g["min_size"],
                         "dead_ranks": list(g["dead_ranks"]),
+                        "want_world": g.get("want_world", 0),
+                        "grow": g.get("grow", False),
+                        "world_hint": g.get("world_hint"),
                     }
                     for gid, g in self._gangs.items()
                 }
+        if kind == "elasticity":
+            # unified elasticity plane (PR 19): tick latency
+            # percentiles, last actuation plan, drain table
+            ctrl = getattr(self, "_elasticity", None)
+            if ctrl is None:
+                return {"enabled": False}
+            state = ctrl.state()
+            state["enabled"] = bool(cfg.elastic_controller)
+            with self._lock:
+                state["draining_nodes"] = {
+                    n: round(d - time.monotonic(), 2)
+                    for n, d in self._draining_nodes.items()
+                }
+            return state
         if kind == "replication":
             # replicated control plane: role, shipping stream position,
             # per-standby follower lag, owner-shard occupancy, pending
@@ -5943,6 +6142,8 @@ class HeadServer:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+        if getattr(self, "_elasticity", None) is not None:
+            self._elasticity.stop()
         self._repl.stop()
         if self._pipeline is not None:
             # drain in-flight rounds (their grants are already paid for on

@@ -1164,3 +1164,82 @@ define(
     "the poll, so detection latency is governed by the health loop, "
     "not this cap.",
 )
+define(
+    "elastic_controller",
+    False,
+    "Unified elasticity plane (PR 19): one head-resident controller "
+    "tick folds serve pressure, gang grow-back wants, and parked task "
+    "demand into a single weighted demand matrix and runs one batched "
+    "device solve driving provision/retire, serve capacity hints, and "
+    "drain-ahead migration. OFF by default: the three legacy loops "
+    "(autoscaler tick, serve SLO tick, elastic grow probe) run "
+    "bit-for-bit unchanged.",
+)
+define(
+    "elastic_tick_s",
+    1.0,
+    "Elasticity controller tick period: one snapshot + one device "
+    "solve + actuation per tick.",
+)
+define(
+    "elastic_w_serve",
+    3.0,
+    "Priority weight of SERVE demand rows (per-tenant replica "
+    "pressure) in the unified elasticity solve. Higher-weighted "
+    "classes take the waterfall extraction first, so they hold first "
+    "claim on every node's capacity.",
+)
+define(
+    "elastic_w_gang",
+    2.0,
+    "Priority weight of GANG demand rows (grow-back deficits) in the "
+    "unified elasticity solve.",
+)
+define(
+    "elastic_w_task",
+    1.0,
+    "Priority weight of TASK demand rows (parked/deferred queue "
+    "shapes) in the unified elasticity solve.",
+)
+define(
+    "elastic_provision_max",
+    4,
+    "Max nodes the elasticity controller will provision per tick; "
+    "also the number of simulated-provisionable node rows appended to "
+    "the solve, so the solver can only justify what the provider is "
+    "allowed to create.",
+)
+define(
+    "elastic_node_cpus",
+    2.0,
+    "CPU resources of one hypothetical provisionable node when no "
+    "provider node_template is attached.",
+    float,
+)
+define(
+    "elastic_min_nodes",
+    1,
+    "Retirement floor: the elasticity controller never drains the "
+    "fleet below this many alive nodes.",
+)
+define(
+    "elastic_idle_retire_s",
+    30.0,
+    "A node must be solver-idle (zero demand placed on it) AND "
+    "lease-idle for this long before it becomes a retirement "
+    "candidate.",
+)
+define(
+    "elastic_retire_max",
+    1,
+    "Max nodes entering drain per controller tick — retirement is "
+    "deliberately slower than provisioning so a demand blip cannot "
+    "flap the fleet.",
+)
+define(
+    "elastic_drain_deadline_s",
+    20.0,
+    "Drain-ahead deadline: a retiring node gets this long for its "
+    "migrated work to land elsewhere before the provider terminates "
+    "it regardless.",
+)

@@ -39,3 +39,15 @@ from .binpack import (  # noqa: F401
     utilization_scores,
 )
 from .pipeline import SchedulerPipeline  # noqa: F401
+from .elasticity import (  # noqa: F401
+    DemandMatrix,
+    ElasticPlan,
+    ElasticSnapshot,
+    ElasticityController,
+    GangWant,
+    assemble_demand,
+    build_plan,
+    credit_gang_usage,
+    dedupe_task_shapes,
+    solve_demand,
+)

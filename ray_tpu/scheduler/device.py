@@ -123,6 +123,52 @@ def pad_scatter(rows: np.ndarray, vals: np.ndarray):
     return rows, vals
 
 
+def elastic_pack_solve(
+    avail: np.ndarray,
+    shapes: np.ndarray,
+    counts: np.ndarray,
+    *,
+    iters: int = 24,
+):
+    """One batched ``solve_pack_counts`` for the unified elasticity plane,
+    with both axes bucket-padded (node rows with zero capacity, shape rows
+    with zero count) so the jit cache stays keyed on bucket sizes only —
+    tick latency must not pay a re-trace every time demand churn changes
+    U or the fleet changes N. Returns host-side
+    ``(placed f32[U], per_node f32[U, N])`` trimmed back to true sizes."""
+    n, r = int(avail.shape[0]), int(avail.shape[1])
+    u = int(shapes.shape[0])
+    if n == 0 or u == 0:
+        return (
+            np.zeros((u,), dtype=np.float32),
+            np.zeros((u, n), dtype=np.float32),
+        )
+    np_pad = _bucket(n) - n
+    up_pad = _bucket(u) - u
+    if np_pad:
+        avail = np.concatenate(
+            [avail, np.zeros((np_pad, r), dtype=np.float32)]
+        )
+    if up_pad:
+        shapes = np.concatenate(
+            [shapes, np.zeros((up_pad, r), dtype=np.float32)]
+        )
+        counts = np.concatenate(
+            [counts, np.zeros((up_pad,), dtype=np.float32)]
+        )
+    from .binpack import solve_pack_counts
+
+    res = solve_pack_counts(
+        np.asarray(avail, dtype=np.float32),
+        np.asarray(shapes, dtype=np.float32),
+        np.asarray(counts, dtype=np.float32),
+        iters=int(iters),
+    )
+    placed = np.asarray(res.placed)[:u]
+    per_node = np.asarray(res.per_node)[:u, :n]
+    return placed.astype(np.float32), per_node.astype(np.float32)
+
+
 _cache_configured = False
 _jitted = None
 _jitted_lock = threading.Lock()

@@ -904,6 +904,45 @@ def dedupe_shapes(demands: np.ndarray):
     return uniq[order].astype(np.float32), remap[inverse].astype(np.int32)
 
 
+@jax.jit
+def retire_scores_impl(
+    totals: jax.Array,   # f32[N,R]
+    avail: jax.Array,    # f32[N,R]
+    demand: jax.Array,   # f32[N] — solver placements landing on the node
+) -> jax.Array:
+    """Retirement desirability per node for the elasticity plane: higher
+    = retire first. Fully idle beats partially idle (idle fraction),
+    small beats big at equal idleness (losing a small node costs the
+    least future headroom), and any node the solve placed demand on is
+    pushed far negative — the controller must never retire a machine the
+    same tick's solve just counted on."""
+    cap = jnp.maximum(totals.sum(axis=1), _EPS)
+    idle_frac = avail.sum(axis=1) / cap
+    size_bias = cap / jnp.maximum(jnp.max(cap), _EPS)
+    return idle_frac - 0.5 * size_bias - 1e6 * (demand > 0)
+
+
+def retire_order(
+    totals: np.ndarray, avail: np.ndarray, demand: np.ndarray
+) -> np.ndarray:
+    """Host wrapper: node indices best-retire-first. Falls back to the
+    equivalent NumPy scoring when the backend is unavailable."""
+    try:
+        scores = np.asarray(
+            retire_scores_impl(
+                jnp.asarray(totals, dtype=jnp.float32),
+                jnp.asarray(avail, dtype=jnp.float32),
+                jnp.asarray(demand, dtype=jnp.float32),
+            )
+        )
+    except Exception:  # noqa: BLE001 - scoring is host-recoverable
+        cap = np.maximum(totals.sum(axis=1), 1e-9)
+        idle_frac = avail.sum(axis=1) / cap
+        size_bias = cap / max(float(cap.max()), 1e-9)
+        scores = idle_frac - 0.5 * size_bias - 1e6 * (demand > 0)
+    return np.argsort(-scores, kind="stable")
+
+
 # ---------------------------------------------------------------------------
 # NumPy golden model (host, exact) — used by tests to pin down the batched
 # kernels' semantics against an independent implementation of the reference

@@ -49,14 +49,24 @@ def pressure_to_demand_rows(
     queue_per_replica: float = 8.0,
     cpu_per_replica: float = 1.0,
     max_rows: int = 64,
+    width: int = 1,
+    cpu_col: int = 0,
 ) -> Tuple[np.ndarray, List[str]]:
-    """Per-tenant serve pressure → dense demand rows ``f32[B, 1]`` (one
-    resource axis: CPU-equivalents per replica) plus the tenant each row
-    belongs to. A tenant contributes ``ceil(max(tokens/T, waiting/Q))``
-    replica-shaped rows, capped so one flooding tenant cannot blow up
-    the kernel batch (the WFQ weights already bound its actual share)."""
-    rows: List[float] = []
+    """Per-tenant serve pressure → dense demand rows ``f32[B, width]``
+    (``cpu_per_replica`` CPU-equivalents in column ``cpu_col``, zeros
+    elsewhere) plus the tenant each row belongs to. The default
+    ``width=1`` keeps the PR 18 single-axis form for ``capacity_plan``;
+    the unified elasticity controller passes the full resource width so
+    serve rows solve in the same matrix as gang and task shapes. A
+    tenant contributes ``ceil(max(tokens/T, waiting/Q))`` replica-shaped
+    rows, capped so one flooding tenant cannot blow up the kernel batch
+    (the WFQ weights already bound its actual share)."""
+    rows: List[np.ndarray] = []
     owners: List[str] = []
+    width = max(1, int(width))
+    cpu_col = min(max(0, int(cpu_col)), width - 1)
+    shape = np.zeros(width, dtype=np.float32)
+    shape[cpu_col] = cpu_per_replica
     for tenant in sorted(pressure):
         row = pressure[tenant]
         tokens = float(row.get("waiting_tokens") or 0)
@@ -67,11 +77,13 @@ def pressure_to_demand_rows(
         )
         n = int(np.ceil(need))
         for _ in range(min(n, max_rows - len(rows))):
-            rows.append(cpu_per_replica)
+            rows.append(shape)
             owners.append(tenant)
         if len(rows) >= max_rows:
             break
-    demands = np.asarray(rows, dtype=np.float32).reshape(-1, 1)
+    if not rows:
+        return np.zeros((0, width), dtype=np.float32), owners
+    demands = np.stack(rows).astype(np.float32)
     return demands, owners
 
 

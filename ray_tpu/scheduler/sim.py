@@ -424,6 +424,148 @@ def run_sim_pair(
     }
 
 
+def run_elasticity_sim(
+    num_nodes: int = 10_000,
+    *,
+    ticks: int = 50,
+    serve_tenants: int = 32,
+    gangs: int = 8,
+    task_shapes: int = 1000,
+    seed: int = 0,
+    cpu_per_node: float = 64.0,
+    memory_per_node: float = 256.0,
+) -> dict:
+    """Controller-tick latency at sim scale (PR 19 perf claim): a real
+    HeadServer with ``num_nodes`` synthetic nodes, serve pressure across
+    ``serve_tenants`` tenants, ``gangs`` under-world gangs with declared
+    wants, and ``task_shapes`` parked lease specs — then ``ticks``
+    unified controller ticks, each one snapshot + ONE batched device
+    solve + plan (actuation runs dry: no provider, retirement disabled).
+    Returns assembly/solve tick percentiles — the number that replaces
+    three Python control loops' worth of per-entity scanning."""
+    from ray_tpu.cluster.common import LeaseRequest, NodeInfo
+    from ray_tpu.cluster.head import HeadServer
+
+    rng = np.random.default_rng(seed)
+    saved = {
+        k: os.environ.get(k)
+        for k in (
+            "RAY_TPU_ELASTIC_RETIRE_MAX",
+            "RAY_TPU_ELASTIC_CONTROLLER",
+        )
+    }
+    os.environ["RAY_TPU_ELASTIC_RETIRE_MAX"] = "0"
+    # construct with the controller ticking OFF: the sim drives tick()
+    # by hand so every tick is measured, none raced
+    os.environ["RAY_TPU_ELASTIC_CONTROLLER"] = "0"
+    head = None
+    try:
+        head = HeadServer(dashboard_port=None)
+        head._send_grants = lambda grants: None
+        with head._cond:
+            for i in range(num_nodes):
+                nid = f"simnode-{i}"
+                head.nodes[nid] = NodeInfo(
+                    node_id=nid,
+                    address="",
+                    resources={
+                        "CPU": cpu_per_node,
+                        "memory": memory_per_node,
+                    },
+                )
+                head.view.add_node(nid, head.nodes[nid].resources)
+            # serve pressure: one deployment, per-tenant waiting queues
+            head._serve_budget["simdep"] = {
+                "router-0": {
+                    "usage": {},
+                    "waiting": {},
+                    "weights": {},
+                    "pressure": {
+                        f"tenant-{t}": {
+                            "waiting": int(rng.integers(1, 64)),
+                            "waiting_tokens": int(
+                                rng.integers(256, 65536)
+                            ),
+                        }
+                        for t in range(serve_tenants)
+                    },
+                    "ts": time.monotonic(),
+                }
+            }
+            # gangs below their want: grow-back demand rows
+            for g in range(gangs):
+                world = int(rng.integers(1, 4))
+                head._gangs[f"simgang-{g}"] = {
+                    "epoch": 1,
+                    "owner": "sim",
+                    "members": {
+                        r: f"simnode-{(g * 7 + r) % num_nodes}"
+                        for r in range(world)
+                    },
+                    "min_size": 1,
+                    "dead_ranks": [],
+                    "updated": time.monotonic(),
+                    "want_world": world + int(rng.integers(1, 5)),
+                    "resources_per_rank": {"CPU": 4.0},
+                    "grow": True,
+                    "world_hint": None,
+                }
+            # parked task demand: shapes sized ABOVE per-node capacity so
+            # the head's own scheduler loop keeps them infeasible across
+            # every tick (feasible ones would drain into the grant sink)
+            # — exactly the parked demand that drives provisioning
+            for i in range(task_shapes):
+                head._infeasible.append(
+                    LeaseRequest(
+                        task_id=f"simtask-{i}",
+                        name="sim",
+                        payload=b"",
+                        return_ids=[],
+                        resources={
+                            "CPU": cpu_per_node
+                            + 1.0
+                            + float(rng.integers(0, 64)),
+                            "memory": memory_per_node
+                            + float(rng.integers(0, 256)),
+                        },
+                        max_retries=0,
+                    )
+                )
+        ctrl = head._elasticity
+        # untimed warmup ticks compile the padded solve program (and any
+        # neighbor bucket the head's own infeasible-retry churn lands in)
+        for _ in range(3):
+            ctrl.tick()
+        with ctrl._lock:
+            ctrl._tick_ms.clear()
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            ctrl.tick()
+        elapsed = time.perf_counter() - t0
+        pct = ctrl.tick_percentiles()
+        last = ctrl.last_plan
+        return {
+            "num_nodes": num_nodes,
+            "ticks": ticks,
+            "elapsed_s": round(elapsed, 3),
+            "ticks_per_s": round(ticks / elapsed, 2) if elapsed else 0.0,
+            "tick_p50_ms": round(pct["p50_ms"], 3),
+            "tick_p99_ms": round(pct["p99_ms"], 3),
+            "demand_rows": last.demand_rows if last else 0,
+            "solve_path": last.path if last else "none",
+            "serve_hints": len(last.serve_hints) if last else 0,
+            "world_hints": len(last.world_hints) if last else 0,
+        }
+    finally:
+        if head is not None:
+            head.shutdown(stop_agents=False)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 _WEIGHT_ENV = (
     ("RAY_TPU_SCHED_W_UTIL", "util"),
     ("RAY_TPU_SCHED_W_HET", "het"),

@@ -329,9 +329,12 @@ class _LocalFleetCoordinator:
                 "headroom": True,
             }
             hint = _capacity_hint_local(fresh)
+        # capacity_hint is ALWAYS present: None is the positive "demand
+        # drained" signal that clears the fleet's hold-capacity latch
+        # immediately (hold-capacity latch fix) instead of letting a
+        # stale blocking hint ride out its staleness window
         reply = {**share, "window_s": window}
-        if hint is not None:
-            reply["capacity_hint"] = hint
+        reply["capacity_hint"] = hint
         return reply
 
 
@@ -913,6 +916,19 @@ class RouterFleet:
                 with self._lock:
                     self._capacity_hint = dict(reply["capacity_hint"])
                     self._capacity_hint_ts = time.monotonic()
+            elif self._capacity_hint is not None and (
+                "capacity_hint" in reply or self._hint_drained(reply)
+            ):
+                # hold-capacity latch fix: a reconcile
+                # reply carrying hint=None (pressure drained) — or one
+                # proving the fleet shrank / this tenant's parked demand
+                # emptied — clears the latched blocking hint NOW; the
+                # SLO autoscaler must not sit in hold-capacity for up to
+                # the full staleness window on a verdict about demand
+                # that no longer exists
+                with self._lock:
+                    self._capacity_hint = None
+                    self._capacity_hint_ts = 0.0
 
     # -- chaos -----------------------------------------------------------
     def chaos_kill_router(self, rid: Optional[str] = None, rng=None):
@@ -994,6 +1010,32 @@ class RouterFleet:
             "capacity_hint": self.capacity_hint(),
         }
         return base
+
+    def _hint_drained(self, reply: dict) -> bool:
+        """Drain evidence for replies from a coordinator that predates
+        the always-present ``capacity_hint`` key: the latched blocking
+        hint is moot once this fleet's routers no longer park demand
+        (every tenant's waiting queue and pending-token backlog is
+        empty) — the verdict described pressure that has drained."""
+        try:
+            with self._lock:
+                live = list(self.routers.values())
+            for router in live:
+                adm = router.admission
+                pressure = (
+                    adm.pressure_by_tenant()
+                    if hasattr(adm, "pressure_by_tenant")
+                    else {}
+                )
+                for row in (pressure or {}).values():
+                    if (
+                        int(row.get("waiting") or 0) > 0
+                        or int(row.get("waiting_tokens") or 0) > 0
+                    ):
+                        return False
+            return True
+        except Exception:  # noqa: BLE001 - advisory path only
+            return False
 
     def capacity_hint(self, max_age_s: float = 10.0) -> Optional[dict]:
         """The scheduler's last serve-pressure capacity verdict (how

@@ -222,11 +222,18 @@ class SLOAutoscaler:
         self._stop.set()
 
     def state(self) -> dict:
+        hint_fn = getattr(self.router, "capacity_hint", None)
+        try:
+            hint = hint_fn() if callable(hint_fn) else None
+        except Exception:  # noqa: BLE001 - advisory signal only
+            hint = None
         return {
             "last_decision": self.last_decision,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "capacity_blocks": self.capacity_blocks,
+            "capacity_hint": hint,
+            "capacity_blocked": self._capacity_blocked(),
             "min_replicas": self.slo.min_replicas,
             "max_replicas": self.slo.max_replicas,
             "target_ttft_ms": self.slo.target_ttft_ms,

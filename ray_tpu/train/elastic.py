@@ -1047,6 +1047,14 @@ class ElasticTrainer:
                 members,
                 min_size=self.elastic.min_workers,
                 epoch_floor=self._epoch,
+                # elasticity plane (PR 19): declare the grow-back want
+                # and per-rank shape so the unified controller can put
+                # this gang's deficit into its demand matrix
+                want_world=self.elastic.world_for(
+                    self.elastic.max_workers
+                ),
+                resources_per_rank=self._worker_res(),
+                grow=bool(self.elastic.grow),
             )
         else:
             self._epoch += 1
@@ -1265,6 +1273,42 @@ class ElasticTrainer:
                 with self._lock:
                     self._resize_request = None
             now = time.monotonic()
+            if (
+                cfg.elastic_controller
+                and self._is_remote()
+                and not broke
+                and fenced_at is None
+                and now - last_grow_probe >= float(cfg.elastic_grow_poll_s)
+            ):
+                # unified elasticity plane (PR 19): the controller's
+                # solver verdict replaces the local capacity probe —
+                # grow when it says more ranks are placeable, CEDE when
+                # serve pressure outbid this gang for its nodes (a
+                # graceful reshape to the hinted world: seals + refit,
+                # no attempts burned, no disk restore). hint=None means
+                # no verdict yet: fall through to the legacy probe so
+                # the gang never stalls on a cold controller.
+                last_grow_probe = now
+                hint = None
+                try:
+                    reply = self._runtime().gang_hint(self.gang_id)
+                    hint = reply.get("world_hint")
+                except Exception:  # noqa: BLE001 - head blip
+                    hint = None
+                if hint is not None:
+                    hinted = self.elastic.world_for(
+                        max(int(hint), self.elastic.min_workers)
+                    )
+                    if hinted > gen.world and self.elastic.grow:
+                        self._target_world = hinted
+                        self._fence("grow")
+                        fenced_at = time.monotonic()
+                    elif hinted < gen.world:
+                        self._target_world = hinted
+                        self._fence("cede")
+                        fenced_at = time.monotonic()
+                else:
+                    last_grow_probe = 0.0  # legacy probe may run now
             if (
                 self.elastic.grow
                 and not broke

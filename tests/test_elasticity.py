@@ -1,0 +1,580 @@
+"""Unified elasticity plane (PR 19): demand assembly, solve-to-actuation
+mapping, parked-demand dedupe, the capacity-hint latch fix, legacy-loop
+deferral, and the slow mixed-fleet trough-absorb/peak-cede scenario."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.scheduler.elasticity import (
+    CLASS_GANG,
+    CLASS_SERVE,
+    CLASS_TASK,
+    DemandMatrix,
+    ElasticSnapshot,
+    GangWant,
+    SolvedDemand,
+    assemble_demand,
+    build_plan,
+    credit_gang_usage,
+    dedupe_task_shapes,
+    solve_demand,
+)
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: parked-demand dedupe
+# ---------------------------------------------------------------------------
+def test_dedupe_ring_resident_shape_takes_max_not_sum():
+    key = (("CPU", 2.0),)
+    other = (("CPU", 4.0),)
+    merged = dedupe_task_shapes(
+        parked={key: 5, other: 3},
+        deferred={key: 2, other: 4},
+        ring_keys=[key],
+    )
+    # ring-pinned shape: same backlog seen from two tables -> max
+    assert merged[key] == 5
+    # non-ring shape: genuinely disjoint queues -> sum
+    assert merged[other] == 7
+
+
+def test_dedupe_drops_zero_and_handles_disjoint_sources():
+    a, b, c = (("CPU", 1.0),), (("CPU", 2.0),), (("CPU", 3.0),)
+    merged = dedupe_task_shapes(
+        parked={a: 2, c: 0},
+        deferred={b: 3},
+        ring_keys=[c],
+    )
+    assert merged == {a: 2, b: 3}
+
+
+# ---------------------------------------------------------------------------
+# demand-matrix assembly
+# ---------------------------------------------------------------------------
+def _snap(width=2, nodes=2, cpu=8.0, **kw):
+    avail = np.full((nodes, width), 0.0, dtype=np.float32)
+    avail[:, 0] = cpu
+    return ElasticSnapshot(
+        width=width,
+        avail=avail.copy(),
+        totals=avail.copy(),
+        alive=np.ones(nodes, dtype=bool),
+        node_ids=[f"n{i}" for i in range(nodes)],
+        serve_pressure=kw.pop("serve_pressure", {}),
+        gang_wants=kw.pop("gang_wants", []),
+        task_shapes=kw.pop("task_shapes", {}),
+        lease_load=kw.pop("lease_load", {}),
+    )
+
+
+def _gang(gid="g0", current=1, want=4, cpu=2.0, width=2, **kw):
+    row = np.zeros(width, dtype=np.float32)
+    row[0] = cpu
+    return GangWant(
+        gang_id=gid, current=current, want=want,
+        min_size=kw.pop("min_size", 1), row=row,
+        members_by_node=kw.pop("members_by_node", {}),
+    )
+
+
+PRESSURE = {"tenant-a": {"waiting": 16, "waiting_tokens": 0}}
+
+
+def test_assemble_orders_serve_gang_task_and_weights_rows():
+    snap = _snap(
+        serve_pressure={"dep": PRESSURE},  # 16/8 -> 2 replicas
+        gang_wants=[_gang(want=3)],
+        task_shapes={((0, 4.0),): 5},  # dense int-keyed form
+    )
+    m = assemble_demand(snap)
+    assert [int(c) for c in m.classes] == [CLASS_SERVE, CLASS_GANG, CLASS_TASK]
+    assert m.owners[0] == ("serve", "dep", "tenant-a")
+    assert m.owners[1] == ("gang", "g0")
+    assert m.owners[2][0] == "task"
+    # serve row: (shape, count) pair, not one row per replica
+    assert m.counts[0] == 2.0
+    # gang row carries the FULL want (every seat re-decided per tick)
+    assert m.counts[1] == 3.0
+    assert m.counts[2] == 5.0
+    # class weights land per row, descending
+    assert m.weights[0] > m.weights[1] > m.weights[2]
+
+
+def test_assemble_custom_weights_reorder_classes():
+    snap = _snap(
+        serve_pressure={"dep": PRESSURE},
+        task_shapes={((0, 4.0),): 2},
+    )
+    m = assemble_demand(
+        snap, weights={CLASS_SERVE: 1.0, CLASS_GANG: 2.0, CLASS_TASK: 9.0}
+    )
+    assert [int(c) for c in m.classes] == [CLASS_TASK, CLASS_SERVE]
+
+
+def test_assemble_empty_and_unpackable_keys():
+    m = assemble_demand(_snap())
+    assert m.rows == 0 and m.shapes.shape == (0, 2)
+    # string resource keys need a packer; without one they are dropped
+    m = assemble_demand(_snap(task_shapes={(("CPU", 2.0),): 3}))
+    assert m.rows == 0
+    m = assemble_demand(
+        _snap(task_shapes={(("CPU", 2.0),): 3}),
+        pack_key=lambda key: np.array([dict(key)["CPU"], 0.0], np.float32),
+    )
+    assert m.rows == 1 and m.counts[0] == 3.0
+
+
+def test_credit_gang_usage_adds_member_footprint():
+    snap = _snap(nodes=2, cpu=1.0)
+    gw = _gang(current=2, members_by_node={"n0": 2})
+    out = credit_gang_usage(snap.avail, snap.node_ids, [gw])
+    assert out[0, 0] == pytest.approx(1.0 + 2 * 2.0)
+    assert out[1, 0] == pytest.approx(1.0)
+    # unknown nodes ignored, input not mutated
+    assert snap.avail[0, 0] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# the solve (device path + first-fit equivalence on small inputs)
+# ---------------------------------------------------------------------------
+def test_solve_demand_places_by_priority_and_uses_hypo():
+    snap = _snap(nodes=1, cpu=4.0)
+    matrix = assemble_demand(
+        _snap(
+            nodes=1,
+            cpu=4.0,
+            serve_pressure={"dep": PRESSURE},  # 2 x 1 CPU
+            gang_wants=[_gang(want=2, cpu=2.0)],  # 2 x 2 CPU
+        )
+    )
+    hypo = np.zeros((2, 2), dtype=np.float32)
+    hypo[:, 0] = 2.0
+    solved = solve_demand(snap.avail, matrix, hypo_rows=hypo, iters=24)
+    assert solved.path in ("solve", "first_fit")
+    assert solved.n_real == 1 and solved.n_hypo == 2
+    # serve (higher priority) fully real-placed; gang overflow -> hypo
+    assert solved.placed_real(0) == pytest.approx(2.0)
+    total_gang = solved.placed_real(1) + solved.placed_hypo(1)
+    assert total_gang == pytest.approx(2.0)
+    assert solved.placed_hypo(1) >= 1.0
+
+
+def test_solve_demand_empty_matrix_short_circuits():
+    snap = _snap()
+    m = assemble_demand(_snap())
+    solved = solve_demand(snap.avail, m)
+    assert solved.path == "empty" and solved.placed.shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# solver -> actuation mapping (pure build_plan from a fixed solve)
+# ---------------------------------------------------------------------------
+def _fixed(matrix, per_node, n_real):
+    per_node = np.asarray(per_node, dtype=np.float32)
+    return SolvedDemand(
+        placed=per_node.sum(axis=1),
+        per_node=per_node,
+        n_real=n_real,
+        n_hypo=per_node.shape[1] - n_real,
+        path="solve",
+    )
+
+
+def test_build_plan_serve_hints_and_world_hints_from_fixed_solve():
+    snap = _snap(
+        nodes=2,
+        serve_pressure={"dep": PRESSURE},
+        gang_wants=[_gang(current=2, want=4, min_size=1)],
+    )
+    matrix = assemble_demand(snap)
+    assert matrix.rows == 2
+    # row 0 (serve, want 2): 1 real + 1 hypo; row 1 (gang, want 4):
+    # 3 real, 1 unplaced
+    per_node = [[1, 0, 1], [2, 1, 0]]
+    plan = build_plan(snap, matrix, _fixed(matrix, per_node, n_real=2))
+    hint = plan.serve_hints["dep"]
+    assert hint["source"] == "elastic_controller"
+    assert hint["replicas_wanted"] == 2
+    assert hint["replicas_placeable"] == 1
+    assert hint["unfulfilled"] == 1
+    assert hint["by_tenant"] == {"tenant-a": 1}
+    # gang verdict = real-fleet placement (3), not current + deficit
+    assert plan.world_hints == {"g0": 3}
+    assert plan.unfulfilled["gang"] == 1
+    # one hypothetical column received demand -> provision 1
+    assert plan.provision == 1
+
+
+def test_build_plan_world_hint_cede_below_current_floors_at_min_size():
+    snap = _snap(gang_wants=[_gang(current=3, want=4, min_size=2)])
+    matrix = assemble_demand(snap)
+    # solver placed zero gang seats on the real fleet (serve outbid it)
+    per_node = [[0, 0]]
+    plan = build_plan(snap, matrix, _fixed(matrix, per_node, n_real=2))
+    assert plan.world_hints == {"g0": 2}  # cede verdict, min_size floor
+
+
+def test_build_plan_retires_idle_node_past_window_respecting_floor():
+    snap = _snap(nodes=3)
+    matrix = assemble_demand(snap)  # empty
+    solved = solve_demand(snap.avail, matrix)
+    now = 1000.0
+    idle = {nid: now - 60.0 for nid in snap.node_ids}
+    plan = build_plan(
+        snap, matrix, solved, idle_since=idle, now=now,
+        min_nodes=1, idle_retire_s=30.0, retire_max=8,
+    )
+    # retire_max honored via min_nodes floor: 3 alive - retired >= 1
+    assert len(plan.retire) == 2
+    assert plan.migrate == []
+    # inside the idle window: nothing retires
+    plan = build_plan(
+        snap, matrix, solved,
+        idle_since={nid: now - 5.0 for nid in snap.node_ids},
+        now=now, min_nodes=1, idle_retire_s=30.0, retire_max=8,
+    )
+    assert plan.retire == []
+
+
+def test_build_plan_drain_ahead_consolidation_migrates_leased_node():
+    # node n1 hosts 2 migratable leases using 4 CPU; n0 has room for
+    # them and no demand goes unfulfilled -> consolidation retire + migrate
+    snap = _snap(nodes=2, cpu=8.0, lease_load={"n1": 2})
+    snap.avail[1, 0] = 4.0  # 4 CPU in use by the leases
+    matrix = assemble_demand(snap)
+    solved = solve_demand(snap.avail, matrix)
+    plan = build_plan(
+        snap, matrix, solved, idle_since={}, now=1000.0,
+        min_nodes=1, idle_retire_s=30.0, retire_max=1,
+    )
+    assert plan.retire == ["n1"]
+    assert plan.migrate == ["n1"]
+
+
+def test_build_plan_no_consolidation_when_demand_unfulfilled_or_no_fit():
+    # unfulfilled demand present -> busy nodes never consolidation-retire
+    snap = _snap(nodes=2, cpu=8.0, lease_load={"n1": 2})
+    snap.avail[1, 0] = 4.0
+    snap.task_shapes = {((0, 64.0),): 1}  # unplaceable anywhere
+    matrix = assemble_demand(snap)
+    solved = solve_demand(snap.avail, matrix)
+    plan = build_plan(
+        snap, matrix, solved, idle_since={}, now=1000.0,
+        min_nodes=1, idle_retire_s=30.0, retire_max=1,
+    )
+    assert "n1" not in plan.retire
+    # work does not fit in the rest of the fleet -> no consolidation
+    snap = _snap(nodes=2, cpu=8.0, lease_load={"n1": 2})
+    snap.avail[0, 0] = 1.0  # n0 nearly full
+    snap.avail[1, 0] = 1.0  # n1 using 7 CPU
+    matrix = assemble_demand(snap)
+    solved = solve_demand(snap.avail, matrix)
+    plan = build_plan(
+        snap, matrix, solved, idle_since={}, now=1000.0,
+        min_nodes=1, idle_retire_s=30.0, retire_max=2,
+    )
+    assert plan.retire == []
+    # busy-without-leases (actors/replicas): nothing to migrate -> skip
+    snap = _snap(nodes=2, cpu=8.0)
+    snap.avail[1, 0] = 4.0
+    matrix = assemble_demand(snap)
+    solved = solve_demand(snap.avail, matrix)
+    plan = build_plan(
+        snap, matrix, solved, idle_since={}, now=1000.0,
+        min_nodes=1, idle_retire_s=30.0, retire_max=1,
+    )
+    assert plan.retire == []
+
+
+def test_build_plan_provision_capped():
+    snap = _snap(nodes=1, cpu=0.0, gang_wants=[_gang(current=0, want=8)])
+    matrix = assemble_demand(snap)
+    per_node = [[0, 1, 1, 1, 1, 1, 1, 1, 1]]  # 8 hypo columns used
+    plan = build_plan(
+        snap, matrix, _fixed(matrix, per_node, n_real=1), provision_max=3
+    )
+    assert plan.provision == 3
+
+
+# ---------------------------------------------------------------------------
+# legacy loops defer while the controller owns the fleet
+# ---------------------------------------------------------------------------
+def test_legacy_autoscaler_tick_noops_under_controller(monkeypatch):
+    from ray_tpu.autoscaler.autoscaler import (
+        Autoscaler,
+        NodeTypeConfig,
+        ScalingDecision,
+    )
+
+    calls = []
+
+    class _Provider:
+        def create_node(self, t):
+            calls.append(("create", t.name))
+
+        def terminate_node(self, nid):
+            calls.append(("terminate", nid))
+
+        def non_terminated_nodes(self):
+            return []
+
+    class _Runtime:
+        vocab = None
+
+        def pending_resource_demands(self):
+            calls.append(("demands",))
+            return [{"CPU": 1.0}] * 4
+
+    scaler = Autoscaler(
+        _Runtime(),
+        [NodeTypeConfig(name="t", resources={"CPU": 1.0}, min_workers=2)],
+        provider=_Provider(),
+    )
+    monkeypatch.setenv("RAY_TPU_ELASTIC_CONTROLLER", "1")
+    decision = scaler.tick()
+    assert isinstance(decision, ScalingDecision)
+    assert decision.launch == {} and decision.terminate == []
+    assert calls == []  # provider and runtime never consulted
+    # controller off -> the legacy loop is restored, bit for bit
+    monkeypatch.setenv("RAY_TPU_ELASTIC_CONTROLLER", "0")
+    decision = scaler.tick()
+    # min_workers fill (2) + demand-driven launches run again
+    assert decision.launch.get("t", 0) >= 2
+    assert ("demands",) in calls and ("create", "t") in calls
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: capacity-hint latch clears on drain evidence
+# ---------------------------------------------------------------------------
+def _fleet_shell():
+    """A RouterFleet shell with just the latch state (the latch logic
+    only touches _lock/_capacity_hint/_capacity_hint_ts/routers)."""
+    import threading
+
+    from ray_tpu.serve.fleet import RouterFleet
+
+    fleet = object.__new__(RouterFleet)
+    fleet._lock = threading.Lock()
+    fleet._capacity_hint = {"replicas_placeable": 0, "unfulfilled": 3}
+    fleet._capacity_hint_ts = time.monotonic()
+    fleet.routers = {}
+    return fleet
+
+
+def test_capacity_hint_latch_clears_on_present_none_reply():
+    fleet = _fleet_shell()
+    reply = {"rate": 1.0, "capacity_hint": None}
+    # the reconcile branch under test: hint key present but None
+    if reply.get("capacity_hint") is not None:
+        pytest.fail("unexpected")
+    elif fleet._capacity_hint is not None and (
+        "capacity_hint" in reply or fleet._hint_drained(reply)
+    ):
+        with fleet._lock:
+            fleet._capacity_hint = None
+            fleet._capacity_hint_ts = 0.0
+    assert fleet.capacity_hint() is None
+
+
+def test_capacity_hint_latch_clears_when_pressure_drained():
+    class _Adm:
+        def __init__(self, pressure):
+            self._p = pressure
+
+        def pressure_by_tenant(self):
+            return self._p
+
+    class _Router:
+        def __init__(self, pressure):
+            self.admission = _Adm(pressure)
+
+    fleet = _fleet_shell()
+    # legacy coordinator reply without the hint key: parked demand still
+    # present -> latch holds
+    fleet.routers = {"r0": _Router({"t": {"waiting": 2, "waiting_tokens": 0}})}
+    assert not fleet._hint_drained({})
+    assert fleet.capacity_hint() is not None
+    # all routers drained -> latch clears without waiting for the timer
+    fleet.routers = {"r0": _Router({"t": {"waiting": 0, "waiting_tokens": 0}})}
+    assert fleet._hint_drained({})
+
+
+def test_local_coordinator_budget_reply_always_carries_hint_key():
+    from ray_tpu.serve.fleet import _LocalFleetCoordinator
+
+    coord = _LocalFleetCoordinator()
+    coord.join("dep", "r0")
+    reply = coord.budget("dep", "r0", 1, {}, {}, {}, pressure={})
+    assert "capacity_hint" in reply  # None IS the drained signal
+
+
+# ---------------------------------------------------------------------------
+# controller against a live head (hints land, QueryState exposes state)
+# ---------------------------------------------------------------------------
+def test_controller_tick_lands_hints_on_head(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ELASTIC_CONTROLLER", "0")
+    from ray_tpu.cluster.common import NodeInfo
+    from ray_tpu.cluster.head import HeadServer
+
+    head = HeadServer(dashboard_port=None)
+    try:
+        with head._cond:
+            for i in range(2):
+                nid = f"n{i}"
+                head.nodes[nid] = NodeInfo(
+                    node_id=nid, address="", resources={"CPU": 8.0}
+                )
+                head.view.add_node(nid, head.nodes[nid].resources)
+            head._serve_budget["dep"] = {
+                "r0": {
+                    "pressure": {
+                        "t0": {"waiting": 16, "waiting_tokens": 0}
+                    },
+                    "ts": time.monotonic(),
+                }
+            }
+            head._gangs["g0"] = {
+                "epoch": 1,
+                "owner": "test",
+                "members": {0: "n0"},
+                "min_size": 1,
+                "dead_ranks": [],
+                "updated": time.monotonic(),
+                "want_world": 3,
+                "resources_per_rank": {"CPU": 2.0},
+                "grow": True,
+                "world_hint": None,
+            }
+        ctrl = head._elasticity
+        summary = ctrl.tick()
+        assert summary["path"] in ("solve", "first_fit")
+        # serve hint landed where the budget reply reads
+        hint = head._serve_capacity_hints["dep"]["hint"]
+        assert hint["source"] == "elastic_controller"
+        assert hint["replicas_wanted"] == 2
+        # gang world hint landed in the table (16 CPU fleet: all 3 fit)
+        assert head._gangs["g0"]["world_hint"] == 3
+        # observability: QueryState exposes the controller state
+        state = head._h_query_state({"kind": "elasticity"})
+        assert state["ticks"] == 1
+        assert state["enabled"] is False
+        assert state["last_plan"]["path"] == summary["path"]
+    finally:
+        head.shutdown(stop_agents=False)
+
+
+def test_head_drain_zeroes_avail_and_finish_restores(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ELASTIC_CONTROLLER", "0")
+    from ray_tpu.cluster.common import NodeInfo, NodeReport
+    from ray_tpu.cluster.head import HeadServer
+
+    head = HeadServer(dashboard_port=None)
+    try:
+        with head._cond:
+            head.nodes["n0"] = NodeInfo(
+                node_id="n0", address="", resources={"CPU": 4.0}
+            )
+            head.view.add_node("n0", head.nodes["n0"].resources)
+        assert head.begin_node_drain("n0", deadline_s=30.0)
+        _, avail, _ = head.view.active_arrays()
+        assert float(avail.sum()) == 0.0
+        # heartbeats while draining stay clamped to zero and tell the
+        # agent to stop warming its pool
+        reply = head._h_node_report(
+            NodeReport(node_id="n0", available={"CPU": 4.0}, version=1)
+        )
+        assert reply["draining"] is True
+        _, avail, _ = head.view.active_arrays()
+        assert float(avail.sum()) == 0.0
+        assert head.node_drained("n0")
+        # cancel: the node returns to service, next report restores avail
+        head.finish_node_drain("n0", retire=False)
+        reply = head._h_node_report(
+            NodeReport(node_id="n0", available={"CPU": 4.0}, version=2)
+        )
+        assert reply["draining"] is False
+        _, avail, _ = head.view.active_arrays()
+        assert float(avail.sum()) == pytest.approx(4.0)
+    finally:
+        head.shutdown(stop_agents=False)
+
+
+# ---------------------------------------------------------------------------
+# slow: mixed-fleet trough-absorb / peak-cede on a synthetic 2-node head
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_mixed_fleet_trough_absorb_peak_cede(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ELASTIC_CONTROLLER", "0")
+    monkeypatch.setenv("RAY_TPU_ELASTIC_RETIRE_MAX", "0")
+    from ray_tpu.cluster.common import NodeInfo
+    from ray_tpu.cluster.head import HeadServer
+
+    head = HeadServer(dashboard_port=None)
+    try:
+        with head._cond:
+            for i in range(2):
+                nid = f"n{i}"
+                head.nodes[nid] = NodeInfo(
+                    node_id=nid, address="", resources={"CPU": 8.0}
+                )
+                head.view.add_node(nid, head.nodes[nid].resources)
+            head._gangs["gang"] = {
+                "epoch": 1,
+                "owner": "trainer",
+                "members": {0: "n0", 1: "n0"},
+                "min_size": 1,
+                "dead_ranks": [],
+                "updated": time.monotonic(),
+                "want_world": 6,
+                "resources_per_rank": {"CPU": 2.0},
+                "grow": True,
+                "world_hint": None,
+            }
+
+        def set_pressure(waiting):
+            with head._lock:
+                head._serve_budget["dep"] = {
+                    "r0": {
+                        "pressure": {
+                            "t0": {
+                                "waiting": waiting,
+                                "waiting_tokens": 0,
+                            }
+                        },
+                        "ts": time.monotonic(),
+                    }
+                }
+
+        ctrl = head._elasticity
+        # trough: 2 serve replicas leave 14 CPU -> the gang absorbs it
+        set_pressure(16)  # 16/8 = 2 replicas
+        ctrl.tick()
+        trough_hint = head._gangs["gang"]["world_hint"]
+        assert trough_hint == 6, ctrl.last_plan.summary()
+        # peak: 14 replicas of 1 CPU outbid the gang (weight order) on
+        # the 16-CPU fleet -> the gang cedes to what is left
+        set_pressure(14 * 8)
+        ctrl.tick()
+        peak_plan = ctrl.last_plan.summary()
+        peak_hint = head._gangs["gang"]["world_hint"]
+        assert peak_hint < trough_hint, peak_plan
+        assert peak_hint >= 1
+        # serve held its claim while the gang ceded
+        serve = peak_plan["serve_hints"]["dep"]
+        assert serve["replicas_placeable"] >= 12, peak_plan
+        # overflow demand asked for new capacity (hypothetical columns)
+        assert peak_plan["provision"] >= 1, peak_plan
+        # trough again: the gang takes the capacity back — no disk
+        # restore is even possible here (no trainer state): grow-back is
+        # purely the solver verdict rising, which the driver applies via
+        # seals + refit (test_elastic_train covers the zero-restore fit)
+        set_pressure(16)
+        ctrl.tick()
+        assert head._gangs["gang"]["world_hint"] == trough_hint
+        # tick latency is recorded for the p99 export
+        pct = ctrl.tick_percentiles()
+        assert pct["p99_ms"] > 0.0
+    finally:
+        head.shutdown(stop_agents=False)
