@@ -1097,6 +1097,38 @@ class _TpuTiers:
         except Exception:  # noqa: BLE001 - forensics only
             pass
 
+    @staticmethod
+    def _bundle_first_error(path: str):
+        """The first ERROR-looking line inside a crash bundle, embedded
+        directly in the bench JSON (ISSUE 20): previously
+        ``tpu_tier_skipped_reason`` pointed at bundle PATHS you needed
+        shell access to read. Scans the bundle's event rows for an
+        error-state row, then the recorded stderr tail, then falls back
+        to the bundle's reason. Best-effort — forensics never fail a
+        bench."""
+        try:
+            with open(os.path.join(path, "events.json")) as f:
+                for row in json.load(f):
+                    st = str(row.get("state", "")).upper()
+                    extra = row.get("extra") or {}
+                    if (
+                        "ERROR" in st
+                        or "FAIL" in st
+                        or (isinstance(extra, dict) and extra.get("error"))
+                    ):
+                        return json.dumps(row, default=str)[:400]
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+            for line in str(meta.get("stderr_tail", "")).splitlines():
+                if "ERROR" in line.upper():
+                    return line.strip()[:400]
+            return str(meta.get("reason", ""))[:400] or None
+        except Exception:  # noqa: BLE001
+            return None
+
     def kernel_ok(self) -> bool:
         return not self._stage_bad(self.marks.get("KERNEL"))
 
@@ -1238,6 +1270,10 @@ class _TpuTiers:
             out["tpu_stderr_tail"] = self.tail[-800:]
         if self.bundle_paths:
             out["tpu_tier_wedge_bundles"] = self.bundle_paths
+            out["tpu_tier_wedge_bundle_errors"] = [
+                {"bundle": p, "first_error": self._bundle_first_error(p)}
+                for p in self.bundle_paths
+            ]
         if not self.kernel_ok():
             out["kernel_cpu_fallback"] = self.cpu_fallback_kernel()
         return out
@@ -3408,6 +3444,111 @@ def sim_weights_bench() -> dict:
     return out
 
 
+def rl_loop_bench() -> dict:
+    """Tier: online-RL continuous-learning loop (ISSUE 20). Runs the
+    in-process rollout→train→publish cycle on a tiny causal LM with the
+    two-phase epoch fence backed by a real HeadServer (WAL on), then
+    reruns an identical loop from the same seed and asserts the loss
+    curves match bit-for-bit (rl_loss_continuity_ok — the determinism
+    oracle the chaos soak leans on). Exports rl_samples_per_s,
+    rl_publish_to_first_token_ms (mean publish→first-served-token gap),
+    rl_stale_dropped_frac, with RAY_TPU_BENCH_RL_SAMPLES_FLOOR /
+    RAY_TPU_BENCH_RL_PUBLISH_LATENCY_CEILING_MS exit-1 gates."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.cluster.head import HeadServer
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.rl import OnlineRLLoop, RLLoopConfig
+
+    steps = int(os.environ.get("RAY_TPU_BENCH_RL_STEPS", 8))
+    mc = tfm.ModelConfig(
+        vocab_size=96,
+        d_model=32,
+        n_layers=1,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=64,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+    params = tfm.init_params(mc, jax.random.PRNGKey(7))
+    lc = RLLoopConfig(
+        n_rollout_workers=2,
+        prompts_per_step=2,
+        prompt_len=6,
+        max_new_tokens=6,
+        batch_size=4,
+        total_steps=steps,
+        seed=3,
+        publish_interval=2,
+    )
+    t0 = time.perf_counter()
+
+    def _run(head_address):
+        loop = OnlineRLLoop(mc, params, lc, head_address=head_address)
+        try:
+            return loop.run()
+        finally:
+            loop.close()
+
+    with tempfile.TemporaryDirectory() as td:
+        head = HeadServer(
+            port=0,
+            use_device_scheduler=False,
+            persist_path=os.path.join(td, "head"),
+        )
+        try:
+            res = _run(head.address)
+        finally:
+            head.shutdown()
+    # continuity oracle: same seed + same protocol (local ledger — the
+    # fence is transport-agnostic) must reproduce the loss curve exactly
+    ref = _run(None)
+    continuity_ok = bool(
+        res["losses"] == ref["losses"]
+        and res["weights_epoch"] == ref["weights_epoch"]
+    )
+    pft = res["publish_to_first_token_ms"]
+    pft_mean = sum(pft) / len(pft) if pft else 0.0
+    acct = res["accounting"]
+    out = {
+        "rl_steps": steps,
+        "rl_samples_per_s": round(res["samples_per_s"], 2),
+        "rl_weights_epochs_published": res["weights_epoch"],
+        "rl_publish_to_first_token_ms": round(pft_mean, 2),
+        "rl_publish_ms": round(
+            sum(res["publish_ms"]) / max(len(res["publish_ms"]), 1), 2
+        ),
+        "rl_stale_dropped_frac": round(res["stale_dropped_frac"], 4),
+        "rl_trajectories_unaccounted": acct.get("unaccounted", -1),
+        "rl_loss_continuity_ok": continuity_ok,
+        "rl_loop_bench_s": round(time.perf_counter() - t0, 1),
+    }
+    samples_floor = float(
+        os.environ.get("RAY_TPU_BENCH_RL_SAMPLES_FLOOR", "0") or 0.0
+    )
+    if samples_floor > 0:
+        out["rl_samples_floor_per_s"] = samples_floor
+        out["rl_samples_ok"] = bool(
+            res["samples_per_s"] >= samples_floor and continuity_ok
+        )
+    latency_ceiling = float(
+        os.environ.get(
+            "RAY_TPU_BENCH_RL_PUBLISH_LATENCY_CEILING_MS", "0"
+        )
+        or 0.0
+    )
+    if latency_ceiling > 0:
+        out["rl_publish_latency_ceiling_ms"] = latency_ceiling
+        out["rl_publish_latency_ok"] = bool(
+            pft and pft_mean <= latency_ceiling
+        )
+    return out
+
+
 def main():
     out = {}
     tiers = None
@@ -3503,6 +3644,11 @@ def main():
             cluster.update(elasticity_bench())
         except Exception as exc:  # noqa: BLE001 - other tiers still publish
             cluster["elasticity_error"] = repr(exc)
+    if os.environ.get("RAY_TPU_BENCH_RL", "1") != "0":
+        try:
+            cluster.update(rl_loop_bench())
+        except Exception as exc:  # noqa: BLE001 - other tiers still publish
+            cluster["rl_loop_error"] = repr(exc)
     if tiers is not None:
         # TPU attempt 2: ~10 minutes of e2e tiers later the tunnel may
         # have recovered; attempt 3 at the very end with a raised
@@ -3574,6 +3720,8 @@ def main():
         or out.get("mixed_fleet_retention_ok") is False
         or out.get("mixed_fleet_serve_p99_ok") is False
         or out.get("elastic_tick_p99_ok") is False
+        or out.get("rl_samples_ok") is False
+        or out.get("rl_publish_latency_ok") is False
     ):
         # regression floor tripped (RAY_TPU_BENCH_ACTORS_FLOOR_PER_S /
         # RAY_TPU_BENCH_DATA_FLOOR_BLOCKS_PER_S /
@@ -3593,7 +3741,9 @@ def main():
         # RAY_TPU_BENCH_ELASTIC_RETENTION_FLOOR /
         # RAY_TPU_BENCH_ELASTICITY_RETENTION_FLOOR /
         # RAY_TPU_BENCH_ELASTICITY_SERVE_P99_CEILING_MS /
-        # RAY_TPU_BENCH_ELASTICITY_TICK_P99_MS):
+        # RAY_TPU_BENCH_ELASTICITY_TICK_P99_MS /
+        # RAY_TPU_BENCH_RL_SAMPLES_FLOOR /
+        # RAY_TPU_BENCH_RL_PUBLISH_LATENCY_CEILING_MS):
         # the JSON above still published; exit nonzero so CI notices
         import sys
 

@@ -19,12 +19,14 @@ from .plan import (  # noqa: F401
     FAILOVER_MIX,
     KINDS,
     NET_MIX,
+    RL_MIX,
     ROUTER_MIX,
     SERVE_MIX,
     ChaosPlan,
     FaultSpec,
     make_plan,
 )
+from .rl import RLRolloutWorkload  # noqa: F401
 from .serve import ServeStreamWorkload  # noqa: F401
 from .workload import ChaosCounter, ChaosWorkload  # noqa: F401
 

@@ -439,6 +439,70 @@ class InvariantChecker:
             time.sleep(0.3)
         return failures
 
+    def wait_weights_epoch_converged(
+        self, rl_adapter, timeout: float
+    ) -> List[str]:
+        """Online-RL invariant (ISSUE 20): the fleet converges on the
+        published weights epoch — live rollout replicas span at most ONE
+        epoch between them and none sits below ``published - 1``. While
+        the trainer keeps publishing, one swap is always legitimately in
+        flight toward some replica (so demanding bit-equal epochs at a
+        sampled instant would flake against a moving frontier); a swap
+        that is actually LOST still trips this, because the dead
+        replica falls ever further behind as publishes keep landing on
+        its peers. Also asserts publish atomicity: the committed epoch
+        the control plane reports never reads torn (a sealed-but-
+        uncommitted phase must coexist with the OLD committed value)."""
+        deadline = time.monotonic() + timeout
+        failures: List[str] = []
+        while time.monotonic() < deadline:
+            failures = []
+            try:
+                published = int(rl_adapter.published_epoch())
+                epochs = list(rl_adapter.replica_epochs())
+            except Exception as e:  # noqa: BLE001 - control plane moving
+                failures = [f"weights epoch state unreadable: {e!r}"]
+                time.sleep(0.3)
+                continue
+            if not epochs:
+                failures.append("no live rollout replica reported an epoch")
+            elif max(epochs) - min(epochs) > 1:
+                failures.append(
+                    f"replica weights epochs diverged: {sorted(epochs)} "
+                    f"(published={published})"
+                )
+            elif min(epochs) < published - 1:
+                failures.append(
+                    f"replica stuck {published - min(epochs)} epochs "
+                    f"behind published {published}"
+                )
+            if not failures:
+                return []
+            time.sleep(0.3)
+        return failures
+
+    def wait_trajectory_accounting(
+        self, rl_adapter, timeout: float
+    ) -> List[str]:
+        """Online-RL conservation law: every emitted trajectory is
+        trained, dropped stale, or still in flight — zero unaccounted.
+        A trajectory silently lost (or silently trained twice) breaks
+        ``emitted == trained + dropped_stale + in_flight``."""
+        deadline = time.monotonic() + timeout
+        failures: List[str] = []
+        while time.monotonic() < deadline:
+            try:
+                acct = dict(rl_adapter.trajectory_accounting())
+            except Exception as e:  # noqa: BLE001
+                failures = [f"trajectory accounting unreadable: {e!r}"]
+                time.sleep(0.3)
+                continue
+            if acct.get("unaccounted", None) == 0:
+                return []
+            failures = [f"trajectory accounting does not balance: {acct}"]
+            time.sleep(0.3)
+        return failures
+
     def check_durable_state(self, pre: Snapshot) -> List[str]:
         head = self.cluster.head
         failures: List[str] = []

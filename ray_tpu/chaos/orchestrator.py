@@ -157,6 +157,7 @@ class ChaosOrchestrator:
         straggler_peak_s: float = 0.3,
         convergence_budget_s: float = 60.0,
         serve_adapter=None,
+        rl_adapter=None,
     ):
         self.cluster = cluster
         self.workload = workload
@@ -166,6 +167,7 @@ class ChaosOrchestrator:
         self.tasks_per_step = tasks_per_step
         self.partition_hold_s = partition_hold_s
         self.straggler_peak_s = straggler_peak_s
+        self.convergence_budget_s = float(convergence_budget_s)
         self.checker = InvariantChecker(
             cluster,
             workload,
@@ -186,6 +188,12 @@ class ChaosOrchestrator:
         self.serve_adapter = serve_adapter
         self._killed_replica: Optional[int] = None
         self._killed_prefill: Optional[int] = None
+        # online-RL adapter (ISSUE 20): rollout victim selection, the
+        # publish-hold kill window, and the epoch/accounting invariants
+        self.rl_adapter = rl_adapter
+        self._killed_rollout: Optional[int] = None
+        self._killed_trainer_gangs: Optional[Dict[str, int]] = None
+        self._head_killed_mid_publish = False
 
     # -- sacrificial owner ----------------------------------------------
     def _spawn_owner_proc(self) -> None:
@@ -465,6 +473,103 @@ class ChaosOrchestrator:
                 f"drained {nid} ({'clean' if drained else 'deadline'}) "
                 f"within {deadline:.1f}s, replacement joining"
             )
+        if kind == "rollout_kill":
+            # SIGKILL a rollout replica mid-trajectory: its in-flight
+            # streams fail over token-exact via resume_from, and the
+            # re-emitted trajectories dedup by id in the feed — the
+            # accounting invariant stays balanced
+            if self.rl_adapter is None:
+                return "skipped: no RL workload registered"
+            pid = self.rl_adapter.pick_rollout_pid(self._rng)
+            if pid is None:
+                return "skipped: no live rollout replica to kill"
+            import signal as _signal
+
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                return f"skipped: rollout pid {pid} already gone"
+            self._killed_rollout = pid
+            return f"SIGKILLed rollout replica pid {pid} mid-trajectory"
+        if kind == "trainer_rank_kill":
+            # SIGKILL a node hosting ranks of the RL TRAINER's gang
+            # mid-step: the gang reshapes (PR 14) and the replayed step
+            # pulls the identical batch from the feed's step cache, so
+            # the loss curve stays continuous vs the unkilled reference
+            if self.rl_adapter is None:
+                return "skipped: no RL workload registered"
+            gang_ids = set(self.rl_adapter.trainer_gang_ids())
+            if not gang_ids:
+                return "skipped: RL trainer gang not registered yet"
+            head = self.cluster.head
+            with head._lock:
+                gangs = {
+                    gid: {
+                        "epoch": g["epoch"],
+                        "members": dict(g["members"]),
+                    }
+                    for gid, g in head._gangs.items()
+                    if gid in gang_ids
+                }
+            live = set(self._live_nodes())
+            hosts = sorted(
+                {
+                    n
+                    for g in gangs.values()
+                    for n in g["members"].values()
+                    if n in live
+                }
+            )
+            if not hosts:
+                return "skipped: no live node hosts an RL trainer rank"
+            nid = hosts[spec.target % len(hosts)]
+            self._killed_trainer_gangs = {
+                gid: g["epoch"]
+                for gid, g in gangs.items()
+                if nid in g["members"].values()
+            }
+            self.cluster.kill_node(nid)
+            self.cluster.add_node(
+                dict(self.node_resources),
+                num_workers=self.workers_per_node,
+                wait=False,
+            )
+            return (
+                f"SIGKILLed RL trainer rank node {nid} "
+                f"({len(self._killed_trainer_gangs)} gang(s) fencing)"
+            )
+        if kind == "head_kill_mid_publish":
+            # kill the leader INSIDE the seal->commit window of a
+            # two-phase weights publish: the adapter holds the publisher
+            # between phases, we SIGKILL the head there, the standby
+            # promotes, and the release lets the publisher's retry land
+            # against the new leader — either the old or the new epoch
+            # becomes visible, never a torn in-between
+            if self.rl_adapter is None:
+                return "skipped: no RL workload registered"
+            standby = getattr(self.cluster, "standby", None)
+            if standby is None or standby.promoted is not None:
+                return "skipped: no armed warm standby"
+            # how long a publish cycle can take under chaos scales with
+            # the same recovery envelope the convergence budget models —
+            # a fixed small window skips the fault whenever the trainer
+            # is mid-recovery from an earlier kill
+            arm_s = min(60.0, max(20.0, self.convergence_budget_s / 3.0))
+            if not self.rl_adapter.arm_publish_hold(timeout=arm_s):
+                return "skipped: no publish entered the seal window"
+            try:
+                self._pre_kill_epoch = self.cluster.head.cluster_epoch
+                self._head_killed = True
+                self._head_killed_mid_publish = True
+                self.cluster.kill_head()
+                if not standby.auto_promote:
+                    self.cluster.promote()
+            finally:
+                self.rl_adapter.release_publish_hold()
+            return (
+                "SIGKILLed the leader inside a seal->commit window "
+                f"(epoch {self._pre_kill_epoch}); standby promoting"
+            )
         if kind == "zygote_kill":
             nid = self._pick_node(spec)
             if nid is None:
@@ -505,6 +610,9 @@ class ChaosOrchestrator:
                 self._killed_gang_nodes: Optional[Dict[str, int]] = None
                 self._head_killed = False
                 self._pre_kill_epoch = 0
+                self._killed_rollout = None
+                self._killed_trainer_gangs = None
+                self._head_killed_mid_publish = False
                 detail = self._inject(spec)
                 logger.info(
                     "chaos #%d %s: %s", spec.index, spec.kind, detail
@@ -633,6 +741,61 @@ class ChaosOrchestrator:
                     if fleet_fail:
                         check.ok = False
                         check.failures.extend(fleet_fail)
+                if self._killed_rollout is not None:
+                    # online-RL rollout death: in-flight streams resume
+                    # token-exact, the replica set backfills, the fleet
+                    # reconverges on one weights epoch, and no
+                    # trajectory goes unaccounted (resume re-emits dedup)
+                    rl_fail = self.checker.wait_streams_resume(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    rl_fail += self.checker.wait_replica_backfilled(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    rl_fail += self.checker.wait_weights_epoch_converged(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    rl_fail += self.checker.wait_trajectory_accounting(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if rl_fail:
+                        check.ok = False
+                        check.failures.extend(rl_fail)
+                if self._killed_trainer_gangs:
+                    # RL trainer rank death: the gang fences + reshapes,
+                    # and the conservation law still balances (replayed
+                    # steps re-read the cached batch, nothing double-
+                    # counts)
+                    rl_fail = self.checker.wait_gang_reshaped(
+                        self._killed_trainer_gangs,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    rl_fail += self.checker.wait_trajectory_accounting(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if rl_fail:
+                        check.ok = False
+                        check.failures.extend(rl_fail)
+                if self._head_killed_mid_publish:
+                    # publish atomicity across the promotion: the
+                    # publisher's retry resolved to exactly one epoch on
+                    # the new leader and the fleet converged on it
+                    rl_fail = self.checker.wait_weights_epoch_converged(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    rl_fail += self.checker.wait_trajectory_accounting(
+                        self.rl_adapter,
+                        timeout=self.checker.actor_restart_budget_s,
+                    )
+                    if rl_fail:
+                        check.ok = False
+                        check.failures.extend(rl_fail)
                 recovery = time.monotonic() - t0
                 CHAOS_RECOVERY.observe(recovery)
                 if not check.ok:

@@ -90,11 +90,29 @@ ROUTER_MIX: Tuple[Tuple[str, float], ...] = SERVE_MIX + (
     ("router_kill", 2.0),
 )
 
+# online-RL mix: the triple-plane soak (ISSUE 20). rollout_kill SIGKILLs
+# a rollout replica mid-trajectory (token-exact resume via resume_from),
+# trainer_rank_kill SIGKILLs a node hosting elastic-gang ranks of the RL
+# trainer mid-step (gang reshape, loss-curve continuity vs reference),
+# and head_kill_mid_publish kills the leader INSIDE the seal->commit
+# window of a two-phase weights publish (standby promotes; the epoch is
+# either fully old or fully new, never torn). Not in DEFAULT_MIX for the
+# same seed-stability reason — plans that drive the online-RL workload
+# pass this mix.
+RL_MIX: Tuple[Tuple[str, float], ...] = DEFAULT_MIX + (
+    ("rollout_kill", 2.0),
+    ("trainer_rank_kill", 2.0),
+    ("head_kill_mid_publish", 1.0),
+)
+
 KINDS = tuple(k for k, _ in ROUTER_MIX) + (
     "peer_conn_drop",
     "head_kill_promote",
     "rank_node_kill",
     "node_drain",
+    "rollout_kill",
+    "trainer_rank_kill",
+    "head_kill_mid_publish",
 )
 
 

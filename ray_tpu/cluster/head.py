@@ -409,6 +409,14 @@ class HeadServer:
         # like stale cluster-epoch stamps. Durable (snapshot + WAL) so
         # a promoted standby keeps fencing the same epochs.
         self._serve_fleets: Dict[str, dict] = {}
+        # weights-version epochs (online-RL publish fence): deployment ->
+        # {"committed": int, "meta": dict, "sealed": {"epoch", "meta"}|None}.
+        # Publish is two-phase (seal -> commit), each phase its own WAL
+        # record replicated to standbys, so a head killed mid-publish
+        # leaves either the old or the new epoch fully visible — never a
+        # torn in-between. Fenced exactly like gang epochs: commit of an
+        # epoch that is not the currently sealed one is rejected stale.
+        self._weights_epochs: Dict[str, dict] = {}
         # fleet stream leases: stream_id -> {stream_id, deployment,
         # tenant, router_id, delivered, ts}. The delivered-count
         # checkpoints are what make router failover token-exact — a
@@ -559,6 +567,9 @@ class HeadServer:
             "ServeStreamRelease": self._h_serve_stream_release,
             "ServeStreamLookup": self._h_serve_stream_lookup,
             "ServeBudget": self._h_serve_budget,
+            "WeightsPublishSeal": self._h_weights_publish_seal,
+            "WeightsPublishCommit": self._h_weights_publish_commit,
+            "WeightsEpochGet": self._h_weights_epoch_get,
             "QueryState": self._h_query_state,
             "StandbyHello": self._h_standby_hello,
             "HeadRole": self._h_head_role,
@@ -693,6 +704,12 @@ class HeadServer:
                 # and resuming streams token-exact
                 "serve_fleets": {
                     dep: dict(f) for dep, f in self._serve_fleets.items()
+                },
+                # weights-version publish fence: committed epoch + any
+                # sealed-but-uncommitted phase survive restart/promotion
+                # so the publisher's retry resolves to exactly one epoch
+                "weights_epochs": {
+                    dep: dict(w) for dep, w in self._weights_epochs.items()
                 },
                 "serve_streams": [
                     dict(row) for row in self._serve_streams.values()
@@ -831,6 +848,12 @@ class HeadServer:
                 "epoch": int(f.get("epoch", 0)),
                 "members": list(f.get("members", ())),
             }
+        for dep, w in snap.get("weights_epochs", {}).items():
+            self._weights_epochs[dep] = {
+                "committed": int(w.get("committed", 0)),
+                "meta": dict(w.get("meta", {})),
+                "sealed": dict(w["sealed"]) if w.get("sealed") else None,
+            }
         for row in snap.get("serve_streams", []):
             self._serve_streams[row["stream_id"]] = dict(row)
         for actor_id, fields in snap.get("actors", {}).items():
@@ -908,6 +931,8 @@ class HeadServer:
                         row["router_id"] = rec[1]["router_id"]
             elif kind == "serve_stream_gone":
                 self._serve_streams.pop(rec[1], None)
+            elif kind == "weights_epoch":
+                self._replay_weights_epoch(rec[1])
         logger.info(
             "recovered head state: %d kv keys, %d actors, %d jobs, "
             "%d WAL records",
@@ -5847,6 +5872,101 @@ class HeadServer:
         reply["capacity_hint"] = hint
         return reply
 
+    # -- weights-version epochs (online-RL two-phase publish fence) -------
+
+    def _replay_weights_epoch(self, row: dict) -> None:
+        """Apply one ``weights_epoch`` WAL record (seal or commit phase).
+        Shared by replay-after-restart and the standby's replication
+        apply path — both must converge on the leader's exact state."""
+        dep = row["deployment"]
+        w = self._weights_epochs.setdefault(
+            dep, {"committed": 0, "meta": {}, "sealed": None}
+        )
+        if row.get("phase") == "seal":
+            w["sealed"] = {
+                "epoch": int(row["epoch"]),
+                "meta": dict(row.get("meta", {})),
+            }
+        else:  # commit
+            w["committed"] = int(row["epoch"])
+            w["meta"] = dict(row.get("meta", {}))
+            w["sealed"] = None
+
+    def _h_weights_publish_seal(self, req: dict) -> dict:
+        """Phase 1 of a weights publish: reserve committed+1 and WAL the
+        seal. A re-seal (publisher retrying after a head death) simply
+        supersedes any dangling sealed phase — only a commit that names
+        the currently sealed epoch lands, so the fence can never tear."""
+        dep = req["deployment"]
+        with self._lock:
+            w = self._weights_epochs.setdefault(
+                dep, {"committed": 0, "meta": {}, "sealed": None}
+            )
+            epoch = int(w["committed"]) + 1
+            meta = dict(req.get("meta") or {})
+            w["sealed"] = {"epoch": epoch, "meta": meta}
+            self._wal(
+                (
+                    "weights_epoch",
+                    {
+                        "deployment": dep,
+                        "phase": "seal",
+                        "epoch": epoch,
+                        "meta": meta,
+                    },
+                )
+            )
+            reply = {"epoch": epoch, "committed": int(w["committed"])}
+        self._wal_flush()
+        return reply
+
+    def _h_weights_publish_commit(self, req: dict) -> dict:
+        """Phase 2: flip the sealed epoch to committed. Stale-fenced like
+        gang epochs — a commit for anything other than the currently
+        sealed epoch is rejected so a deposed publisher (or a retry that
+        raced a newer seal) can never clobber the fence."""
+        dep = req["deployment"]
+        epoch = int(req["epoch"])
+        with self._lock:
+            w = self._weights_epochs.setdefault(
+                dep, {"committed": 0, "meta": {}, "sealed": None}
+            )
+            sealed = w.get("sealed")
+            if int(w["committed"]) >= epoch:
+                # idempotent re-commit after a lost reply
+                reply = {"committed": int(w["committed"]), "stale": False}
+            elif sealed is None or int(sealed["epoch"]) != epoch:
+                reply = {"committed": int(w["committed"]), "stale": True}
+            else:
+                w["committed"] = epoch
+                w["meta"] = dict(sealed.get("meta", {}))
+                w["sealed"] = None
+                self._wal(
+                    (
+                        "weights_epoch",
+                        {
+                            "deployment": dep,
+                            "phase": "commit",
+                            "epoch": epoch,
+                            "meta": w["meta"],
+                        },
+                    )
+                )
+                reply = {"committed": epoch, "stale": False}
+        self._wal_flush()
+        return reply
+
+    def _h_weights_epoch_get(self, req: dict) -> dict:
+        with self._lock:
+            w = self._weights_epochs.get(req["deployment"])
+            if w is None:
+                return {"committed": 0, "meta": {}, "sealed": None}
+            return {
+                "committed": int(w["committed"]),
+                "meta": dict(w.get("meta", {})),
+                "sealed": dict(w["sealed"]) if w.get("sealed") else None,
+            }
+
     def _h_query_state(self, req: dict) -> Any:
         kind = req.get("kind", "summary")
         if kind == "explain_placement":
@@ -5906,6 +6026,20 @@ class HeadServer:
                         "world_hint": g.get("world_hint"),
                     }
                     for gid, g in self._gangs.items()
+                }
+        if kind == "weights_epochs":
+            # online-RL publish fence: committed epoch + any in-flight
+            # sealed phase per deployment
+            with self._lock:
+                return {
+                    dep: {
+                        "committed": int(w["committed"]),
+                        "meta": dict(w.get("meta", {})),
+                        "sealed": dict(w["sealed"])
+                        if w.get("sealed")
+                        else None,
+                    }
+                    for dep, w in self._weights_epochs.items()
                 }
         if kind == "elasticity":
             # unified elasticity plane (PR 19): tick latency

@@ -103,6 +103,7 @@ class StandbyHead:
         self._peer_links: ShardedTable = ShardedTable(n)
         self._pending_revokes: Dict[str, dict] = {}
         self._serve_fleets: Dict[str, dict] = {}
+        self._weights_epochs: Dict[str, dict] = {}
         self._serve_streams: ShardedTable = ShardedTable(n)
         self.metrics = {
             "wal_applied": 0,
@@ -188,6 +189,14 @@ class StandbyHead:
         }
         self._serve_fleets = {
             dep: dict(f) for dep, f in snap.get("serve_fleets", {}).items()
+        }
+        self._weights_epochs = {
+            dep: {
+                "committed": int(w.get("committed", 0)),
+                "meta": dict(w.get("meta", {})),
+                "sealed": dict(w["sealed"]) if w.get("sealed") else None,
+            }
+            for dep, w in snap.get("weights_epochs", {}).items()
         }
         self._serve_streams = ShardedTable(self._num_shards)
         for row in snap.get("serve_streams", []):
@@ -336,6 +345,25 @@ class StandbyHead:
                     row["router_id"] = rec[1]["router_id"]
         elif kind == "serve_stream_gone":
             self._serve_streams.pop(rec[1], None)
+        elif kind == "weights_epoch":
+            # two-phase publish fence: mirror seal/commit so a promoted
+            # standby exposes exactly the old or the new epoch — the
+            # sealed-but-uncommitted phase survives but never reads as
+            # committed (the publisher's retry re-seals + commits)
+            row = rec[1]
+            w = self._weights_epochs.setdefault(
+                row["deployment"],
+                {"committed": 0, "meta": {}, "sealed": None},
+            )
+            if row.get("phase") == "seal":
+                w["sealed"] = {
+                    "epoch": int(row["epoch"]),
+                    "meta": dict(row.get("meta", {})),
+                }
+            else:
+                w["committed"] = int(row["epoch"])
+                w["meta"] = dict(row.get("meta", {}))
+                w["sealed"] = None
 
     # -- promotion -------------------------------------------------------
     def tables_snapshot(self) -> dict:
@@ -372,6 +400,10 @@ class StandbyHead:
                 "serve_fleets": {
                     dep: dict(f)
                     for dep, f in self._serve_fleets.items()
+                },
+                "weights_epochs": {
+                    dep: dict(w)
+                    for dep, w in self._weights_epochs.items()
                 },
                 "serve_streams": [
                     dict(r) for r in self._serve_streams.values()
@@ -610,6 +642,7 @@ class StandbyHead:
                     "peer_links": len(self._peer_links),
                     "pending_revokes": len(self._pending_revokes),
                     "serve_fleets": len(self._serve_fleets),
+                    "weights_epochs": len(self._weights_epochs),
                     "serve_streams": len(self._serve_streams),
                 },
             }

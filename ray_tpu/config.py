@@ -1009,6 +1009,33 @@ define(
     "Target admitted-in-flight requests per replica: sustained excess "
     "scales up, sustained idleness (below half) drains one replica.",
 )
+define(
+    "serve_swap_drain_deadline_s",
+    30.0,
+    "Deadline for swap_params' drain of in-flight sequences: past it, "
+    "still-active slots are force-evicted (their output truncated at "
+    "the tokens generated so far) and parked submits are rejected with "
+    "Overloaded(reason='weights_swap') instead of hanging. 0 restores "
+    "the legacy unbounded drain.",
+)
+
+# ---------------------------------------------------------------------------
+# online-RL loop
+# ---------------------------------------------------------------------------
+define(
+    "rl_staleness_window",
+    2,
+    "Off-policy staleness window K for the online-RL loop: trajectories "
+    "stamped with a weights epoch older than committed-K are dropped "
+    "and counted (dropped_stale), never silently trained on.",
+)
+define(
+    "rl_publish_interval_steps",
+    4,
+    "Trainer steps between weight publishes in the online-RL loop: "
+    "every interval the trainer seals params into the object plane and "
+    "runs the two-phase (seal->commit) weights-epoch publish.",
+)
 
 # ---------------------------------------------------------------------------
 # compiled DAG

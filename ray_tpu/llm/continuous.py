@@ -28,6 +28,7 @@ pattern this follows.
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -178,6 +179,11 @@ class ContinuousBatchingEngine:
         self.model_id = model_id
         self.weights_epoch = 0
         self._swapping = False
+        # bounded swap drain (ISSUE 20): when the drain outlives
+        # cfg.serve_swap_drain_deadline_s, stuck slots are force-evicted
+        # and parked submits get a typed Overloaded instead of hanging
+        self._swap_started: Optional[float] = None
+        self.swap_force_evicted = 0
         # full-prefill vs page-adoption accounting: the disagg bench's
         # zero-re-prefill gate reads these off the decode replicas
         self.full_prefill_count = 0
@@ -525,6 +531,22 @@ class ContinuousBatchingEngine:
                 "per-slot top_k is not supported by the continuous engine "
                 "(temperature sampling and greedy are); use LLMEngine"
             )
+        if self._swapping and self._swap_started is not None:
+            from ray_tpu.config import cfg
+
+            deadline = float(cfg.serve_swap_drain_deadline_s)
+            if deadline > 0 and (
+                time.monotonic() - self._swap_started > deadline
+            ):
+                # the drain has outlived its budget: stop parking — the
+                # caller gets a typed, retryable rejection instead of an
+                # unbounded hang behind one wedged slot
+                from ray_tpu.serve.admission import Overloaded
+
+                raise Overloaded(
+                    reason="weights_swap",
+                    retry_after_s=min(deadline, 5.0),
+                )
         prompt_pages = -(-max(len(prompt), 1) // self.page)
         if prompt_pages > self.max_pages_per_seq:
             raise ValueError(
@@ -869,18 +891,60 @@ class ContinuousBatchingEngine:
         parks, every ACTIVE slot finishes its generation on the old
         weights-epoch, then the swap lands and the epoch bumps — no
         in-flight stream ever crosses weights. Queued requests stay
-        queued and admit on the NEW weights. Returns the new epoch."""
+        queued and admit on the NEW weights. Returns the new epoch.
+
+        The drain is bounded by ``cfg.serve_swap_drain_deadline_s``
+        (0 = legacy unbounded): past the deadline, still-active slots are
+        force-evicted — their output is recorded truncated at the tokens
+        generated so far, so a wedged generation can park the whole
+        replica for at most one deadline, never forever."""
+        from ray_tpu.config import cfg
+
+        deadline = float(cfg.serve_swap_drain_deadline_s)
         self._swapping = True
+        self._swap_started = time.monotonic()
         try:
             while any(s.active for s in self.slots):
+                if deadline > 0 and (
+                    time.monotonic() - self._swap_started > deadline
+                ):
+                    self._force_evict_active()
+                    break
                 self.step()
             self.params = params
             if model_id is not None:
                 self.model_id = model_id
             self.weights_epoch += 1
+            if self.prefix_cache is not None:
+                # KV cached under the OLD weights must never be restored
+                # for the new ones — re-namespace the shared cache so
+                # every stale prefix misses (engines swapping to the
+                # same model id keep sharing the new namespace)
+                self.prefix_cache.retag(
+                    self.model_id
+                    if model_id is not None
+                    else f"swap{self.weights_epoch}"
+                )
         finally:
             self._swapping = False
+            self._swap_started = None
         return self.weights_epoch
+
+    def _force_evict_active(self) -> None:
+        """Evict every still-active slot at the swap-drain deadline: the
+        partial output lands in results (eos-truncated like a normal
+        finish) so readers unblock, pages free, and the slot resets."""
+        for si, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            out = slot.out
+            if slot.eos is not None and slot.eos in out:
+                out = out[: out.index(slot.eos)]
+            self.results[slot.req_id] = out
+            self.pool.free(slot.pages)
+            self.slots[si] = _Slot()
+            self.active_mask = self.active_mask.at[si].set(False)
+            self.swap_force_evicted += 1
 
     def _maybe_finish(self, si: int) -> None:
         slot = self.slots[si]
@@ -1019,6 +1083,7 @@ class ContinuousBatchingEngine:
             "weights_epoch": self.weights_epoch,
             "full_prefill_count": self.full_prefill_count,
             "adopted_count": self.adopted_count,
+            "swap_force_evicted": self.swap_force_evicted,
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()

@@ -350,6 +350,42 @@ def build_llm_deployment(
             writer.close_channel()
             return n
 
+        # -- online-RL hot-swap (ISSUE 20) -------------------------------
+        def swap_weights_ref(self, request) -> dict:
+            """Install params shipped through the OBJECT PLANE — the
+            online-RL publish path, where the weights are genuinely new
+            (trained this run) rather than a pre-built variant. The tree
+            lands from the ref, is registered as a variant (so
+            ``_ensure_model`` routing and replica restarts resolve the
+            model id), pushed into the node hub for same-node siblings,
+            then installed under the usual epoch-fenced drain."""
+            from ray_tpu.serve import model_store as ms
+
+            model = request["model"]
+            version = int(request.get("version", 0))
+            new_params = ray_tpu.get(request["params_ref"], timeout=60.0)
+            with self._swap_lock:
+                if model == self.engine.model_id:
+                    return {
+                        "model": model,
+                        "epoch": self.engine.weights_epoch,
+                        "swapped": False,
+                    }
+                labels = {"deployment": name, "model": str(model)}
+                t0 = time.monotonic()
+                self._variants[model] = new_params
+                if self._hub is not None:
+                    self._hub.ensure(model, version, new_params)
+                epoch = self.engine.swap_params(new_params, model_id=model)
+                now = time.monotonic()
+                ms.WEIGHT_SWAP_MS.observe(
+                    (now - t0) * 1000.0, labels=labels
+                )
+                ms.WEIGHT_SWAPS.inc(labels=labels)
+                self._swap_done_t = now
+                self._swaps += 1
+                return {"model": model, "epoch": epoch, "swapped": True}
+
         # -- observability -----------------------------------------------
         def pid(self) -> int:
             return os.getpid()

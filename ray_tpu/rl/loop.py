@@ -1,0 +1,374 @@
+"""Online-RL continuous-learning loop (ISSUE 20).
+
+The closed cycle::
+
+    rollout replicas ──trajectories──▶ TrajectoryFeed ──batches──▶ trainer
+         ▲                                                            │
+         └────── hot-swap (swap_params) ◀── two-phase publish ◀───────┘
+
+Rollout replicas are :class:`~ray_tpu.llm.continuous.
+ContinuousBatchingEngine` instances generating deterministically
+(greedy or per-request seeded), so every trajectory is reproducible
+from ``(params-epoch, prompt, seed)`` — that is what lets chaos tests
+assert token-exact resume and lets the bench prove loss-curve
+continuity by rerunning the reference. The trainer takes real causal-LM
+gradient steps (``jax.value_and_grad(tfm.loss_fn)`` + SGD) on the SAME
+model the rollouts run, so a published epoch genuinely changes rollout
+behaviour.
+
+:class:`OnlineRLLoop` is the in-process driver (fast tests, the
+``rl_loop`` bench tier, 2-core CPU friendly). For the cluster soak the
+module exports ``elastic_rl_init``/``elastic_rl_step`` — an
+:class:`~ray_tpu.train.ElasticTrainer` loop body that pulls its batches
+from a :class:`TrajectoryFeed` actor by step index; the feed's
+idempotent per-step batches are what keep the loss curve identical
+across gang reshapes.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.continuous import ContinuousBatchingEngine
+from ray_tpu.llm.engine import GenerationConfig
+from ray_tpu.models import transformer as tfm
+from ray_tpu.rl.publish import WeightsPublisher
+from ray_tpu.rl.trajectory import Trajectory, TrajectoryFeed, encode_block
+
+
+def make_prompt(
+    seed: int, step: int, worker: int, i: int, length: int, vocab: int
+) -> List[int]:
+    """Deterministic synthetic prompt — same (seed, step, worker, i)
+    always yields the same tokens, so reruns and resumed rollouts are
+    comparable token-for-token. Token 0 is reserved for padding."""
+    base = seed * 9973 + step * 131 + worker * 31 + i * 17
+    return [((base + j * 7) % (vocab - 1)) + 1 for j in range(length)]
+
+
+class RolloutWorker:
+    """One rollout replica: a continuous-batching engine plus the
+    published weights epoch it currently serves. ``set_weights`` is the
+    hot-swap edge — epoch-fenced drain via ``swap_params`` (PR 18), so
+    no in-flight stream ever mixes weights epochs."""
+
+    def __init__(
+        self,
+        model_cfg: tfm.ModelConfig,
+        params: Any,
+        rollout_id: str,
+        *,
+        max_batch: int = 2,
+        page_size: int = 8,
+        n_pages: int = 64,
+    ):
+        self.rollout_id = rollout_id
+        self.model_cfg = model_cfg
+        self.engine = ContinuousBatchingEngine(
+            model_cfg,
+            params,
+            max_batch=max_batch,
+            page_size=page_size,
+            n_pages=n_pages,
+            model_id="epoch-0",
+        )
+        self.weights_epoch = 0
+
+    def set_weights(self, epoch: int, params: Any) -> int:
+        """Hot-swap to a published epoch (idempotent; stale epochs are
+        no-ops — a replica never moves backwards)."""
+        if int(epoch) <= self.weights_epoch:
+            return self.weights_epoch
+        self.engine.swap_params(params, model_id=f"epoch-{int(epoch)}")
+        self.weights_epoch = int(epoch)
+        return self.weights_epoch
+
+    def rollout(
+        self,
+        specs: List[Dict[str, Any]],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+    ) -> Dict[str, Any]:
+        """Generate one trajectory per spec (``{"traj_id", "prompt",
+        "seed"}``), all stamped with the CURRENT weights epoch, returned
+        as an encoded block ready for ``TrajectoryFeed.emit``."""
+        epoch = self.weights_epoch
+        ids = []
+        for s in specs:
+            gen = GenerationConfig(
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                seed=int(s.get("seed", 0)),
+            )
+            ids.append(self.engine.submit(list(s["prompt"]), gen))
+        while any(r not in self.engine.results for r in ids):
+            self.engine.step()
+        trajs = []
+        for s, rid in zip(specs, ids):
+            out = self.engine.results.pop(rid)
+            prompt = list(s["prompt"])
+            trajs.append(
+                Trajectory(
+                    traj_id=s["traj_id"],
+                    prompt=prompt,
+                    tokens=prompt + list(out),
+                    weights_epoch=epoch,
+                    rollout_id=self.rollout_id,
+                    seed=int(s.get("seed", 0)),
+                )
+            )
+        return encode_block(trajs)
+
+    def probe_first_token(self) -> None:
+        """One greedy token end-to-end — the 'first serving token on the
+        new weights' the publish-latency metric measures."""
+        self.engine.generate_ids(
+            [[1, 2, 3]], GenerationConfig(max_new_tokens=1)
+        )
+
+
+@dataclass
+class RLLoopConfig:
+    n_rollout_workers: int = 2
+    prompts_per_step: int = 2  # per worker
+    prompt_len: int = 8
+    max_new_tokens: int = 8
+    batch_size: int = 4
+    lr: float = 1e-2
+    total_steps: int = 12
+    seed: int = 0
+    temperature: float = 0.0
+    staleness_window: Optional[int] = None  # None -> cfg.rl_staleness_window
+    publish_interval: Optional[int] = None  # None -> cfg.rl_publish_interval_steps
+
+
+class OnlineRLLoop:
+    """In-process rollout→train→publish driver.
+
+    ``head_address`` of None fences epochs through a local ledger —
+    same two-phase protocol, no cluster. Everything downstream of the
+    seed is deterministic, so two loops built from identical inputs
+    produce identical loss curves (the continuity oracle)."""
+
+    def __init__(
+        self,
+        model_cfg: tfm.ModelConfig,
+        init_params: Any,
+        loop_cfg: RLLoopConfig,
+        head_address: Optional[str] = None,
+        deployment: str = "rl-policy",
+        use_hub: bool = False,
+    ):
+        from ray_tpu.config import cfg
+
+        self.model_cfg = model_cfg
+        self.lc = loop_cfg
+        self.staleness_window = (
+            int(cfg.rl_staleness_window)
+            if loop_cfg.staleness_window is None
+            else int(loop_cfg.staleness_window)
+        )
+        self.publish_interval = (
+            int(cfg.rl_publish_interval_steps)
+            if loop_cfg.publish_interval is None
+            else int(loop_cfg.publish_interval)
+        )
+        self.publisher = WeightsPublisher(
+            deployment, head_address, use_hub=use_hub
+        )
+        self.feed = TrajectoryFeed(self.staleness_window)
+        self.params = init_params
+        self.epoch = 0
+        self.workers = [
+            RolloutWorker(
+                model_cfg,
+                init_params,
+                f"r{i}",
+                max_batch=max(2, loop_cfg.prompts_per_step),
+            )
+            for i in range(loop_cfg.n_rollout_workers)
+        ]
+        self._vg = jax.jit(
+            jax.value_and_grad(
+                lambda p, t: tfm.loss_fn(p, t, self.model_cfg)
+            )
+        )
+        self.losses: List[float] = []
+        self.publish_ms: List[float] = []
+        self.publish_to_first_token_ms: List[float] = []
+        self.samples_trained = 0
+
+    # -- one cycle -----------------------------------------------------
+    def _collect(self, step: int) -> None:
+        vocab = self.model_cfg.vocab_size
+        for wi, w in enumerate(self.workers):
+            specs = [
+                {
+                    "traj_id": f"{w.rollout_id}:s{step}:p{i}",
+                    "prompt": make_prompt(
+                        self.lc.seed, step, wi, i, self.lc.prompt_len, vocab
+                    ),
+                    "seed": self.lc.seed * 1000 + step * 10 + i,
+                }
+                for i in range(self.lc.prompts_per_step)
+            ]
+            self.feed.emit(
+                w.rollout(specs, self.lc.max_new_tokens, self.lc.temperature)
+            )
+
+    def _train_step(self, step: int) -> Optional[float]:
+        block = self.feed.take_for_step(
+            step, self.lc.batch_size, self.epoch, self.staleness_window
+        )
+        if block is None:
+            return None
+        tokens = jnp.asarray(block["tokens"])
+        loss, grads = self._vg(self.params, tokens)
+        lr = self.lc.lr
+        self.params = jax.tree.map(
+            lambda p, g: p - lr * g, self.params, grads
+        )
+        self.samples_trained += int(tokens.shape[0])
+        return float(loss)
+
+    def _publish(self) -> None:
+        t0 = time.monotonic()
+        self.epoch = self.publisher.publish(self.params)
+        self.feed.note_epoch(self.epoch)
+        self.publish_ms.append((time.monotonic() - t0) * 1000.0)
+        for w in self.workers:
+            w.set_weights(self.epoch, self.params)
+        self.workers[0].probe_first_token()
+        self.publish_to_first_token_ms.append(
+            (time.monotonic() - t0) * 1000.0
+        )
+
+    def run(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        for step in range(self.lc.total_steps):
+            self._collect(step)
+            loss = self._train_step(step)
+            if loss is not None:
+                self.losses.append(loss)
+            if (step + 1) % self.publish_interval == 0:
+                self._publish()
+        wall = max(time.monotonic() - t0, 1e-9)
+        acct = self.feed.accounting()
+        return {
+            "steps": self.lc.total_steps,
+            "losses": list(self.losses),
+            "weights_epoch": self.epoch,
+            "samples_trained": self.samples_trained,
+            "samples_per_s": self.samples_trained / wall,
+            "publish_ms": list(self.publish_ms),
+            "publish_to_first_token_ms": list(
+                self.publish_to_first_token_ms
+            ),
+            "accounting": acct,
+            "stale_dropped_frac": (
+                acct["dropped_stale"] / acct["emitted"]
+                if acct["emitted"]
+                else 0.0
+            ),
+            "wall_s": wall,
+        }
+
+    def close(self) -> None:
+        self.publisher.close()
+
+
+# ---------------------------------------------------------------------------
+# ElasticTrainer loop body (cluster soak): batches come from a
+# TrajectoryFeed actor keyed by step index — idempotent across gang
+# reshapes, so the killed run's loss curve matches the reference.
+# ---------------------------------------------------------------------------
+def model_config_to_dict(cfg: tfm.ModelConfig) -> Dict[str, Any]:
+    d = dict(cfg.__dict__)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    return d
+
+
+def model_config_from_dict(d: Dict[str, Any]) -> tfm.ModelConfig:
+    d = dict(d)
+    d["dtype"] = jnp.dtype(d["dtype"])
+    return tfm.ModelConfig(**d)
+
+
+def elastic_rl_init(config: Dict[str, Any]) -> Dict[str, Any]:
+    mc = model_config_from_dict(config["model"])
+    params = tfm.init_params(mc, jax.random.PRNGKey(int(config["seed"])))
+    return {"params": params}
+
+
+def elastic_rl_step(state, step, gang, config):
+    """One elastic RL trainer step: pull the (idempotent) step batch
+    from the feed actor, take a real CE gradient step, and run one
+    epoch-fenced collective so membership changes surface here exactly
+    like any SPMD loop."""
+    import ray_tpu
+
+    mc = model_config_from_dict(config["model"])
+    feed = ray_tpu.get_actor(config["feed_actor"])
+    # pacing: the feed's live override wins (lets a soak driver throttle
+    # the trainer through a fault schedule, then sprint the tail),
+    # falling back to the static config knob
+    pace = float(config.get("step_sleep", 0.0))
+    try:
+        live = ray_tpu.get(feed.pace.remote(), timeout=30.0)
+        if live is not None:
+            pace = float(live)
+    except Exception:  # noqa: BLE001 - feed actor mid-restart
+        pass
+    if pace > 0:
+        time.sleep(pace)
+    block = ray_tpu.get(
+        feed.take_for_step.remote(step, int(config["batch_size"]))
+    )
+    params = state["params"]
+    leaf0 = jax.tree_util.tree_leaves(params)[0]
+    params_finite = bool(jnp.isfinite(jnp.sum(leaf0)))
+    tok_max = -1
+    loss_val = float("nan")
+    if block is not None:
+        tokens = jnp.asarray(np.asarray(block["tokens"]))
+        tok_max = int(jnp.max(tokens))
+        loss, grads = jax.value_and_grad(
+            lambda p, t: tfm.loss_fn(p, t, mc)
+        )(params, tokens)
+        lr = float(config["lr"])
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        loss_val = float(loss)
+    # the collective every step: a rank killed mid-step is detected by
+    # the gang's epoch fence, the survivors reshape, and this step
+    # replays — pulling the SAME batch from the feed's step cache
+    partials = {v: {"one": np.ones(1)} for v in gang.owned_shards()}
+    gang.allreduce_shards(partials)
+    # cooperative stop: the feed's per-step-idempotent flag means every
+    # rank sees the same answer for the same step, so the whole gang
+    # breaks out of its loop together (a diverging rank would wedge the
+    # next collective and take a needless reshape)
+    stop = False
+    try:
+        stop = bool(ray_tpu.get(feed.stop_for_step.remote(step), timeout=30.0))
+    except Exception:  # noqa: BLE001 - feed actor mid-restart
+        pass
+    return (
+        {"params": params},
+        {
+            "step": step,
+            "loss": loss_val,
+            "world": gang.world,
+            "stop": stop,
+            # provenance for the soak's loss-continuity oracle: which
+            # trajectories this rank actually trained on (empty batch
+            # == the feed had nothing for this step)
+            "traj_ids": list(block["traj_ids"]) if block is not None else None,
+            "params_finite": params_finite,
+            "tok_max": tok_max,
+        },
+    )

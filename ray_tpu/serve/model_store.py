@@ -166,6 +166,17 @@ class WeightsHub:
             return None
         return params
 
+    def ensure(self, model_id: str, version: int, params: Any) -> bool:
+        """Publish-or-already-present: the idempotent shape a retrying
+        publisher needs (the online-RL two-phase publish re-runs its
+        whole cycle after a head failover — a version its earlier
+        attempt already sealed must read as success, not a race loss)."""
+        if self.contains(model_id, version):
+            return True
+        if self.publish(model_id, version, params):
+            return True
+        return self.contains(model_id, version)
+
     def contains(self, model_id: str, version: int) -> bool:
         try:
             return self.store.contains(

@@ -131,6 +131,7 @@ class SharedPrefixCache:
         self.store = store
         self.page = int(page_size)
         self.model_sig = model_sig
+        self._base_sig = model_sig
         self.max_bytes = int(max_bytes)
         self.max_prefix_pages = int(max_prefix_pages)
         self._lock = threading.Lock()
@@ -145,6 +146,15 @@ class SharedPrefixCache:
         return hashlib.sha256(
             b"pfx\0" + self.model_sig.encode() + b"\0" + chain_hash
         ).hexdigest()[:32]
+
+    def retag(self, tag: str) -> None:
+        """Re-namespace the cache for a weights swap: KV computed under
+        the previous weights must never be restored for the new ones, so
+        the signature (and with it every object id) changes. Entries
+        from the old epoch age out of the store via the shared budget;
+        engines on other replicas that swap to the same ``tag`` land on
+        the same namespace and keep sharing."""
+        self.model_sig = f"{self._base_sig}|{tag}"
 
     # -- lookup --------------------------------------------------------
     def lookup(

@@ -785,6 +785,14 @@ class _ElasticRank:
                     ctx._reports.append(
                         {"metrics": dict(metrics or {}), "checkpoint": None}
                     )
+                if metrics and metrics.get("stop"):
+                    # cooperative early finish (continuous-learning
+                    # loops have no fixed horizon): the step fn asked to
+                    # stop. The decision MUST be identical across ranks
+                    # for this step (derive it from per-step-idempotent
+                    # shared state), so the whole gang breaks together
+                    # and every rank returns "done" at the same step.
+                    break
                 if (
                     seal_every
                     and step % seal_every == 0
@@ -876,7 +884,12 @@ class ElasticTrainer:
     ``init_fn(config) -> state`` builds the step-0 state pytree;
     ``step_fn(state, step, gang, config) -> (state, metrics)`` advances
     one step, using ``gang.allreduce_shards`` /
-    ``gang.owned_shards()`` for reshape-invariant data parallelism."""
+    ``gang.owned_shards()`` for reshape-invariant data parallelism.
+    A truthy ``metrics["stop"]`` requests a cooperative early finish
+    (the continuous-learning case — no fixed horizon): every rank of
+    the generation must compute the same value for the same step (use
+    per-step-idempotent shared state, e.g. the RL trajectory feed's
+    ``stop_for_step``), and the gang seals + returns done there."""
 
     def __init__(
         self,
